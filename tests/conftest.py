@@ -1,0 +1,14 @@
+"""Test harness: run everything on CPU with 8 fake devices.
+
+This is the TPU-world "fake backend" (SURVEY.md §4.2): multi-chip logic
+(psum gradient allreduce, SyncBN, sharded updates) is exercised on an
+8-device host-platform mesh with no TPU present.  Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")

@@ -1,0 +1,64 @@
+import os
+
+import pytest
+
+from yet_another_mobilenet_series_tpu import config as cfg_lib
+
+
+def test_defaults_roundtrip():
+    cfg = cfg_lib.config_from_dict({})
+    assert cfg.model.arch == "mobilenet_v2"
+    assert cfg.train.batch_size == 256
+    d = cfg_lib.config_to_dict(cfg)
+    cfg2 = cfg_lib.config_from_dict(d)
+    assert cfg2 == cfg
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        cfg_lib.config_from_dict({"model": {"archh": "x"}})
+    with pytest.raises(KeyError):
+        cfg_lib.config_from_dict({"nonsense": {}})
+
+
+def test_yaml_inheritance(tmp_path):
+    base = tmp_path / "base.yml"
+    base.write_text("model:\n  arch: mobilenet_v3_large\ntrain:\n  epochs: 350\n  batch_size: 1024\n")
+    child = tmp_path / "child.yml"
+    child.write_text("_base_: base.yml\ntrain:\n  batch_size: 512\n")
+    cfg = cfg_lib.load_config(str(child))
+    assert cfg.model.arch == "mobilenet_v3_large"
+    assert cfg.train.epochs == 350.0  # inherited + coerced to float
+    assert cfg.train.batch_size == 512  # overridden
+
+
+def test_circular_inheritance_detected(tmp_path):
+    a = tmp_path / "a.yml"
+    b = tmp_path / "b.yml"
+    a.write_text("_base_: b.yml\n")
+    b.write_text("_base_: a.yml\n")
+    with pytest.raises(ValueError):
+        cfg_lib.load_config(str(a))
+
+
+def test_cli_app_and_overrides(tmp_path):
+    app = tmp_path / "app.yml"
+    app.write_text("name: exp\nmodel:\n  width_mult: 1.0\n")
+    cfg = cfg_lib.parse_cli([f"app:{app}", "model.width_mult=0.75", "train.seed=7", "ema.enable=false"])
+    assert cfg.name == "exp"
+    assert cfg.model.width_mult == 0.75
+    assert cfg.train.seed == 7
+    assert cfg.ema.enable is False
+
+
+def test_cli_rejects_garbage():
+    with pytest.raises(ValueError):
+        cfg_lib.parse_cli(["not-an-arg"])
+
+
+def test_shipped_apps_parse():
+    apps_dir = os.path.join(os.path.dirname(cfg_lib.__file__), "apps")
+    ymls = [f for f in os.listdir(apps_dir) if f.endswith(".yml")]
+    assert len(ymls) >= 5  # the five acceptance configs (BASELINE.md)
+    for f in ymls:
+        cfg_lib.load_config(os.path.join(apps_dir, f))

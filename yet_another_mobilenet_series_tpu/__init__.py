@@ -1,0 +1,23 @@
+"""yet_another_mobilenet_series_tpu: a TPU-native MobileNet/AtomNAS framework.
+
+A from-scratch JAX/XLA rebuild of the capabilities of the public
+``meijieru/yet_another_mobilenet_series`` (AtomNAS, ICLR'20) codebase:
+
+- MobileNet V1/V2/V3 + MNASNet model zoo expressed as a block-spec grammar
+  (SURVEY.md §3.4), built on a pure-functional NN core (``ops/``).
+- AtomNAS one-shot search: FLOPs-weighted L1 on BatchNorm scales of atomic
+  channel groups, with in-jit mask pruning and coarse-cadence shape
+  rematerialization (``nas/``) — the XLA-friendly replacement for the
+  reference's eager dynamic network shrinkage (SURVEY.md §3.2).
+- Data-parallel training over a ``jax.sharding.Mesh`` with psum gradient
+  allreduce and cross-replica SyncBN (``parallel/``) — replacing
+  apex DDP + apex SyncBatchNorm + NCCL (SURVEY.md §2 #12).
+- tf.data / native-C++ ImageNet input pipelines (``data/``, ``native/``) —
+  replacing NVIDIA DALI.
+- Orbax checkpointing with an architecture-spec sidecar (``ckpt/``).
+
+The reference mount was empty this round (see SURVEY.md provenance warning);
+behavioral parity targets come from SURVEY.md/BASELINE.md.
+"""
+
+__version__ = "0.1.0"

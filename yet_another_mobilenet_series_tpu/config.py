@@ -1,0 +1,347 @@
+"""Typed, immutable experiment configuration.
+
+Replaces the reference's ``utils/config.py`` global-``FLAGS`` AttrDict
+(SURVEY.md §2 #2) with frozen dataclasses passed explicitly.  The YAML surface
+stays reference-compatible in spirit:
+
+- experiments live in ``apps/*.yml`` and are selected with an ``app:<path>``
+  CLI argument,
+- a YAML file may inherit from another via a top-level ``_base_: <relpath>``
+  key (deep-merged, child wins),
+- remaining CLI args of the form ``a.b.c=value`` override individual keys.
+
+Unknown keys are an error — silent typos in a 350-epoch run are expensive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Mapping, Sequence
+
+import yaml
+
+# ---------------------------------------------------------------------------
+# YAML loading with _base_ inheritance
+# ---------------------------------------------------------------------------
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    """Recursively merge ``override`` into ``base`` (override wins)."""
+    out = dict(base)
+    for k, v in override.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def load_yaml(path: str, _seen: tuple = ()) -> dict:
+    """Load a YAML file, resolving ``_base_`` inheritance chains."""
+    path = os.path.abspath(path)
+    if path in _seen:
+        raise ValueError(f"circular _base_ inheritance: {path}")
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: top-level YAML must be a mapping")
+    base_rel = raw.pop("_base_", None)
+    if base_rel is not None:
+        base_path = os.path.join(os.path.dirname(path), base_rel)
+        base = load_yaml(base_path, _seen + (path,))
+        raw = _deep_merge(base, raw)
+    return raw
+
+
+# ---------------------------------------------------------------------------
+# Config schema
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Architecture selection.
+
+    ``arch`` names a built-in block-spec (models/zoo.py); ``block_specs``
+    overrides it with an explicit list (the reference expressed searched /
+    supernet architectures as YAML block-spec lists, SURVEY.md §2 #5 #14).
+    """
+
+    arch: str = "mobilenet_v2"
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    dropout: float = 0.2
+    # Explicit block specs override `arch`. Each entry is a mapping accepted
+    # by models.specs.BlockSpec.from_dict.
+    block_specs: Sequence[Mapping[str, Any]] | None = None
+    # Stem / head channel overrides (None = arch default).
+    stem_channels: int | None = None
+    head_channels: int | None = None
+    feature_channels: int | None = None
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    # Global default activation for blocks that don't specify one.
+    active_fn: str = "relu6"
+    # If true, classifier bias is zero-initialized (standard).
+    dtype: str = "float32"  # param dtype; compute may be bf16 (train.compute_dtype)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "imagenet"  # imagenet | fake | folder
+    data_dir: str = ""
+    train_split: str = "train"
+    val_split: str = "validation"
+    image_size: int = 224
+    eval_resize: int = 256
+    num_train_examples: int = 1281167
+    num_eval_examples: int = 50000
+    # fake dataset knobs (integration tests / benches without ImageNet)
+    fake_num_classes: int | None = None
+    fake_train_size: int = 6400
+    fake_eval_size: int = 640
+    # input pipeline
+    loader: str = "tfdata"  # tfdata | native | synthetic
+    shuffle_buffer: int = 16384
+    prefetch: int = 4
+    decode_threads: int = 8
+    # augmentation (Inception-style random-resized-crop defaults)
+    rrc_area_min: float = 0.08
+    rrc_area_max: float = 1.0
+    rrc_ratio_min: float = 0.75
+    rrc_ratio_max: float = 1.3333333333333333
+    color_jitter: float = 0.0  # brightness/contrast/saturation strength, 0=off
+    mean: Sequence[float] = (0.485, 0.456, 0.406)
+    std: Sequence[float] = (0.229, 0.224, 0.225)
+
+
+@dataclass(frozen=True)
+class OptimConfig:
+    optimizer: str = "rmsprop"  # rmsprop | sgd | adamw
+    momentum: float = 0.9
+    # TF-style RMSProp constants (eps inside the sqrt; SURVEY.md §7 hard part 2)
+    rmsprop_decay: float = 0.9
+    rmsprop_eps: float = 0.002
+    weight_decay: float = 1e-5
+    # weight-decay exemptions, reference-style (SURVEY.md §2 #7)
+    wd_skip_bn: bool = True
+    wd_skip_bias: bool = True
+    wd_skip_depthwise: bool = False
+    label_smoothing: float = 0.1
+    grad_clip_norm: float = 0.0  # 0 = off
+
+
+@dataclass(frozen=True)
+class ScheduleConfig:
+    """LR schedule; stepped per-iteration (SURVEY.md §2 #9)."""
+
+    schedule: str = "exp_decay"  # exp_decay | cosine | constant
+    base_lr: float = 0.064  # scaled by total_batch/256 if scale_by_batch
+    scale_by_batch: bool = True
+    warmup_epochs: float = 5.0
+    # exp_decay: lr *= decay_rate every decay_epochs
+    decay_rate: float = 0.963
+    decay_epochs: float = 3.0
+    # cosine
+    final_lr_factor: float = 0.0
+
+
+@dataclass(frozen=True)
+class EMAConfig:
+    enable: bool = True
+    decay: float = 0.9999
+    # TF-style warmup: effective decay = min(decay, (1+t)/(10+t))
+    warmup: bool = True
+
+
+@dataclass(frozen=True)
+class PruneConfig:
+    """AtomNAS dynamic shrinkage (SURVEY.md §2 #11, §3.2)."""
+
+    enable: bool = False
+    # penalty weight on FLOPs-weighted BN-gamma L1
+    rho: float = 1.8e-4
+    # |gamma| below this is dead
+    gamma_threshold: float = 1e-3
+    # steps between in-jit mask refreshes
+    mask_interval: int = 500
+    # epochs between physical shape rematerializations (0 = never)
+    remat_epochs: float = 25.0
+    # stop pruning after this fraction of training (paper stops to stabilize)
+    stop_epoch_frac: float = 0.5
+    # optional FLOPs floor: stop masking when effective FLOPs reach target
+    target_flops: float = 0.0
+    # normalize per-channel flops cost by total network flops
+    normalize_cost: bool = True
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    epochs: float = 350.0
+    batch_size: int = 256  # GLOBAL batch size (split across data-parallel chips)
+    eval_batch_size: int = 250
+    seed: int = 0
+    compute_dtype: str = "bfloat16"  # matmul/conv compute dtype on TPU
+    log_every: int = 100
+    eval_every_epochs: float = 1.0
+    checkpoint_every_epochs: float = 1.0
+    max_checkpoints: int = 3
+    log_dir: str = "/tmp/yamt_logs"
+    resume: bool = True
+    test_only: bool = False
+    pretrained: str = ""  # checkpoint path for eval/finetune
+    # debug guards (SURVEY.md §5 race-detection analogue)
+    check_finite_every: int = 0  # 0 = off
+    param_checksum_every: int = 0  # cross-replica divergence check, 0 = off
+
+
+@dataclass(frozen=True)
+class DistConfig:
+    # number of data-parallel shards; 0 = use all visible devices
+    num_devices: int = 0
+    sync_bn: bool = True
+    # ZeRO-style cross-replica sharded weight update (PAPERS.md:5); optional.
+    shard_optimizer: bool = False
+
+
+@dataclass(frozen=True)
+class Config:
+    name: str = "experiment"
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    schedule: ScheduleConfig = field(default_factory=ScheduleConfig)
+    ema: EMAConfig = field(default_factory=EMAConfig)
+    prune: PruneConfig = field(default_factory=PruneConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    dist: DistConfig = field(default_factory=DistConfig)
+
+
+# ---------------------------------------------------------------------------
+# dict -> dataclass with strict key checking
+# ---------------------------------------------------------------------------
+
+def _build(dc_type, data: Mapping[str, Any], path: str = ""):
+    if data is None:
+        data = {}  # a YAML section header with every key commented out
+    if not isinstance(data, Mapping):
+        raise TypeError(f"config section '{path or dc_type.__name__}' must be a mapping, got {type(data).__name__}")
+    valid = {f.name: f for f in fields(dc_type)}
+    unknown = set(data) - set(valid)
+    if unknown:
+        raise KeyError(f"unknown config key(s) {sorted(unknown)} in section '{path or 'root'}'; valid: {sorted(valid)}")
+    kwargs = {}
+    for name, f in valid.items():
+        if name not in data:
+            continue
+        v = data[name]
+        sub = path + "." + name if path else name
+        # `from __future__ import annotations` makes f.type a string; section
+        # dataclasses are dispatched by name.
+        if isinstance(f.type, str) and f.type in _SECTION_TYPES:
+            kwargs[name] = _build(_SECTION_TYPES[f.type], v, sub)
+        else:
+            kwargs[name] = _coerce(f, v, sub)
+    return dc_type(**kwargs)
+
+
+_SECTION_TYPES = {
+    "ModelConfig": ModelConfig,
+    "DataConfig": DataConfig,
+    "OptimConfig": OptimConfig,
+    "ScheduleConfig": ScheduleConfig,
+    "EMAConfig": EMAConfig,
+    "PruneConfig": PruneConfig,
+    "TrainConfig": TrainConfig,
+    "DistConfig": DistConfig,
+    "Config": Config,
+}
+
+
+def _coerce(f, v, path):
+    # Best-effort scalar coercion so "lr=0.1" CLI overrides work. Optional
+    # fields ("X | None") accept None and coerce the non-None branch;
+    # None for a non-optional field is a parse-time error, not a latent crash.
+    t = f.type if isinstance(f.type, str) else getattr(f.type, "__name__", "")
+    optional = isinstance(t, str) and "None" in t
+    if optional:
+        t = t.replace("| None", "").replace("None |", "").strip()
+    if v is None:
+        if optional:
+            return None
+        raise TypeError(f"config key '{path}' is not optional; got null")
+    if t == "int" and not isinstance(v, bool):
+        return int(v)
+    if t == "float":
+        return float(v)
+    if t == "bool":
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    if t == "str":
+        return str(v)
+    if isinstance(v, list):
+        return tuple(v)
+    return v
+
+
+def config_from_dict(data: Mapping[str, Any]) -> Config:
+    return _build(Config, data)
+
+
+def config_to_dict(cfg) -> dict:
+    return dataclasses.asdict(cfg)
+
+
+# ---------------------------------------------------------------------------
+# CLI parsing: app:<path> + dotted overrides
+# ---------------------------------------------------------------------------
+
+
+def _parse_scalar(s: str):
+    if s == "":
+        return ""  # yaml.safe_load("") is None, but `key=` means empty string
+    try:
+        return yaml.safe_load(s)
+    except yaml.YAMLError:
+        return s
+
+
+def _set_dotted(d: dict, dotted: str, value) -> None:
+    keys = dotted.split(".")
+    cur = d
+    for k in keys[:-1]:
+        cur = cur.setdefault(k, {})
+        if not isinstance(cur, dict):
+            raise KeyError(f"override '{dotted}': '{k}' is not a section")
+    cur[keys[-1]] = value
+
+
+def parse_cli(argv: Sequence[str]) -> Config:
+    """Parse ``app:<yaml> [a.b=c ...]`` into a Config.
+
+    Mirrors the reference's ``train.py app:apps/x.yml`` convention
+    (SURVEY.md §1 L6) without the process-global FLAGS.
+    """
+    data: dict = {}
+    overrides: dict = {}
+    app_seen = False
+    for arg in argv:
+        if arg.startswith("app:"):
+            if app_seen:
+                raise ValueError("multiple app: arguments")
+            data = load_yaml(arg[4:])
+            app_seen = True
+        elif "=" in arg:
+            k, v = arg.split("=", 1)
+            _set_dotted(overrides, k, _parse_scalar(v))
+        else:
+            raise ValueError(f"unrecognized argument {arg!r} (expected app:<path> or key=value)")
+    # CLI overrides always win, regardless of their position relative to app:.
+    return config_from_dict(_deep_merge(data, overrides))
+
+
+def load_config(path: str) -> Config:
+    return config_from_dict(load_yaml(path))
