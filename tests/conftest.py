@@ -7,8 +7,21 @@ This is the TPU-world "fake backend" (SURVEY.md §4.2): multi-chip logic
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Force-set (not setdefault): the sandbox exports JAX_PLATFORMS for the real
+# TPU tunnel, but tests must be deterministic f32 CPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop any pre-set device-count flag and force 8 (a foreign value would make
+# the device-count assert below kill the whole session).
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split() if "xla_force_host_platform_device_count" not in f]
+os.environ["XLA_FLAGS"] = " ".join(flags + ["--xla_force_host_platform_device_count=8"])
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+
+# The sandbox's sitecustomize imports jax at interpreter startup (axon PJRT
+# registration), which freezes jax_platforms before this file runs — so the
+# env var alone is not enough; override the live config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.default_backend() == "cpu"
+assert len(jax.devices()) == 8, jax.devices()

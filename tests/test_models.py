@@ -1,0 +1,124 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yet_another_mobilenet_series_tpu.config import ModelConfig
+from yet_another_mobilenet_series_tpu.models import get_model, get_arch
+from yet_another_mobilenet_series_tpu.utils.profiling import masked_macs, profile_network
+
+
+# Golden tables from the public papers (SURVEY.md §4.1; BASELINE.md):
+# (params, macs) at width 1.0, 224x224. Tolerances are tight — the block
+# grammar is the top-1-parity contract (SURVEY.md §3.4).
+GOLDEN = {
+    "mobilenet_v1": (4.23e6, 569e6, 0.01),
+    "mobilenet_v2": (3.50e6, 300e6, 0.01),
+    "mobilenet_v3_large": (5.48e6, 217e6, 0.01),
+    "mobilenet_v3_small": (2.54e6, 56e6, 0.02),
+    "mnasnet_a1": (3.9e6, 312e6, 0.01),
+}
+
+
+@pytest.mark.parametrize("arch", sorted(GOLDEN))
+def test_golden_params_macs(arch):
+    params_ref, macs_ref, tol = GOLDEN[arch]
+    prof = profile_network(get_model(ModelConfig(arch=arch)))
+    assert abs(prof.total_params - params_ref) / params_ref < tol, prof.total_params
+    assert abs(prof.total_macs - macs_ref) / macs_ref < tol, prof.total_macs
+
+
+def test_profiler_matches_actual_param_count():
+    """Analytic profiler == number of weights actually initialized."""
+    for arch in ["mobilenet_v2", "mobilenet_v3_large", "atomnas_supernet_se"]:
+        net = get_model(ModelConfig(arch=arch))
+        params, _ = net.init(jax.random.PRNGKey(0))
+        n_actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert n_actual == profile_network(net).total_params, arch
+
+
+def test_width_mult_rounding():
+    # torchvision MBV2-0.75 has ~2.64M params; channel rounding must match.
+    prof = profile_network(get_model(ModelConfig(arch="mobilenet_v2", width_mult=0.75)))
+    assert abs(prof.total_params - 2.64e6) / 2.64e6 < 0.02
+    # head width must not shrink below 1280 at width<1 (MBV2 convention)
+    net = get_model(ModelConfig(arch="mobilenet_v2", width_mult=0.5))
+    assert net.head.out_channels == 1280
+
+
+@pytest.mark.parametrize("arch", ["mobilenet_v1", "mobilenet_v2", "mobilenet_v3_large", "mnasnet_a1", "atomnas_supernet"])
+def test_forward_shapes_and_state(arch):
+    net = get_model(ModelConfig(arch=arch, num_classes=10), image_size=64)
+    params, state = net.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    logits, new_state = net.apply(params, state, x, train=True, rng=jax.random.PRNGKey(2))
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32
+    # BN state must actually update in train mode
+    diff = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), state, new_state)
+    assert max(jax.tree.leaves(diff)) > 0
+    # eval mode leaves state untouched
+    _, eval_state = net.apply(params, state, x, train=False)
+    same = jax.tree.map(lambda a, b: bool((a == b).all()), state, eval_state)
+    assert all(jax.tree.leaves(same))
+
+
+def test_supernet_masks_change_output():
+    # Train mode: fresh-init running stats make eval-mode outputs decay to
+    # ~0 through 17 un-normalized blocks, so compare where BN normalizes.
+    net = get_model(ModelConfig(arch="atomnas_supernet", num_classes=4, dropout=0.0), image_size=32)
+    params, state = net.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    y0, _ = net.apply(params, state, x, train=True)
+    masks = {1: jnp.zeros(net.blocks[1].expanded_channels).at[:8].set(1.0)}
+    y1, _ = net.apply(params, state, x, train=True, masks=masks)
+    assert not np.allclose(np.asarray(y0), np.asarray(y1), atol=1e-4)
+
+
+def test_masked_macs_accounting():
+    net = get_model(ModelConfig(arch="atomnas_supernet"))
+    prof = profile_network(net)
+    full = masked_macs(net, {})
+    assert full == prof.total_macs
+    # kill all atoms of block 3 -> reduction equals that block's atom cost sum
+    e = net.blocks[3].expanded_channels
+    red = full - masked_macs(net, {3: np.zeros(e)})
+    assert abs(red - prof.atom_costs[3].sum()) < 1e-6
+    # supernet with everything alive costs more than plain MBV2 (k=5,7 atoms)
+    mbv2 = profile_network(get_model(ModelConfig(arch="mobilenet_v2"))).total_macs
+    assert full > mbv2
+
+
+def test_bad_arch_rejected():
+    with pytest.raises(ValueError):
+        get_arch("resnet50")
+
+
+def test_v1_is_separable_not_residual():
+    net = get_model(ModelConfig(arch="mobilenet_v1"))
+    assert all(not b.has_residual for b in net.blocks)
+    assert all(not b.has_expand for b in net.blocks)
+    assert all(b.project_act == "relu" for b in net.blocks)
+
+
+def test_v3_block_structure():
+    net = get_model(ModelConfig(arch="mobilenet_v3_large"))
+    b0 = net.blocks[0]
+    assert not b0.has_expand  # exp 16 == in 16
+    assert net.blocks[3].se_channels == 24  # make_divisible(72/4) = 24 (V3 table)
+    assert net.blocks[3].kernel_sizes == (5,)
+    assert net.head.out_channels == 960 and net.feature.out_features == 1280
+
+
+def test_custom_block_specs_override():
+    cfg = ModelConfig(
+        arch="mobilenet_v2",
+        block_specs=({"t": 4, "c": 24, "n": 2, "s": 2, "k": [3, 5]},),
+        num_classes=7,
+    )
+    net = get_model(cfg, image_size=32)
+    assert len(net.blocks) == 2
+    assert net.blocks[0].kernel_sizes == (3, 5)
+    params, state = net.init(jax.random.PRNGKey(0))
+    logits, _ = net.apply(params, state, jnp.zeros((1, 32, 32, 3)), train=False)
+    assert logits.shape == (1, 7)

@@ -1,0 +1,194 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu import ops
+
+
+def test_activation_values():
+    x = jnp.array([-4.0, -3.0, -1.0, 0.0, 1.0, 3.0, 10.0])
+    np.testing.assert_allclose(ops.relu6(x), np.clip(x, 0, 6))
+    # h-swish = x*relu6(x+3)/6 (MobileNetV3 paper exact form)
+    np.testing.assert_allclose(ops.hswish(x), x * np.clip(x + 3, 0, 6) / 6, rtol=1e-5)
+    np.testing.assert_allclose(ops.hsigmoid(x), np.clip(x + 3, 0, 6) / 6, rtol=1e-5)
+    np.testing.assert_allclose(ops.swish(x), x / (1 + np.exp(-x)), rtol=1e-5)
+    assert ops.hswish(jnp.array(-3.0)) == 0.0
+    assert ops.hswish(jnp.array(10.0)) == 10.0
+    with pytest.raises(ValueError):
+        ops.get_activation("nope")
+
+
+def test_make_divisible():
+    # Reference semantics: round to nearest multiple of 8, never below 90%.
+    assert ops.make_divisible(32) == 32
+    assert ops.make_divisible(32 * 0.75) == 24
+    assert ops.make_divisible(33) == 32
+    assert ops.make_divisible(39) == 40
+    assert ops.make_divisible(91) == 88  # 88 >= 0.9*91
+    assert ops.make_divisible(8 * 0.35) == 8  # min_value clamp
+    assert ops.make_divisible(16, divisor=8, min_value=16) == 16
+
+
+def _torch_conv(x_nhwc, w_hwio, stride, groups, pad):
+    import torch
+    import torch.nn.functional as F
+
+    xt = torch.from_numpy(np.asarray(x_nhwc).transpose(0, 3, 1, 2)).double()
+    # HWIO -> OIHW
+    wt = torch.from_numpy(np.asarray(w_hwio).transpose(3, 2, 0, 1)).double()
+    y = F.conv2d(xt, wt, stride=stride, padding=pad, groups=groups)
+    return y.numpy().transpose(0, 2, 3, 1)
+
+
+@pytest.mark.parametrize("cin,cout,k,stride,groups", [
+    (8, 16, 3, 1, 1),
+    (8, 16, 1, 1, 1),
+    (16, 16, 3, 2, 16),   # depthwise stride 2
+    (16, 16, 5, 1, 16),   # depthwise k=5
+    (12, 24, 7, 2, 1),
+])
+def test_conv2d_matches_torch(cin, cout, k, stride, groups):
+    torch = pytest.importorskip("torch")  # noqa: F841
+    key = jax.random.PRNGKey(0)
+    spec = ops.Conv2D(cin, cout, k, stride, groups)
+    params = spec.init(key)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 13, 13, cin))
+    y = spec.apply(params, x)
+    y_ref = _torch_conv(x, params["w"], stride, groups, k // 2)
+    np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_matches_torch_train_and_eval():
+    import torch
+
+    c = 6
+    spec = ops.BatchNorm(c, momentum=0.1, eps=1e-5)
+    params, state = spec.init()
+    # random gamma/beta to make the test non-trivial
+    params["gamma"] = jnp.asarray(np.random.RandomState(0).uniform(0.5, 1.5, c).astype(np.float32))
+    params["beta"] = jnp.asarray(np.random.RandomState(1).uniform(-0.5, 0.5, c).astype(np.float32))
+    x = np.random.RandomState(2).normal(size=(4, 5, 5, c)).astype(np.float32)
+
+    bn = torch.nn.BatchNorm2d(c, momentum=0.1, eps=1e-5)
+    bn.weight.data = torch.from_numpy(np.asarray(params["gamma"]))
+    bn.bias.data = torch.from_numpy(np.asarray(params["beta"]))
+    xt = torch.from_numpy(x.transpose(0, 3, 1, 2))
+
+    # train step: normalized output + running-stat update semantics
+    y, new_state = spec.apply(params, state, jnp.asarray(x), train=True)
+    bn.train()
+    yt = bn(xt).detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y), yt, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["mean"]), bn.running_mean.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_state["var"]), bn.running_var.numpy(), rtol=1e-5, atol=1e-6)
+
+    # eval uses running stats
+    y_eval, same_state = spec.apply(params, new_state, jnp.asarray(x), train=False)
+    bn.eval()
+    yt_eval = bn(xt).detach().numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y_eval), yt_eval, rtol=1e-4, atol=1e-5)
+    assert same_state is new_state
+
+
+def test_syncbn_equals_full_batch_bn():
+    """psum-of-moments SyncBN over 8 shards == BN over the unsharded batch
+    (SURVEY.md §4.2). This is the apex-SyncBatchNorm parity contract."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    c = 4
+    spec = ops.BatchNorm(c)
+    params, state = spec.init()
+    x = jax.random.normal(jax.random.PRNGKey(0), (16, 3, 3, c))
+
+    y_ref, st_ref = spec.apply(params, state, x, train=True)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    def shard_fn(p, s, xx):
+        return spec.apply(p, s, xx, train=True, axis_name="data")
+
+    y, st = jax.jit(
+        shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P(), P(), P("data")),
+            out_specs=(P("data"), P()),
+        )
+    )(params, state, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(st["mean"]), np.asarray(st_ref["mean"]), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(st["var"]), np.asarray(st_ref["var"]), rtol=1e-5, atol=1e-6)
+
+
+def test_inverted_residual_shapes_and_residual():
+    spec = ops.InvertedResidual(
+        in_channels=16, out_channels=16, expanded_channels=48, stride=1,
+        kernel_sizes=(3, 5, 7), group_channels=(16, 16, 16), active_fn="hswish", se_channels=12,
+    )
+    params, state = spec.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 16))
+    y, new_state = spec.apply(params, state, x, train=True)
+    assert y.shape == (2, 8, 8, 16)
+    assert spec.has_residual
+    # stride-2 block: no residual, spatial halved
+    spec2 = ops.InvertedResidual(16, 24, 96, stride=2, kernel_sizes=(3,))
+    p2, s2 = spec2.init(jax.random.PRNGKey(2))
+    y2, _ = spec2.apply(p2, s2, x, train=False)
+    assert y2.shape == (2, 4, 4, 24)
+    assert not spec2.has_residual
+
+
+def test_inverted_residual_no_expand_when_t1():
+    spec = ops.InvertedResidual(16, 16, 16, stride=1, kernel_sizes=(3,))
+    params, _ = spec.init(jax.random.PRNGKey(0))
+    assert "expand" not in params and not spec.has_expand
+
+
+def test_inverted_residual_validation():
+    with pytest.raises(ValueError):
+        ops.InvertedResidual(16, 16, 48, kernel_sizes=(3, 5), group_channels=(16,))
+    with pytest.raises(ValueError):
+        ops.InvertedResidual(16, 16, 48, kernel_sizes=(3, 5), group_channels=(40, 9))
+
+
+def test_mask_zeroes_atoms_exact_equivalence():
+    """Masked supernet forward == physically shrunk net forward (the central
+    AtomNAS-on-XLA claim, SURVEY.md §7 hard part 1). Includes SE to prove the
+    zero channels are invisible to the squeeze FCs."""
+    full = ops.InvertedResidual(8, 8, 24, stride=1, kernel_sizes=(3, 5), group_channels=(12, 12), se_channels=6)
+    params, state = full.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 6, 8))
+
+    # kill channels 3..11 of branch0 and 0..5 of branch1 -> keep (3, 6)
+    keep0 = np.arange(0, 3)
+    keep1 = np.arange(12 + 6, 24)
+    keep = np.concatenate([keep0, keep1])
+    mask = np.zeros(24, np.float32)
+    mask[keep] = 1.0
+
+    y_masked, _ = full.apply(params, state, x, train=False, mask=jnp.asarray(mask))
+
+    shrunk = ops.InvertedResidual(8, 8, 9, stride=1, kernel_sizes=(3, 5), group_channels=(3, 6), se_channels=6)
+    sp = {
+        "expand": {"w": params["expand"]["w"][..., keep]},
+        "expand_bn": {k: v[keep] for k, v in params["expand_bn"].items()},
+        "dw0_k3": {"w": params["dw0_k3"]["w"][..., keep0]},
+        "dw1_k5": {"w": params["dw1_k5"]["w"][..., keep1 - 12]},
+        "dw_bn": {k: v[keep] for k, v in params["dw_bn"].items()},
+        "se": {
+            "reduce": {"w": params["se"]["reduce"]["w"][keep, :], "b": params["se"]["reduce"]["b"]},
+            "expand": {"w": params["se"]["expand"]["w"][:, keep], "b": params["se"]["expand"]["b"][keep]},
+        },
+        "project": {"w": params["project"]["w"][..., keep, :]},
+        "project_bn": params["project_bn"],
+    }
+    ss = {
+        "expand_bn": {k: v[keep] for k, v in state["expand_bn"].items()},
+        "dw_bn": {k: v[keep] for k, v in state["dw_bn"].items()},
+        "project_bn": state["project_bn"],
+    }
+    y_shrunk, _ = shrunk.apply(sp, ss, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_masked), np.asarray(y_shrunk), rtol=1e-5, atol=1e-5)

@@ -1,0 +1,175 @@
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.config import Config, EMAConfig, OptimConfig, ScheduleConfig, config_from_dict
+from yet_another_mobilenet_series_tpu.train import ema as ema_lib
+from yet_another_mobilenet_series_tpu.train import losses, optim, schedules, steps
+from yet_another_mobilenet_series_tpu.models import get_model
+
+
+def test_label_smoothing_matches_torch():
+    import torch
+
+    logits = np.random.RandomState(0).normal(size=(8, 10)).astype(np.float32)
+    labels = np.random.RandomState(1).randint(0, 10, size=(8,))
+    ours = losses.cross_entropy_label_smooth(jnp.asarray(logits), jnp.asarray(labels), 0.1)
+    ref = torch.nn.functional.cross_entropy(torch.from_numpy(logits), torch.from_numpy(labels), label_smoothing=0.1)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    # smoothing=0 degenerates to plain CE
+    ours0 = losses.cross_entropy_label_smooth(jnp.asarray(logits), jnp.asarray(labels), 0.0)
+    ref0 = torch.nn.functional.cross_entropy(torch.from_numpy(logits), torch.from_numpy(labels))
+    np.testing.assert_allclose(float(ours0), float(ref0), rtol=1e-5)
+
+
+def test_topk_correct():
+    logits = jnp.asarray([[0.1, 0.9, 0.0, 0.0], [0.9, 0.1, 0.0, 0.0], [0.0, 0.1, 0.2, 0.7]])
+    labels = jnp.asarray([1, 1, 0])
+    out = losses.topk_correct(logits, labels, ks=(1, 3))
+    assert float(out["top1"]) == 1.0  # only first row top-1 correct
+    assert float(out["top3"]) == 2.0  # row2 label 0 is rank 3 (out of top-3... rank within top3)
+
+
+def test_lr_exp_decay_staircase():
+    cfg = ScheduleConfig(schedule="exp_decay", base_lr=0.1, scale_by_batch=False, warmup_epochs=2.0, decay_rate=0.9, decay_epochs=1.0)
+    lr = schedules.make_lr_schedule(cfg, total_batch=256, steps_per_epoch=10, total_epochs=10)
+    assert float(lr(0)) == 0.0
+    np.testing.assert_allclose(float(lr(10)), 0.05, rtol=1e-6)  # mid-warmup (20 steps)
+    np.testing.assert_allclose(float(lr(20)), 0.1, rtol=1e-6)  # warmup done
+    np.testing.assert_allclose(float(lr(29)), 0.1, rtol=1e-6)  # staircase holds
+    np.testing.assert_allclose(float(lr(30)), 0.09, rtol=1e-6)  # first decay
+    np.testing.assert_allclose(float(lr(50)), 0.1 * 0.9**3, rtol=1e-6)
+
+
+def test_lr_cosine_endpoints():
+    cfg = ScheduleConfig(schedule="cosine", base_lr=0.2, scale_by_batch=False, warmup_epochs=0.0, final_lr_factor=0.0)
+    lr = schedules.make_lr_schedule(cfg, total_batch=256, steps_per_epoch=100, total_epochs=10)
+    np.testing.assert_allclose(float(lr(0)), 0.2, rtol=1e-6)
+    np.testing.assert_allclose(float(lr(500)), 0.1, rtol=1e-5)
+    assert float(lr(1000)) < 1e-8
+
+
+def test_lr_batch_scaling():
+    cfg = ScheduleConfig(schedule="constant", base_lr=0.064, scale_by_batch=True, warmup_epochs=0.0)
+    lr = schedules.make_lr_schedule(cfg, total_batch=1024, steps_per_epoch=10, total_epochs=1)
+    np.testing.assert_allclose(float(lr(5)), 0.064 * 4, rtol=1e-6)
+
+
+def test_ema_algebra_and_warmup():
+    cfg = EMAConfig(enable=True, decay=0.5, warmup=False)
+    shadow = {"w": jnp.asarray(1.0)}
+    val = {"w": jnp.asarray(3.0)}
+    out = ema_lib.ema_update(cfg, shadow, val, step=0)
+    np.testing.assert_allclose(float(out["w"]), 0.5 * 1 + 0.5 * 3)
+    # warmup: at step 0 effective decay = min(0.9999, 1/10) = 0.1
+    cfgw = EMAConfig(enable=True, decay=0.9999, warmup=True)
+    outw = ema_lib.ema_update(cfgw, shadow, val, step=0)
+    np.testing.assert_allclose(float(outw["w"]), 0.1 * 1 + 0.9 * 3, rtol=1e-6)
+
+
+def test_wd_mask_exemptions():
+    cfg = OptimConfig(wd_skip_bn=True, wd_skip_bias=True, wd_skip_depthwise=True)
+    params = {
+        "stem": {"conv": {"w": 0}, "bn": {"gamma": 0, "beta": 0}},
+        "blocks": {"0": {"dw0_k3": {"w": 0}, "dw_bn": {"gamma": 0, "beta": 0}, "project": {"w": 0}}},
+        "classifier": {"w": 0, "b": 0},
+    }
+    m = optim.wd_mask(params, cfg)
+    assert m["stem"]["conv"]["w"] is True
+    assert m["stem"]["bn"]["gamma"] is False
+    assert m["blocks"]["0"]["dw0_k3"]["w"] is False  # depthwise exempt
+    assert m["blocks"]["0"]["dw_bn"]["gamma"] is False
+    assert m["blocks"]["0"]["project"]["w"] is True
+    assert m["classifier"]["w"] is True and m["classifier"]["b"] is False
+    # depthwise decayed when flag off
+    m2 = optim.wd_mask(params, OptimConfig(wd_skip_depthwise=False))
+    assert m2["blocks"]["0"]["dw0_k3"]["w"] is True
+
+
+def test_rmsprop_tf_semantics_one_step():
+    """Manual check: nu0=1 (TF initial_scale), eps inside sqrt, momentum after."""
+    cfg = OptimConfig(optimizer="rmsprop", momentum=0.9, rmsprop_decay=0.9, rmsprop_eps=0.01, weight_decay=0.0)
+    params = {"w": jnp.asarray(2.0)}
+    opt = optim.make_optimizer(cfg, lambda s: 0.1, params)
+    st = opt.init(params)
+    g = {"w": jnp.asarray(0.5)}
+    upd, _ = opt.update(g, st, params)
+    nu = 0.9 * 1.0 + 0.1 * 0.5**2
+    rms = 0.5 / np.sqrt(nu + 0.01)
+    mom = 0.9 * 0.0 + rms
+    np.testing.assert_allclose(float(upd["w"]), -0.1 * mom, rtol=1e-5)
+
+
+def test_weight_decay_coupled_before_rms():
+    cfg = OptimConfig(optimizer="sgd", momentum=0.0, weight_decay=0.1)
+    params = {"conv": {"w": jnp.asarray(2.0)}}
+    opt = optim.make_optimizer(cfg, lambda s: 1.0, params)
+    st = opt.init(params)
+    upd, _ = opt.update({"conv": {"w": jnp.asarray(0.0)}}, st, params)
+    # pure decay: grad 0 + wd*param = 0.2
+    np.testing.assert_allclose(float(upd["conv"]["w"]), -0.2, rtol=1e-6)
+
+
+def _tiny_cfg(**over):
+    d = {
+        "model": {
+            "arch": "mobilenet_v2",
+            "num_classes": 4,
+            "dropout": 0.0,
+            "block_specs": [
+                {"t": 2, "c": 8, "n": 1, "s": 2},
+                {"t": 2, "c": 16, "n": 1, "s": 2, "k": [3, 5]},
+            ],
+        },
+        "optim": {"optimizer": "rmsprop", "weight_decay": 1e-5},
+        "schedule": {"schedule": "constant", "base_lr": 0.05, "scale_by_batch": False, "warmup_epochs": 0.0},
+        "ema": {"enable": True, "decay": 0.9, "warmup": False},
+        "train": {"compute_dtype": "float32"},
+    }
+    d.update(over)
+    return config_from_dict(d)
+
+
+def test_train_step_overfits_tiny_batch():
+    cfg = _tiny_cfg()
+    net = get_model(cfg.model, image_size=16)
+    lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    opt = optim.make_optimizer(cfg.optim, lr_fn, params)
+    ts = steps.init_train_state(net, cfg, opt, jax.random.PRNGKey(0))
+    step_fn = jax.jit(steps.make_train_step(net, cfg, opt, lr_fn))
+
+    rng = jax.random.PRNGKey(42)
+    batch = {
+        "image": jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3)),
+        "label": jnp.arange(8) % 4,
+    }
+    first = None
+    for i in range(30):
+        ts, metrics = step_fn(ts, batch, rng)
+        if first is None:
+            first = float(metrics["loss"])
+    assert int(ts.step) == 30
+    assert float(metrics["finite"]) == 1.0
+    assert float(metrics["loss"]) < first * 0.7, (first, float(metrics["loss"]))
+    # EMA shadow differs from raw params but has same structure
+    assert jax.tree.structure(ts.ema_params) == jax.tree.structure(ts.params)
+    diffs = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()), ts.ema_params, ts.params)
+    assert max(jax.tree.leaves(diffs)) > 0
+
+
+def test_eval_step_counts_and_padding():
+    cfg = _tiny_cfg()
+    net = get_model(cfg.model, image_size=16)
+    eval_fn = jax.jit(steps.make_eval_step(net, cfg))
+    params, state = net.init(jax.random.PRNGKey(0))
+    batch = {
+        "image": jax.random.normal(jax.random.PRNGKey(1), (6, 16, 16, 3)),
+        "label": jnp.asarray([0, 1, 2, 3, -1, -1]),  # 2 padded
+    }
+    m = eval_fn(params, state, batch, {})
+    assert float(m["n"]) == 4.0
+    assert 0 <= float(m["top1"]) <= float(m["top5"]) <= 4.0
+    assert np.isfinite(float(m["loss_sum"]))

@@ -82,8 +82,9 @@ class ModelConfig:
     feature_channels: int | None = None
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
-    # Global default activation for blocks that don't specify one.
-    active_fn: str = "relu6"
+    # Overrides the arch's default activation when set (e.g. swish for the
+    # AtomNAS "+" variants); None = keep the arch's own default.
+    active_fn: str | None = None
     # If true, classifier bias is zero-initialized (standard).
     dtype: str = "float32"  # param dtype; compute may be bf16 (train.compute_dtype)
 
@@ -272,7 +273,11 @@ def _coerce(f, v, path):
         if optional:
             return None
         raise TypeError(f"config key '{path}' is not optional; got null")
-    if t == "int" and not isinstance(v, bool):
+    if isinstance(v, Mapping):
+        raise TypeError(f"config key '{path}' is a scalar, not a section; got mapping {dict(v)!r}")
+    if t == "int":
+        if isinstance(v, bool):
+            raise TypeError(f"config key '{path}' expects an int; got bool {v}")
         return int(v)
     if t == "float":
         return float(v)

@@ -1,0 +1,39 @@
+"""Model zoo + constructor (reference: models/get_model, SURVEY.md §2 #4)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..config import ModelConfig
+from .specs import ArchDef, Network, build_network
+from .zoo import ARCHS, get_arch
+
+__all__ = ["ArchDef", "Network", "build_network", "get_arch", "get_model", "ARCHS"]
+
+
+def get_model(cfg: ModelConfig, image_size: int = 224) -> Network:
+    """Resolve a ModelConfig into a concrete Network spec."""
+    arch = get_arch(cfg.arch)
+    overrides = {}
+    if cfg.stem_channels is not None:
+        overrides["stem_channels"] = cfg.stem_channels
+    if cfg.head_channels is not None:
+        overrides["head_channels"] = cfg.head_channels
+    if cfg.feature_channels is not None:
+        overrides["feature_channels"] = cfg.feature_channels
+    if cfg.active_fn is not None:
+        overrides.update(
+            stem_act=cfg.active_fn, head_act=cfg.active_fn, default_act=cfg.active_fn
+        )
+    if overrides:
+        arch = dataclasses.replace(arch, **overrides)
+    return build_network(
+        arch,
+        width_mult=cfg.width_mult,
+        num_classes=cfg.num_classes,
+        dropout=cfg.dropout,
+        bn_momentum=cfg.bn_momentum,
+        bn_eps=cfg.bn_eps,
+        image_size=image_size,
+        block_specs_override=cfg.block_specs,
+    )

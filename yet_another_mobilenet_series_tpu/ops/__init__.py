@@ -1,0 +1,20 @@
+"""Functional NN core: activations, primitive layers, composite blocks."""
+
+from .activations import get_activation, hsigmoid, hswish, relu, relu6, sigmoid, swish
+from .blocks import ConvBNAct, InvertedResidual, SqueezeExcite
+from .layers import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    dropout,
+    global_avg_pool,
+    kaiming_normal_fan_out,
+    make_divisible,
+)
+
+__all__ = [
+    "get_activation", "hswish", "hsigmoid", "relu", "relu6", "sigmoid", "swish",
+    "ConvBNAct", "InvertedResidual", "SqueezeExcite",
+    "BatchNorm", "Conv2D", "Dense", "dropout", "global_avg_pool",
+    "kaiming_normal_fan_out", "make_divisible",
+]

@@ -1,0 +1,62 @@
+"""Activation zoo (reference: mobilenet_base.get_active_fn, SURVEY.md §2 #3).
+
+All piecewise-linear forms are written exactly as the MobileNetV3 paper
+defines them (h-swish = x*relu6(x+3)/6) so top-1 parity is not lost to
+activation drift (SURVEY.md §7 hard part 2). XLA fuses these into the
+surrounding conv epilogues; no Pallas needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def hsigmoid(x):
+    return relu6(x + 3.0) * (1.0 / 6.0)
+
+
+def hswish(x):
+    return x * relu6(x + 3.0) * (1.0 / 6.0)
+
+
+def sigmoid(x):
+    return jnp.reciprocal(1.0 + jnp.exp(-x))
+
+
+def swish(x):
+    # a.k.a. SiLU; used by the AtomNAS "+" variants (SURVEY.md §6)
+    return x * sigmoid(x)
+
+
+def identity(x):
+    return x
+
+
+_ACTIVATIONS = {
+    "relu": relu,
+    "relu6": relu6,
+    "hswish": hswish,
+    "h_swish": hswish,
+    "hsigmoid": hsigmoid,
+    "h_sigmoid": hsigmoid,
+    "swish": swish,
+    "silu": swish,
+    "sigmoid": sigmoid,
+    "identity": identity,
+    "linear": identity,
+}
+
+
+def get_activation(name: str):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}") from None

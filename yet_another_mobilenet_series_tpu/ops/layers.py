@@ -1,0 +1,229 @@
+"""Pure-functional NN primitives: conv / batchnorm / dense / pooling.
+
+Design (SURVEY.md §7 "design stance"): layers are *static specs* — frozen
+dataclasses holding only hashable configuration — with ``init(key)`` returning
+parameter/state pytrees (plain nested dicts) and ``apply(params, state, x, ...)``
+as a pure function. No module objects, no global state; specs are safe to
+close over in ``jit``/``shard_map``.
+
+Conventions:
+- NHWC activations, HWIO conv kernels (XLA/TPU-native layouts; channels last
+  keeps the lane dimension dense on the VPU/MXU).
+- Explicit symmetric padding k//2 matches the reference lineage's
+  ``torch.nn.Conv2d(padding=k//2)`` (NOT TF 'SAME', which pads asymmetrically
+  at stride 2 — a known top-1 parity hazard, SURVEY.md §7 hard part 2).
+- Params are float32; matmul/conv compute may run in bfloat16 via
+  ``compute_dtype`` while BN statistics stay float32.
+- SyncBN: pass ``axis_name`` during training to psum batch moments across the
+  data mesh axis — the apex SyncBatchNorm replacement (SURVEY.md §2 #12).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Initializers (torch-default-compatible: kaiming fan_out for convs, SURVEY.md §7)
+# ---------------------------------------------------------------------------
+
+
+def kaiming_normal_fan_out(key, shape, dtype=jnp.float32):
+    """He-normal with fan_out = kh*kw*out_ch (torch's init for conv weights).
+
+    For grouped/depthwise kernels (HWIO with I = in/groups) fan_out is still
+    kh*kw*O per torch semantics.
+    """
+    kh, kw, _, o = shape
+    fan_out = kh * kw * o
+    std = math.sqrt(2.0 / fan_out)
+    return jax.random.normal(key, shape, dtype) * jnp.asarray(std, dtype)
+
+
+def normal_init(std):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.normal(key, shape, dtype) * jnp.asarray(std, dtype)
+
+    return init
+
+
+# ---------------------------------------------------------------------------
+# Conv2D
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Conv2D:
+    """2-D convolution spec. groups=in_channels gives a depthwise conv, which
+    XLA lowers via ``feature_group_count`` (the cuDNN-depthwise replacement,
+    SURVEY.md §2 native table)."""
+
+    in_channels: int
+    out_channels: int
+    kernel_size: int = 1
+    stride: int = 1
+    groups: int = 1
+    use_bias: bool = False
+
+    def __post_init__(self):
+        if self.in_channels % self.groups or self.out_channels % self.groups:
+            raise ValueError(f"channels ({self.in_channels}->{self.out_channels}) not divisible by groups={self.groups}")
+
+    def init(self, key) -> dict:
+        k = self.kernel_size
+        shape = (k, k, self.in_channels // self.groups, self.out_channels)
+        params = {"w": kaiming_normal_fan_out(key, shape)}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_channels,), jnp.float32)
+        return params
+
+    def apply(self, params: dict, x: Array, *, compute_dtype=jnp.float32) -> Array:
+        w = params["w"].astype(compute_dtype)
+        x = x.astype(compute_dtype)
+        pad = self.kernel_size // 2
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(self.stride, self.stride),
+            padding=((pad, pad), (pad, pad)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        )
+        if self.use_bias:
+            y = y + params["b"].astype(compute_dtype)
+        return y
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm (with cross-replica sync)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BatchNorm:
+    """BatchNorm over N,H,W with torch semantics:
+
+    - normalization uses biased batch variance,
+    - running stats update ``running = (1-m)*running + m*batch`` with
+      momentum m (torch default 0.1) and *unbiased* batch variance,
+    - when ``axis_name`` is given in training, batch moments are allreduced
+      with ``lax.psum`` so statistics are exact global mean/var across
+      replicas — matching apex SyncBatchNorm's two-pass moments
+      (SURVEY.md §7 hard part 3).
+
+    The scale vector ``gamma`` is the AtomNAS prune handle (SURVEY.md §3.2).
+    """
+
+    num_features: int
+    momentum: float = 0.1
+    eps: float = 1e-5
+
+    def init(self, key=None) -> tuple[dict, dict]:
+        params = {
+            "gamma": jnp.ones((self.num_features,), jnp.float32),
+            "beta": jnp.zeros((self.num_features,), jnp.float32),
+        }
+        state = {
+            "mean": jnp.zeros((self.num_features,), jnp.float32),
+            "var": jnp.ones((self.num_features,), jnp.float32),
+        }
+        return params, state
+
+    def apply(
+        self,
+        params: dict,
+        state: dict,
+        x: Array,
+        *,
+        train: bool,
+        axis_name: str | None = None,
+    ) -> tuple[Array, dict]:
+        out_dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        if train:
+            # Per-device sums; psum across replicas makes them global (SyncBN).
+            n_local = xf.shape[0] * xf.shape[1] * xf.shape[2]
+            s1 = jnp.sum(xf, axis=(0, 1, 2))
+            s2 = jnp.sum(jnp.square(xf), axis=(0, 1, 2))
+            n = jnp.asarray(n_local, jnp.float32)
+            if axis_name is not None:
+                s1 = lax.psum(s1, axis_name)
+                s2 = lax.psum(s2, axis_name)
+                n = lax.psum(n, axis_name)
+            mean = s1 / n
+            var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)  # biased
+            m = self.momentum
+            unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+            new_state = {
+                "mean": (1.0 - m) * state["mean"] + m * mean,
+                "var": (1.0 - m) * state["var"] + m * unbiased,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps) * params["gamma"]
+        y = (xf - mean) * inv + params["beta"]
+        return y.astype(out_dtype), new_state
+
+
+# ---------------------------------------------------------------------------
+# Dense
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Dense:
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    init_std: float = 0.01  # reference lineage: classifier ~ N(0, 0.01)
+
+    def init(self, key) -> dict:
+        w = normal_init(self.init_std)(key, (self.in_features, self.out_features))
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_features,), jnp.float32)
+        return params
+
+    def apply(self, params: dict, x: Array, *, compute_dtype=jnp.float32) -> Array:
+        y = x.astype(compute_dtype) @ params["w"].astype(compute_dtype)
+        if self.use_bias:
+            y = y + params["b"].astype(compute_dtype)
+        return y
+
+
+# ---------------------------------------------------------------------------
+# Stateless helpers
+# ---------------------------------------------------------------------------
+
+
+def global_avg_pool(x: Array, keepdims: bool = False) -> Array:
+    """Mean over H,W. Computed in float32 (bf16 accumulation over 49+ terms
+    loses precision that measurably hurts SE gates and the head)."""
+    return jnp.mean(x.astype(jnp.float32), axis=(1, 2), keepdims=keepdims).astype(x.dtype)
+
+
+def dropout(rng, x: Array, rate: float, train: bool) -> Array:
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def make_divisible(v: float, divisor: int = 8, min_value: int | None = None) -> int:
+    """Channel rounding used throughout the MobileNet family (reference:
+    mobilenet_base.make_divisible). Never rounds down by more than 10%."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v

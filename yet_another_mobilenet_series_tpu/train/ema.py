@@ -1,0 +1,27 @@
+"""Exponential moving average of params + BN stats (reference:
+ExponentialMovingAverage in utils/optim.py, SURVEY.md §2 #8).
+
+Shadow = decay * shadow + (1-decay) * value, maintained *inside* the jitted
+train step; eval runs on the shadow copy. With ``warmup`` the effective decay
+is min(decay, (1+t)/(10+t)) — the TF convention that stops early steps from
+being dominated by random init.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import EMAConfig
+
+
+def ema_update(cfg: EMAConfig, shadow, value, step):
+    """One EMA step. ``shadow``/``value`` are matching pytrees (params and BN
+    state are both tracked, like the reference's param+buffer EMA)."""
+    if not cfg.enable:
+        return shadow
+    decay = jnp.asarray(cfg.decay, jnp.float32)
+    if cfg.warmup:
+        t = jnp.asarray(step, jnp.float32)
+        decay = jnp.minimum(decay, (1.0 + t) / (10.0 + t))
+    return jax.tree.map(lambda s, v: s * decay + (1.0 - decay) * v.astype(s.dtype), shadow, value)

@@ -1,0 +1,35 @@
+"""Losses (reference: CrossEntropyLabelSmooth in utils/optim.py, SURVEY.md §2 #7)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_label_smooth(logits: jax.Array, labels: jax.Array, smoothing: float = 0.1) -> jax.Array:
+    """Mean label-smoothed cross entropy.
+
+    Exact reference formula: target = (1-eps)*onehot + eps/K, loss =
+    -sum(target * log_softmax(logits)). Computed in float32.
+    """
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    smooth = -jnp.mean(logp, axis=-1)
+    return jnp.mean((1.0 - smoothing) * nll + smoothing * smooth)
+
+
+def topk_correct(logits: jax.Array, labels: jax.Array, ks=(1, 5)) -> dict[str, jax.Array]:
+    """Counts of top-k correct predictions (summable across batches/replicas —
+    the AverageMeter allreduce pattern, SURVEY.md §2 #13)."""
+    out = {}
+    labels = labels.astype(jnp.int32)
+    max_k = max(ks)
+    if max_k > logits.shape[-1]:
+        raise ValueError(f"top-{max_k} with only {logits.shape[-1]} classes")
+    _, pred = jax.lax.top_k(logits, max_k)  # (N, max_k)
+    hit = pred == labels[:, None]
+    for k in ks:
+        out[f"top{k}"] = jnp.sum(hit[:, :k]).astype(jnp.float32)
+    return out

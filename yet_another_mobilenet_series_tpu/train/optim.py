@@ -1,0 +1,68 @@
+"""Optimizer construction (reference: utils/optim.py get_optimizer,
+SURVEY.md §2 #7).
+
+Reproduced semantics:
+- TF-style RMSProp: accumulator initialized to 1.0, eps *inside* the sqrt,
+  heavy-ball momentum applied after the RMS normalization — the combination
+  the MNAS/MobileNet recipes assume (SURVEY.md §7 hard part 2).
+- Coupled L2 weight decay added to the *gradient* before the optimizer
+  transform (torch ``weight_decay=`` semantics, not AdamW-decoupled).
+- Per-parameter weight-decay exemptions: BN gamma/beta and biases (and
+  optionally depthwise kernels) get no decay.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import optax
+
+from ..config import OptimConfig
+
+
+def wd_mask(params, cfg: OptimConfig):
+    """True = apply weight decay. Walks the param tree by key names:
+    BN params live under '*_bn'/'bn' subtrees with leaves gamma/beta; biases
+    are leaves named 'b'; depthwise kernels live under 'dw*' subtrees."""
+
+    def mask_tree(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: mask_tree(v, path + (k,)) for k, v in tree.items()}
+        leaf_name = path[-1] if path else ""
+        in_bn = any(p == "bn" or p.endswith("_bn") for p in path)
+        in_dw = any(p.startswith("dw") and not p.endswith("_bn") for p in path)
+        if cfg.wd_skip_bn and (in_bn or leaf_name in ("gamma", "beta")):
+            return False
+        if cfg.wd_skip_bias and leaf_name == "b":
+            return False
+        if cfg.wd_skip_depthwise and in_dw:
+            return False
+        return True
+
+    return mask_tree(params)
+
+
+def make_optimizer(cfg: OptimConfig, lr_fn: Callable, params_example) -> optax.GradientTransformation:
+    txs = []
+    if cfg.grad_clip_norm > 0:
+        txs.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.weight_decay > 0:
+        mask = wd_mask(params_example, cfg)
+        txs.append(optax.add_decayed_weights(cfg.weight_decay, mask=lambda p: mask))
+    if cfg.optimizer == "rmsprop":
+        # TF-style: nu0=1, update = g / sqrt(nu + eps); then momentum.
+        txs.append(optax.scale_by_rms(decay=cfg.rmsprop_decay, eps=cfg.rmsprop_eps, initial_scale=1.0))
+        if cfg.momentum > 0:
+            txs.append(optax.trace(decay=cfg.momentum, nesterov=False))
+    elif cfg.optimizer == "sgd":
+        if cfg.momentum > 0:
+            txs.append(optax.trace(decay=cfg.momentum, nesterov=False))
+    elif cfg.optimizer == "adamw":
+        # decoupled variant kept for experimentation; wd handled above stays
+        # coupled unless weight_decay==0 here.
+        txs.append(optax.scale_by_adam())
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    txs.append(optax.scale_by_learning_rate(lr_fn))
+    return optax.chain(*txs)

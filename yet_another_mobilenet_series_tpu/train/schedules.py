@@ -1,0 +1,48 @@
+"""LR schedules, stepped per iteration (reference: utils/optim.py
+get_lr_scheduler — linear warmup + MNAS-style staircase exponential decay, or
+cosine; SURVEY.md §2 #9)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import ScheduleConfig
+
+
+def make_lr_schedule(cfg: ScheduleConfig, total_batch: int, steps_per_epoch: int, total_epochs: float):
+    """Returns lr(step) -> float32 scalar, usable inside jit."""
+    base_lr = cfg.base_lr * (total_batch / 256.0) if cfg.scale_by_batch else cfg.base_lr
+    warmup_steps = max(int(cfg.warmup_epochs * steps_per_epoch), 0)
+    total_steps = max(int(total_epochs * steps_per_epoch), warmup_steps + 1)
+
+    if cfg.schedule == "exp_decay":
+        decay_steps = max(int(cfg.decay_epochs * steps_per_epoch), 1)
+
+        def lr_fn(step):
+            step = jnp.asarray(step, jnp.float32)
+            warm = base_lr * step / jnp.maximum(warmup_steps, 1)
+            n_decays = jnp.floor(jnp.maximum(step - warmup_steps, 0.0) / decay_steps)
+            decayed = base_lr * jnp.power(cfg.decay_rate, n_decays)
+            return jnp.where(step < warmup_steps, warm, decayed).astype(jnp.float32)
+
+    elif cfg.schedule == "cosine":
+
+        def lr_fn(step):
+            step = jnp.asarray(step, jnp.float32)
+            warm = base_lr * step / jnp.maximum(warmup_steps, 1)
+            t = jnp.clip((step - warmup_steps) / jnp.maximum(total_steps - warmup_steps, 1), 0.0, 1.0)
+            cos = 0.5 * (1.0 + jnp.cos(jnp.pi * t))
+            floor = cfg.final_lr_factor * base_lr
+            return jnp.where(step < warmup_steps, warm, floor + (base_lr - floor) * cos).astype(jnp.float32)
+
+    elif cfg.schedule == "constant":
+
+        def lr_fn(step):
+            step = jnp.asarray(step, jnp.float32)
+            warm = base_lr * step / jnp.maximum(warmup_steps, 1)
+            return jnp.where(step < warmup_steps, warm, base_lr).astype(jnp.float32)
+
+    else:
+        raise ValueError(f"unknown schedule {cfg.schedule!r}")
+
+    return lr_fn

@@ -1,0 +1,165 @@
+"""The jitted train/eval step (reference: train.py run_one_epoch inner loop,
+SURVEY.md §3.1).
+
+The reference's per-step sequence — forward, CE+penalty, backward, DDP
+allreduce, optimizer step, LR step, EMA update — becomes ONE XLA program:
+grads are pmean'd over the 'data' mesh axis inside the step (replacing NCCL
+bucketed allreduce), BN stats psum via axis_name (replacing apex SyncBN), and
+the EMA/LR updates are fused in (replacing the Python-side loop bodies).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from ..config import Config
+from ..models.specs import Network
+from .ema import ema_update
+from .losses import cross_entropy_label_smooth, topk_correct
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    state: Any  # BN running stats
+    opt_state: Any
+    ema_params: Any  # None when EMA disabled
+    ema_state: Any
+    masks: Any  # {} when pruning disabled; {block_idx(str): (expanded,)} else
+
+
+def init_train_state(net: Network, cfg: Config, optimizer: optax.GradientTransformation, rng) -> TrainState:
+    params, state = net.init(rng)
+    opt_state = optimizer.init(params)
+    ema_p = jax.tree.map(lambda x: x, params) if cfg.ema.enable else None
+    ema_s = jax.tree.map(lambda x: x, state) if cfg.ema.enable else None
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        state=state,
+        opt_state=opt_state,
+        ema_params=ema_p,
+        ema_state=ema_s,
+        masks={},
+    )
+
+
+def _dtype(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+def make_train_step(
+    net: Network,
+    cfg: Config,
+    optimizer: optax.GradientTransformation,
+    lr_fn: Callable,
+    *,
+    axis_name: str | None = None,
+    penalty_fn: Callable[[Any, Mapping[str, Any]], jax.Array] | None = None,
+):
+    """Returns step_fn(ts, batch, rng) -> (ts, metrics).
+
+    ``penalty_fn(params, masks)`` is the AtomNAS FLOPs-weighted BN-gamma L1
+    hook (SURVEY.md §3.2); None for plain training. ``batch`` is
+    {'image': (N,H,W,C), 'label': (N,)} already on device.
+    """
+    compute_dtype = _dtype(cfg.train.compute_dtype)
+
+    def loss_fn(params, state, batch, masks, rng):
+        imasks = {int(k): v for k, v in masks.items()} or None
+        logits, new_state = net.apply(
+            params,
+            state,
+            batch["image"].astype(compute_dtype),
+            train=True,
+            axis_name=axis_name,
+            compute_dtype=compute_dtype,
+            masks=imasks,
+            rng=rng,
+        )
+        ce = cross_entropy_label_smooth(logits, batch["label"], cfg.optim.label_smoothing)
+        pen = penalty_fn(params, masks) if penalty_fn is not None else jnp.zeros((), jnp.float32)
+        return ce + pen, (new_state, logits, ce, pen)
+
+    def step_fn(ts: TrainState, batch, rng):
+        rng = jax.random.fold_in(rng, ts.step)
+        (loss, (new_state, logits, ce, pen)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            ts.params, ts.state, batch, ts.masks, rng
+        )
+        if axis_name is not None:
+            grads = lax.pmean(grads, axis_name)
+        updates, new_opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+        new_params = optax.apply_updates(ts.params, updates)
+        new_ema_p = ema_update(cfg.ema, ts.ema_params, new_params, ts.step) if cfg.ema.enable else None
+        new_ema_s = ema_update(cfg.ema, ts.ema_state, new_state, ts.step) if cfg.ema.enable else None
+
+        correct = topk_correct(logits, batch["label"], ks=(1,))["top1"]
+        n = jnp.asarray(logits.shape[0], jnp.float32)
+        metrics = {
+            "loss": loss,
+            "ce": ce,
+            "penalty": pen,
+            "top1": correct / n,
+            "lr": lr_fn(ts.step),
+            "grad_norm": optax.global_norm(grads),
+            "finite": jnp.isfinite(loss).astype(jnp.float32),
+        }
+        if axis_name is not None:
+            metrics = {k: lax.pmean(v, axis_name) for k, v in metrics.items()}
+        new_ts = ts.replace(
+            step=ts.step + 1,
+            params=new_params,
+            state=new_state,
+            opt_state=new_opt_state,
+            ema_params=new_ema_p,
+            ema_state=new_ema_s,
+        )
+        return new_ts, metrics
+
+    return step_fn
+
+
+def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
+    """Returns eval_fn(params, state, batch, masks) -> summed metric counts
+    {'top1','top5','n','loss_sum'} — allreduce-able AverageMeter counts
+    (SURVEY.md §2 #13). Runs on EMA shadow weights when the caller passes
+    them (reference: eval-on-shadow, SURVEY.md §2 #8)."""
+    compute_dtype = _dtype(cfg.train.compute_dtype)
+
+    def eval_fn(params, state, batch, masks):
+        imasks = {int(k): v for k, v in masks.items()} or None
+        logits, _ = net.apply(
+            params,
+            state,
+            batch["image"].astype(compute_dtype),
+            train=False,
+            compute_dtype=compute_dtype,
+            masks=imasks,
+        )
+        labels = batch["label"]
+        # padded examples carry label -1: mask them out of every count
+        valid = (labels >= 0).astype(jnp.float32)
+        safe_labels = jnp.maximum(labels, 0)
+        k = min(5, logits.shape[-1])
+        _, pred = lax.top_k(logits, k)
+        hit = (pred == safe_labels[:, None]) & (valid[:, None] > 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe_labels[:, None].astype(jnp.int32), axis=-1)[:, 0]
+        metrics = {
+            "top1": jnp.sum(hit[:, :1]).astype(jnp.float32),
+            "top5": jnp.sum(hit).astype(jnp.float32),
+            "n": jnp.sum(valid),
+            "loss_sum": jnp.sum(nll * valid),
+        }
+        if axis_name is not None:
+            metrics = {k: lax.psum(v, axis_name) for k, v in metrics.items()}
+        return metrics
+
+    return eval_fn
