@@ -280,6 +280,8 @@ def _coerce(f, v, path):
             raise TypeError(f"config key '{path}' expects an int; got bool {v}")
         return int(v)
     if t == "float":
+        if isinstance(v, bool):
+            raise TypeError(f"config key '{path}' expects a float; got bool {v}")
         return float(v)
     if t == "bool":
         if isinstance(v, str):

@@ -1,0 +1,74 @@
+"""In-jit dynamic shrinkage via channel masks (SURVEY.md §3.2 TPU translation).
+
+The reference physically rebuilds the network with fewer channels every K
+steps — hostile to XLA's static shapes. Here shrinkage is a monotonic 0/1
+mask over each block's expanded channels, updated *inside* jit at a fixed
+cadence; masked forward == physically shrunk forward exactly (proven in
+tests/test_ops.py and test_nas.py). Physical rematerialization happens at a
+much coarser cadence (nas/rematerialize.py) to reclaim real FLOPs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import PruneConfig
+from ..models.specs import Network
+from ..utils.profiling import masked_macs
+
+
+def prunable_blocks(net: Network) -> list[int]:
+    """Blocks whose expanded channels are atoms. Blocks WITHOUT an expand conv
+    (t=1 / depthwise-separable) are excluded: their depthwise channels are the
+    block's input itself, so removing one cannot be rematerialized into a
+    smaller dense block (the kept channels would be a non-contiguous gather of
+    the input)."""
+    return [i for i, b in enumerate(net.blocks) if b.has_expand]
+
+
+def init_masks(net: Network) -> dict[str, jax.Array]:
+    """All-alive masks for every prunable block (string block-index keys,
+    matching the params tree convention)."""
+    return {str(i): jnp.ones((net.blocks[i].expanded_channels,), jnp.float32) for i in prunable_blocks(net)}
+
+
+def make_mask_update(net: Network, cfg: PruneConfig):
+    """Returns update(params, masks) -> new_masks, jit-compatible.
+
+    An atom dies when |gamma| < threshold; death is irreversible (mask is
+    multiplied in), matching the reference's one-way shrinkage.
+    """
+    threshold = float(cfg.gamma_threshold)
+    residual = {str(i): b.has_residual for i, b in enumerate(net.blocks)}
+
+    def update(params, masks):
+        new = {}
+        for k, m in masks.items():
+            gamma = params["blocks"][k]["dw_bn"]["gamma"]
+            alive = m * (jnp.abs(gamma) >= threshold).astype(jnp.float32)
+            if not residual[k]:
+                # a non-residual block is the only path through the chain:
+                # if everything fell below threshold, revive the strongest
+                # previously-alive atom (rematerialize.py does the same).
+                best = jnp.argmax(jnp.abs(gamma) * m)
+                revive = (jnp.arange(m.shape[0]) == best).astype(jnp.float32) * m
+                alive = jnp.where(jnp.sum(alive) == 0, revive, alive)
+            new[k] = alive
+        return new
+
+    return update
+
+
+def mask_summary(net: Network, masks) -> dict:
+    """Host-side logging payload: alive atom counts + effective MACs — the
+    'remaining FLOPs' line the reference logs during shrinkage."""
+    np_masks = {int(k): np.asarray(v) for k, v in masks.items()}
+    alive = int(sum(m.sum() for m in np_masks.values()))
+    total = int(sum(m.size for m in np_masks.values()))
+    return {
+        "alive_atoms": alive,
+        "total_atoms": total,
+        "effective_macs": masked_macs(net, np_masks),
+    }

@@ -1,0 +1,50 @@
+"""FLOPs-weighted BN-gamma L1 penalty — the AtomNAS search objective
+(reference: utils/prune.py + the loss hook in train.py, SURVEY.md §3.2):
+
+    loss = CE + rho * sum_atoms( flops_cost[atom] * |gamma[atom]| )
+
+Each atom is one expanded channel of an InvertedResidual block; its gamma is
+the corresponding entry of the block's post-depthwise BN scale (ops/blocks.py
+keeps one concatenated BN across kernel branches precisely so this is a
+single vector per block). Dead atoms (mask==0) are excluded so the penalty
+pressure concentrates on the living network.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import PruneConfig
+from ..models.specs import Network
+from ..utils.profiling import profile_network
+
+
+def atom_cost_table(net: Network, cfg: PruneConfig) -> dict[str, np.ndarray]:
+    """Per-block float32 cost vectors, keyed by block index as str (matching
+    the params/masks key convention). Normalized by total network MACs when
+    cfg.normalize_cost so rho is resolution/width independent."""
+    from .masking import prunable_blocks
+
+    prof = profile_network(net)
+    scale = 1.0 / float(prof.total_macs) if cfg.normalize_cost else 1.0
+    keep = set(prunable_blocks(net))
+    return {str(i): (c * scale).astype(np.float32) for i, c in prof.atom_costs.items() if i in keep}
+
+
+def make_penalty_fn(net: Network, cfg: PruneConfig):
+    """Returns penalty_fn(params, masks) -> float32 scalar for the train step."""
+    costs = {k: jnp.asarray(v) for k, v in atom_cost_table(net, cfg).items()}
+    rho = float(cfg.rho)
+
+    def penalty_fn(params, masks):
+        total = jnp.zeros((), jnp.float32)
+        for k, cost in costs.items():
+            gamma = params["blocks"][k]["dw_bn"]["gamma"].astype(jnp.float32)
+            term = cost * jnp.abs(gamma)
+            if masks and k in masks:
+                term = term * masks[k].astype(jnp.float32)
+            total = total + jnp.sum(term)
+        return rho * total
+
+    return penalty_fn

@@ -1,0 +1,235 @@
+"""Physical shape rematerialization: turn masks into a smaller Network.
+
+The reference rebuilds conv/BN tensors with fewer channels mid-training
+(SURVEY.md §3.2 "this CHANGES PARAMETER SHAPES mid-training"); here the same
+surgery happens at a coarse cadence (cfg.prune.remat_epochs), paying one
+re-jit to convert masked (effective) FLOPs into real FLOPs and step time.
+
+Surgery per block, given its keep-set of expanded channels:
+- expand conv columns, expand/dw BN rows, per-branch depthwise kernels,
+  SE reduce rows + SE expand cols/bias, project conv rows are sliced;
+- a kernel branch whose atoms all died is dropped entirely;
+- a block whose atoms ALL died is dropped when it has a residual (the block
+  degenerates to identity); without a residual its strongest atom is kept
+  (the chain cannot be cut).
+- optimizer/EMA accumulators are sliced identically (params-shaped subtrees
+  inside the optax state are located by tree-structure match), so RMSProp/
+  momentum history survives the rebuild.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..models.specs import Network
+from ..ops.blocks import InvertedResidual
+
+
+@dataclass
+class RematReport:
+    dropped_blocks: list[int]
+    dropped_branches: dict[int, list[int]]  # old block idx -> dropped kernel sizes
+    atoms_before: int
+    atoms_after: int
+    index_map: dict[int, int]  # old block idx -> new block idx
+
+
+def _identity(x):
+    return x
+
+
+def _make_block_slicers(block: InvertedResidual, params_b: dict, keep: np.ndarray, branch_keeps: list[np.ndarray]):
+    """Returns (new_block_params_slicer_tree) matching params_b structure."""
+    sl: dict[str, Any] = {}
+    if "expand" in params_b:
+        sl["expand"] = {"w": lambda w: w[..., keep]}
+        sl["expand_bn"] = {k: (lambda v: v[keep]) for k in params_b["expand_bn"]}
+    for i, (k, g) in enumerate(zip(block.kernel_sizes, block.group_channels)):
+        bk = branch_keeps[i]
+        # dead branches get an identity placeholder (the slicer tree must
+        # mirror the params tree); _renumber_dw_keys deletes them after.
+        sl[f"dw{i}_k{k}"] = {"w": (lambda w, bk=bk: w[..., bk]) if bk.size else _identity}
+    sl["dw_bn"] = {k: (lambda v: v[keep]) for k in params_b["dw_bn"]}
+    if "se" in params_b:
+        sl["se"] = {
+            "reduce": {"w": lambda w: w[keep, :], "b": _identity},
+            "expand": {"w": lambda w: w[:, keep], "b": lambda b: b[keep]},
+        }
+    sl["project"] = {"w": lambda w: w[..., keep, :]}
+    sl["project_bn"] = {k: _identity for k in params_b["project_bn"]}
+    return sl
+
+
+def _renumber_dw_keys(block: InvertedResidual, branch_keeps: list[np.ndarray], tree: dict) -> dict:
+    """Drop dead branches and renumber dw{i}_k{k} keys to be contiguous."""
+    out = {}
+    new_i = 0
+    for i, k in enumerate(block.kernel_sizes):
+        key = f"dw{i}_k{k}"
+        if key not in tree:
+            continue
+        if branch_keeps[i].size == 0:
+            continue
+        out[f"dw{new_i}_k{k}"] = tree[key]
+        new_i += 1
+    for key, v in tree.items():
+        if not key.startswith("dw") or key.endswith("_bn"):
+            out.setdefault(key, v)
+    return out
+
+
+def _apply_slicers(slicer_tree, tree):
+    return jax.tree.map(lambda fn, leaf: fn(leaf), slicer_tree, tree)
+
+
+def _map_params_shaped(obj, params_structure, fn):
+    """Recursively apply fn to every subtree of obj whose pytree structure
+    equals the params structure (used to slice optax accumulators)."""
+    try:
+        if jax.tree.structure(obj) == params_structure:
+            return fn(obj)
+    except Exception:
+        pass
+    if isinstance(obj, dict):
+        return {k: _map_params_shaped(v, params_structure, fn) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
+        return type(obj)(*(_map_params_shaped(v, params_structure, fn) for v in obj))
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(_map_params_shaped(v, params_structure, fn) for v in obj)
+    return obj
+
+
+def rematerialize(
+    net: Network,
+    params: dict,
+    state: dict,
+    masks: dict[str, jax.Array],
+    *,
+    opt_state=None,
+    ema_params=None,
+    ema_state=None,
+):
+    """Returns (new_net, new_params, new_state, new_masks, extras, report)
+    where extras = {'opt_state':..., 'ema_params':..., 'ema_state':...} holds
+    whichever optional trees were passed, sliced to the new shapes."""
+    np_masks = {k: np.asarray(v) for k, v in masks.items()}
+
+    new_blocks: list[InvertedResidual] = []
+    param_slicers: dict[str, Any] = {}
+    state_slicers: dict[str, Any] = {}
+    key_renumber: dict[str, Any] = {}
+    dropped_blocks: list[int] = []
+    dropped_branches: dict[int, list[int]] = {}
+    index_map: dict[int, int] = {}
+    atoms_before = atoms_after = 0
+
+    for i, block in enumerate(net.blocks):
+        key = str(i)
+        m = np_masks.get(key)
+        if m is None:  # non-prunable block: pass through
+            index_map[i] = len(new_blocks)
+            new_blocks.append(block)
+            param_slicers[key] = jax.tree.map(lambda _: _identity, params["blocks"][key])
+            state_slicers[key] = jax.tree.map(lambda _: _identity, state["blocks"][key])
+            continue
+        atoms_before += m.size
+        keep = np.flatnonzero(m > 0)
+        if keep.size == 0:
+            if block.has_residual:
+                dropped_blocks.append(i)
+                continue
+            # masking.make_mask_update never lets a non-residual block die
+            # completely (it revives the strongest alive atom), and there is
+            # NO shrunk network equivalent to an all-dead non-residual block
+            # (its masked forward is a constant map). Refuse rather than
+            # silently diverge from the masked supernet.
+            raise ValueError(
+                f"block {i} (no residual) has an all-dead mask; no equivalent "
+                "rematerialization exists — masks must keep >=1 atom alive here"
+            )
+        atoms_after += keep.size
+
+        offsets = np.cumsum([0] + list(block.group_channels))
+        branch_keeps = []
+        kept_kernels = []
+        kept_groups = []
+        dropped_k = []
+        for j, (k, g) in enumerate(zip(block.kernel_sizes, block.group_channels)):
+            bk = keep[(keep >= offsets[j]) & (keep < offsets[j + 1])] - offsets[j]
+            branch_keeps.append(bk)
+            if bk.size:
+                kept_kernels.append(k)
+                kept_groups.append(int(bk.size))
+            else:
+                dropped_k.append(k)
+        if dropped_k:
+            dropped_branches[i] = dropped_k
+
+        new_block = replace(
+            block,
+            expanded_channels=int(keep.size),
+            kernel_sizes=tuple(kept_kernels),
+            group_channels=tuple(kept_groups),
+            # the expand conv exists and must survive even if keep.size
+            # happens to equal in_channels
+            force_expand=block.has_expand,
+        )
+        index_map[i] = len(new_blocks)
+        new_blocks.append(new_block)
+
+        psl = _make_block_slicers(block, params["blocks"][key], keep, branch_keeps)
+        # state trees hold mean/var per BN; expand/dw BNs are row-sliced,
+        # project BN is untouched
+        row = lambda v, keep=keep: v[keep]
+        ssl = {
+            bn: {leaf: (row if bn != "project_bn" else _identity) for leaf in state["blocks"][key][bn]}
+            for bn in state["blocks"][key]
+        }
+        param_slicers[key] = psl
+        state_slicers[key] = ssl
+        key_renumber[key] = branch_keeps
+
+    new_net = replace(net, blocks=tuple(new_blocks))
+
+    def slice_params(p):
+        out = dict(p)
+        nb = {}
+        for old_i, new_i in index_map.items():
+            old_key, new_key = str(old_i), str(new_i)
+            sub = _apply_slicers(param_slicers[old_key], p["blocks"][old_key])
+            if old_key in key_renumber:
+                sub = _renumber_dw_keys(net.blocks[old_i], key_renumber[old_key], sub)
+            nb[new_key] = sub
+        out["blocks"] = nb
+        return out
+
+    def slice_state(s):
+        out = dict(s)
+        nb = {}
+        for old_i, new_i in index_map.items():
+            old_key, new_key = str(old_i), str(new_i)
+            nb[new_key] = _apply_slicers(state_slicers[old_key], s["blocks"][old_key])
+        out["blocks"] = nb
+        return out
+
+    new_params = slice_params(params)
+    new_state = slice_state(state)
+
+    extras: dict[str, Any] = {}
+    if opt_state is not None:
+        pstruct = jax.tree.structure(params)
+        extras["opt_state"] = _map_params_shaped(opt_state, pstruct, slice_params)
+    if ema_params is not None:
+        extras["ema_params"] = slice_params(ema_params)
+    if ema_state is not None:
+        extras["ema_state"] = slice_state(ema_state)
+
+    from .masking import init_masks
+
+    new_masks = init_masks(new_net)
+    report = RematReport(dropped_blocks, dropped_branches, atoms_before, atoms_after, index_map)
+    return new_net, new_params, new_state, new_masks, extras, report

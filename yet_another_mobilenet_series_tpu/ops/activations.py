@@ -8,6 +8,7 @@ surrounding conv epilogues; no Pallas needed.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -28,12 +29,14 @@ def hswish(x):
 
 
 def sigmoid(x):
-    return jnp.reciprocal(1.0 + jnp.exp(-x))
+    # jax.nn.sigmoid: numerically stable VJP (a hand-rolled 1/(1+exp(-x))
+    # yields NaN gradients once exp(-x) overflows at x < -88 in f32).
+    return jax.nn.sigmoid(x)
 
 
 def swish(x):
     # a.k.a. SiLU; used by the AtomNAS "+" variants (SURVEY.md §6)
-    return x * sigmoid(x)
+    return x * jax.nn.sigmoid(x)
 
 
 def identity(x):

@@ -128,6 +128,9 @@ class InvertedResidual:
     project_act: str = "identity"
     # V1/MNASNet-sepconv blocks never add a residual even when shapes allow.
     allow_residual: bool = True
+    # Keep the 1x1 expand conv even when expanded==in (a pruned supernet
+    # block can shrink to exactly in_channels; its expand conv must survive).
+    force_expand: bool = False
 
     def __post_init__(self):
         for name in (self.active_fn, self.project_act, self.se_gate_fn, self.se_inner_act):
@@ -144,7 +147,7 @@ class InvertedResidual:
     # -- derived static structure ------------------------------------------
     @property
     def has_expand(self) -> bool:
-        return self.expanded_channels != self.in_channels
+        return self.force_expand or self.expanded_channels != self.in_channels
 
     @property
     def has_residual(self) -> bool:
@@ -220,5 +223,13 @@ class InvertedResidual:
         )
         h = get_activation(self.project_act)(h)
         if self.has_residual:
+            if mask is not None:
+                # A fully-masked block must equal identity exactly — without
+                # this gate the project BN's shift (beta - mean*scale) leaks
+                # through zeroed inputs, and rematerialization (which drops
+                # dead residual blocks, nas/rematerialize.py) would not be
+                # equivalent to masking.
+                any_alive = (jnp.max(mask) > 0).astype(h.dtype)
+                h = h * any_alive
             h = h + x.astype(h.dtype)
         return h, new_state

@@ -1,0 +1,91 @@
+"""Data-parallel train/eval steps over the mesh (the apex-DDP replacement,
+SURVEY.md §2 #12 and §3.1).
+
+One ``jit(shard_map(step))`` per step: batch sharded on 'data', every state
+pytree replicated. Gradients are pmean'd and BN moments psum'd *inside* the
+program, so XLA overlaps the collectives with backprop the way apex's bucketed
+allreduce overlapped with autograd — except scheduled by the compiler, not by
+hand. Optionally the optimizer update itself is sharded across replicas and
+the fresh params all-gathered (PAPERS.md:5, arXiv:2004.13336 — ZeRO-style
+cross-replica weight-update sharding) to cut update time and optimizer memory.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import Config
+from ..models.specs import Network
+from ..train.steps import TrainState, make_eval_step, make_train_step
+from .mesh import DATA_AXIS
+
+
+def make_dp_train_step(
+    net: Network,
+    cfg: Config,
+    optimizer,
+    lr_fn: Callable,
+    mesh: Mesh,
+    *,
+    penalty_fn=None,
+):
+    """jitted (ts, batch, rng) -> (ts, metrics) over the mesh.
+
+    ts is fully replicated; batch is sharded on the 'data' axis. The per-shard
+    rng is folded with the device's axis index so dropout/augment noise is
+    decorrelated across replicas.
+    """
+    inner = make_train_step(net, cfg, optimizer, lr_fn, axis_name=DATA_AXIS, penalty_fn=penalty_fn)
+
+    def shard_fn(ts: TrainState, batch, rng):
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        return inner(ts, batch, rng)
+
+    fn = shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def make_dp_eval_step(net: Network, cfg: Config, mesh: Mesh):
+    """jitted (params, state, batch, masks) -> summed metric counts."""
+    inner = make_eval_step(net, cfg, axis_name=DATA_AXIS)
+    fn = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def make_replica_sync_check(mesh: Mesh):
+    """Returns check(tree) -> max |checksum_i - checksum_0| across replicas.
+
+    The distributed 'race detector' of SURVEY.md §5: replicated state must be
+    bit-identical on every device; drift means non-deterministic compute or a
+    broken collective. Run every cfg.train.param_checksum_every steps.
+    """
+
+    def local_checksum(tree):
+        leaves = jax.tree.leaves(tree)
+        return sum(jnp.sum(l.astype(jnp.float64) if l.dtype == jnp.float64 else l.astype(jnp.float32)) for l in leaves)
+
+    def shard_fn(tree):
+        c = local_checksum(tree)
+        all_c = lax.all_gather(c, DATA_AXIS)
+        return jnp.max(jnp.abs(all_c - all_c[0]))
+
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False)
+    return jax.jit(fn)

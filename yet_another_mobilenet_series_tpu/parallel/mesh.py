@@ -1,0 +1,78 @@
+"""Device mesh + sharding helpers (reference: utils/distributed.py init_dist /
+rank helpers + apex DDP wrap, SURVEY.md §2 #12).
+
+The reference's NCCL process-group world becomes a single SPMD program over a
+1-D ``('data',)`` mesh: gradient allreduce and SyncBN moments ride ICI inside
+the compiled step (SURVEY.md §5 "distributed communication backend"); DCN is
+only involved across slices, handled transparently by the same collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: int = 0, devices=None) -> Mesh:
+    """1-D data-parallel mesh. num_devices=0 → all visible devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices:
+        if num_devices > len(devices):
+            raise ValueError(f"requested {num_devices} devices, only {len(devices)} visible")
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a host batch onto the mesh, split along the batch dimension.
+    (The device_put_sharded step of SURVEY.md §3.1's TPU hot loop.)
+
+    Single-host: a plain device_put. Multi-host: each process holds only its
+    local rows (see local_batch_slice), so the global array is assembled with
+    make_array_from_process_local_data — device_put to a sharding with
+    non-addressable devices would fail.
+    """
+    s = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, s), batch)
+    return jax.tree.map(lambda x: jax.make_array_from_process_local_data(s, np.asarray(x)), batch)
+
+
+def replicate(tree, mesh: Mesh):
+    s = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, s), tree)
+
+
+# --- multi-host glue (reference: is_master guards / master_only decorators) --
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on exactly one host — gates checkpoint writes and logging, like
+    the reference's is_master()."""
+    return jax.process_index() == 0
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
+    """Per-host share of the global batch (per-host data sharding of the
+    input pipeline, SURVEY.md §7 hard part 5)."""
+    n_proc = jax.process_count()
+    if global_batch % mesh.size:
+        raise ValueError(f"global batch {global_batch} not divisible by {mesh.size} devices")
+    if global_batch % n_proc:
+        raise ValueError(f"global batch {global_batch} not divisible by {n_proc} hosts")
+    return global_batch // n_proc

@@ -38,8 +38,10 @@ class TrainState:
 def init_train_state(net: Network, cfg: Config, optimizer: optax.GradientTransformation, rng) -> TrainState:
     params, state = net.init(rng)
     opt_state = optimizer.init(params)
-    ema_p = jax.tree.map(lambda x: x, params) if cfg.ema.enable else None
-    ema_s = jax.tree.map(lambda x: x, state) if cfg.ema.enable else None
+    # Real copies: the shadow must not alias the live buffers (aliasing breaks
+    # buffer donation of the whole TrainState).
+    ema_p = jax.tree.map(jnp.copy, params) if cfg.ema.enable else None
+    ema_s = jax.tree.map(jnp.copy, state) if cfg.ema.enable else None
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
