@@ -1,0 +1,111 @@
+"""Headline benchmark: MobileNetV3-Large ImageNet training throughput,
+images/sec/chip (the tracked metric, BASELINE.json:2).
+
+Measures the full fused training step — forward, backward, RMSProp+WD update,
+EMA, label-smoothed CE — in bfloat16 at 224x224 on device-resident data, so
+the number is the model/step ceiling of SURVEY.md §3.1's hot loop (host input
+throughput is benchmarked separately by the data pipeline).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+vs_baseline: BASELINE.json ships "published": {} (no reference numbers were
+recoverable this round — see SURVEY.md provenance warning), so the divisor is
+an explicit assumption recorded here: ~1000 images/sec/chip for the
+reference's apex+DALI MobileNet training on its contemporary GPU (V100
+class). Replace when a real reference measurement exists.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+ASSUMED_BASELINE_IMG_S_PER_CHIP = 1000.0
+
+
+def log(msg: str):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+
+    if "--cpu" in sys.argv:
+        # local smoke mode: the sandbox's sitecustomize force-selects the axon
+        # TPU platform regardless of JAX_PLATFORMS, so override the live config
+        # (same trick as tests/conftest.py) before any backend is touched.
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.config import config_from_dict
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.parallel import dp, mesh as mesh_lib
+    from yet_another_mobilenet_series_tpu.train import optim, schedules, steps
+
+    platform = jax.default_backend()
+    n_chips = len(jax.devices())
+    # batch sized for one v5e-class chip; scale with the mesh. The CPU path
+    # exists only as a smoke test (this sandbox has 1 core) — the recorded
+    # number comes from the driver's real-TPU run.
+    per_chip_batch = 256 if platform == "tpu" else 8
+    image_size = 224 if platform == "tpu" else 64
+    batch = per_chip_batch * n_chips
+    log(f"bench: {platform} x{n_chips}, global batch {batch}, image {image_size}")
+
+    cfg = config_from_dict({
+        "model": {"arch": "mobilenet_v3_large", "dropout": 0.2},
+        "optim": {"optimizer": "rmsprop", "weight_decay": 1e-5},
+        "schedule": {"schedule": "exp_decay", "base_lr": 0.064, "warmup_epochs": 5.0},
+        "ema": {"enable": True},
+        "train": {"batch_size": batch, "compute_dtype": "bfloat16"},
+    })
+    mesh = mesh_lib.make_mesh(n_chips)
+    net = get_model(cfg.model, image_size)
+    steps_per_epoch = 1281167 // batch
+    lr_fn = schedules.make_lr_schedule(cfg.schedule, batch, steps_per_epoch, 350)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    optimizer = optim.make_optimizer(cfg.optim, lr_fn, params)
+    ts = steps.init_train_state(net, cfg, optimizer, jax.random.PRNGKey(0))
+    ts = mesh_lib.replicate(ts, mesh)
+    step_fn = dp.make_dp_train_step(net, cfg, optimizer, lr_fn, mesh)
+
+    rng = np.random.RandomState(0)
+    host_batch = {
+        "image": rng.normal(0, 1, (batch, image_size, image_size, 3)).astype(np.float32),
+        "label": (np.arange(batch) % 1000).astype(np.int32),
+    }
+    b = mesh_lib.shard_batch(host_batch, mesh)
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.perf_counter()
+    ts, metrics = step_fn(ts, b, key)
+    jax.block_until_ready(metrics["loss"])
+    log(f"compile+first step: {time.perf_counter()-t0:.1f}s")
+
+    # warmup
+    for _ in range(3):
+        ts, metrics = step_fn(ts, b, key)
+    jax.block_until_ready(metrics["loss"])
+
+    iters = 20 if platform == "tpu" else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ts, metrics = step_fn(ts, b, key)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+    img_s = batch * iters / dt
+    img_s_chip = img_s / n_chips
+    log(f"steady: {dt/iters*1000:.1f} ms/step, {img_s:.0f} img/s total")
+
+    print(json.dumps({
+        "metric": "mobilenet_v3_large_train_images_per_sec_per_chip",
+        "value": round(img_s_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s_chip / ASSUMED_BASELINE_IMG_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Checkpoint semantics (SURVEY.md §4.3): save -> restore -> next step is
+bit-identical to never having checkpointed; pruned-shape-first restore."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.ckpt.manager import CheckpointManager
+from yet_another_mobilenet_series_tpu.config import config_from_dict
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.nas import masking
+from yet_another_mobilenet_series_tpu.train import optim, schedules, steps
+
+
+def _mk(tmp_path):
+    cfg = config_from_dict({
+        "model": {
+            "arch": "atomnas_supernet",
+            "num_classes": 4,
+            "dropout": 0.0,
+            "block_specs": [{"t": 4, "c": 8, "n": 1, "s": 2, "k": [3, 5]}],
+        },
+        "schedule": {"schedule": "constant", "base_lr": 0.02, "scale_by_batch": False, "warmup_epochs": 0.0},
+        "ema": {"enable": True, "decay": 0.9, "warmup": False},
+        "train": {"compute_dtype": "float32", "log_dir": str(tmp_path)},
+        "prune": {"enable": True},
+    })
+    net = get_model(cfg.model, image_size=16)
+    lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 10)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    opt = optim.make_optimizer(cfg.optim, lr_fn, params)
+    ts = steps.init_train_state(net, cfg, opt, jax.random.PRNGKey(0))
+    ts = ts.replace(masks=masking.init_masks(net))
+    step_fn = jax.jit(steps.make_train_step(net, cfg, opt, lr_fn))
+    batch = {"image": jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3)), "label": jnp.arange(8) % 4}
+    return cfg, net, opt, ts, step_fn, batch
+
+
+def test_save_restore_step_bit_equivalence(tmp_path):
+    cfg, net, opt, ts, step_fn, batch = _mk(tmp_path)
+    ts, _ = step_fn(ts, batch, jax.random.PRNGKey(2))
+
+    mgr = CheckpointManager(str(tmp_path) + "/ck", async_save=False)
+    mgr.save(int(ts.step), net, jax.device_get(ts), extra={"epoch": 0.5})
+    mgr.wait()
+
+    # continue WITHOUT restoring
+    ts_cont, _ = step_fn(ts, batch, jax.random.PRNGKey(2))
+
+    # restore (two-phase: spec first, then tree against abstract target)
+    step, net2, extra = mgr.restore_spec()
+    assert net2 == net and extra["epoch"] == 0.5
+    abstract = jax.eval_shape(lambda: ts)
+    tree = mgr.restore_tree(step, steps.train_state_to_dict(abstract))
+    ts_rest = steps.TrainState(**tree)
+    ts_rest2, _ = step_fn(ts_rest, batch, jax.random.PRNGKey(2))
+
+    for a, b in zip(jax.tree.leaves(ts_cont), jax.tree.leaves(ts_rest2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr.close()
+
+
+def test_restore_spec_none_when_empty(tmp_path):
+    mgr = CheckpointManager(str(tmp_path) + "/empty", async_save=False)
+    assert mgr.restore_spec() is None
+    mgr.close()
+
+
+def test_restore_pruned_shape_first(tmp_path):
+    """The sidecar must rebuild the pruned architecture before weights load
+    (SURVEY.md §3.5)."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.nas import rematerialize
+
+    cfg, net, opt, ts, step_fn, batch = _mk(tmp_path)
+    masks = {k: jnp.asarray(np.r_[np.ones(8), np.zeros(v.shape[0] - 8)].astype(np.float32)) for k, v in ts.masks.items()}
+    new_net, p, s, m, extras, _ = rematerialize.rematerialize(
+        net, jax.device_get(ts.params), jax.device_get(ts.state),
+        {k: np.asarray(v) for k, v in masks.items()},
+        opt_state=jax.device_get(ts.opt_state),
+        ema_params=jax.device_get(ts.ema_params), ema_state=jax.device_get(ts.ema_state),
+    )
+    ts2 = steps.TrainState(step=ts.step, params=p, state=s, opt_state=extras["opt_state"],
+                           ema_params=extras["ema_params"], ema_state=extras["ema_state"], masks=m)
+    mgr = CheckpointManager(str(tmp_path) + "/ck2", async_save=False)
+    mgr.save(7, new_net, ts2, extra={})
+    mgr.wait()
+    step, net3, _ = mgr.restore_spec()
+    assert step == 7
+    assert net3 == new_net  # pruned shape, not the supernet
+    assert net3.blocks[0].expanded_channels == 8
+    mgr.close()

@@ -1,0 +1,109 @@
+"""End-to-end integration tests through cli.train.run() (SURVEY.md §4.3):
+fake-data training loss decreases, checkpoint save->resume, eval-only path,
+and the AtomNAS shrink-mid-run->resume survival test."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from yet_another_mobilenet_series_tpu.cli import train as cli_train
+from yet_another_mobilenet_series_tpu.config import config_from_dict
+
+
+def _base_cfg(tmp_path, **over):
+    d = {
+        "name": "itest",
+        "model": {
+            "arch": "mobilenet_v2",
+            "num_classes": 8,
+            "dropout": 0.0,
+            "block_specs": [
+                {"t": 3, "c": 16, "n": 1, "s": 2, "k": 3},
+                {"t": 3, "c": 24, "n": 1, "s": 2, "k": 3},
+            ],
+        },
+        "data": {"dataset": "fake", "image_size": 32, "fake_train_size": 1280, "fake_eval_size": 64},
+        "optim": {"optimizer": "rmsprop", "weight_decay": 1e-5},
+        "schedule": {"schedule": "constant", "base_lr": 0.06, "scale_by_batch": False, "warmup_epochs": 0.25},
+        "ema": {"enable": True, "decay": 0.99, "warmup": True},
+        "train": {
+            "batch_size": 64,
+            "eval_batch_size": 64,
+            "epochs": 2,
+            "log_every": 2,
+            "compute_dtype": "float32",
+            "log_dir": str(tmp_path),
+            "eval_every_epochs": 1.0,
+        },
+        "dist": {"num_devices": 8},
+    }
+    for k, v in over.items():
+        cur = d
+        ks = k.split(".")
+        for kk in ks[:-1]:
+            cur = cur.setdefault(kk, {})
+        cur[ks[-1]] = v
+    return config_from_dict(d)
+
+
+def test_train_run_learns_and_checkpoints(tmp_path):
+    cfg = _base_cfg(tmp_path, **{"train.epochs": 3})
+    result = cli_train.run(cfg)
+    assert result["epoch"] == pytest.approx(3.0)
+    # learnable synthetic task: far above chance (1/8) once EMA/BN warm up
+    assert result["eval_top1"] > 0.5, result
+    assert result["eval_n"] == 64
+    # a checkpoint with spec sidecar exists
+    assert glob.glob(str(tmp_path) + "/ckpt/*/meta*")
+
+
+def test_resume_continues_from_checkpoint(tmp_path, capsys):
+    cfg = _base_cfg(tmp_path, **{"train.epochs": 1})
+    cli_train.run(cfg)
+    cfg2 = _base_cfg(tmp_path, **{"train.epochs": 2})
+    cli_train.run(cfg2)
+    out = capsys.readouterr().out
+    assert "resumed at step 20" in out  # 1280/64 = 20 steps/epoch
+
+
+def test_eval_only_with_pretrained(tmp_path):
+    cfg = _base_cfg(tmp_path)
+    trained = cli_train.run(cfg)
+    cfg_eval = _base_cfg(tmp_path, **{"train.test_only": True})
+    result = cli_train.run(cfg_eval)
+    np.testing.assert_allclose(result["top1"], trained["eval_top1"], atol=1e-6)
+
+
+def test_atomnas_search_shrinks_and_resumes(tmp_path, capsys):
+    over = {
+        "model.arch": "atomnas_supernet",
+        "model.block_specs": [
+            {"t": 6, "c": 16, "n": 2, "s": 2, "k": [3, 5, 7]},
+            {"t": 6, "c": 24, "n": 1, "s": 2, "k": [3, 5, 7], "se": 0.25},
+        ],
+        "prune.enable": True,
+        "prune.rho": 0.05,
+        "prune.gamma_threshold": 0.6,  # aggressive: init gamma=1 must be pushed below
+        "prune.mask_interval": 2,
+        "prune.remat_epochs": 1.0,
+        "prune.stop_epoch_frac": 1.0,
+        "train.epochs": 2,
+        "schedule.base_lr": 0.12,
+    }
+    cfg = _base_cfg(tmp_path, **over)
+    result = cli_train.run(cfg)
+    out = capsys.readouterr().out
+    assert "penalty=" in out
+    assert result["epoch"] == pytest.approx(2.0)
+    # the saved spec sidecar must encode the (possibly pruned) live network
+    metas = sorted(glob.glob(str(tmp_path) + "/ckpt/*/meta/*"))
+    assert metas
+    # resume must rebuild from the sidecar without shape errors
+    cfg3 = _base_cfg(tmp_path, **{**over, "train.epochs": 2.5})
+    result2 = cli_train.run(cfg3)
+    assert result2["epoch"] >= 2.0

@@ -1,0 +1,78 @@
+"""Checkpoint/resume via Orbax + architecture-spec sidecar (reference:
+torch.save dict {epoch, model, EMA, optimizer, lr step, live AtomNAS spec},
+save-on-master-only, SURVEY.md §3.5 / §5).
+
+The critical ordering subtlety reproduced here: on AtomNAS resume the *live
+network spec* must be restored first so the model is rebuilt at the pruned
+shape, and only then can the weight trees load (SURVEY.md §3.5). The spec
+rides in the same Orbax step directory as a JSON item next to the pytree.
+
+Orbax gives async saves (preemption loses minutes, not epochs — SURVEY.md §5
+failure-detection plan) and multi-host coordination for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from ..models.serialize import network_from_dict, network_to_dict
+from ..models.specs import Network
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, net: Network, train_state, extra: dict[str, Any] | None = None):
+        """Saves the TrainState pytree + live network spec (+ small JSON extras
+        like epoch/masks metadata)."""
+        from ..train.steps import train_state_to_dict
+
+        tree = train_state_to_dict(train_state)
+        meta = {"network": network_to_dict(net), "extra": extra or {}}
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                tree=ocp.args.StandardSave(tree),
+                meta=ocp.args.JsonSave(meta),
+            ),
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore_spec(self, step: int | None = None):
+        """Phase 1 of resume: returns (step, net, extra) with the network
+        rebuilt from the JSON sidecar BEFORE any weights are read — the
+        pruned-shape-first ordering of SURVEY.md §3.5. The caller then builds
+        the optimizer/TrainState skeleton at this shape and passes its
+        abstract tree to restore_tree."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            return None
+        meta = self._mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
+        return step, network_from_dict(meta["network"]), meta["extra"]
+
+    def restore_tree(self, step: int, abstract_tree):
+        """Phase 2: restore the pytree against an abstract target so optax
+        NamedTuple states and dtypes round-trip exactly."""
+        return self._mgr.restore(
+            step, args=ocp.args.Composite(tree=ocp.args.StandardRestore(abstract_tree))
+        )["tree"]
+
+    def wait(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

@@ -1,0 +1,255 @@
+"""Training/eval entry point — the reference train.py rebuilt for TPU
+(SURVEY.md §3.1): ``python -m yet_another_mobilenet_series_tpu.cli.train
+app:<yaml> [key=value ...]``.
+
+Owns the epoch/step loops, validation on EMA shadow weights, checkpoint
+save/resume (pruned-shape-first), the AtomNAS shrink schedule (in-jit mask
+refresh at fine cadence + physical rematerialization at coarse cadence), and
+throughput/accuracy logging. Everything inside the step is one compiled XLA
+program (train/steps.py + parallel/dp.py).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+from ..ckpt.manager import CheckpointManager
+from ..config import Config, parse_cli
+from ..data import pipeline as data_lib
+from ..models import get_model
+from ..models.specs import Network
+from ..nas import masking, penalty, rematerialize
+from ..parallel import dp, mesh as mesh_lib
+from ..train import optim, schedules, steps
+from ..utils.logging import Logger
+from ..utils.meters import MetricLogger, format_metrics
+from ..utils.profiling import profile_network
+
+
+def _dataset_sizes(cfg: Config) -> tuple[int, int]:
+    if cfg.data.dataset == "fake":
+        return cfg.data.fake_train_size, cfg.data.fake_eval_size
+    return cfg.data.num_train_examples, cfg.data.num_eval_examples
+
+
+class Trainer:
+    """Builds and owns all step functions; rebuilt wholesale on
+    rematerialization (shapes changed => everything re-jits)."""
+
+    def __init__(self, cfg: Config, net: Network, mesh, log: Logger):
+        self.cfg = cfg
+        self.net = net
+        self.mesh = mesh
+        self.log = log
+        n_train, _ = _dataset_sizes(cfg)
+        self.steps_per_epoch = max(n_train // cfg.train.batch_size, 1)
+        self.lr_fn = schedules.make_lr_schedule(
+            cfg.schedule, cfg.train.batch_size, self.steps_per_epoch, cfg.train.epochs
+        )
+        params_example, _ = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
+        self.optimizer = optim.make_optimizer(cfg.optim, self.lr_fn, params_example)
+        self.penalty_fn = penalty.make_penalty_fn(net, cfg.prune) if cfg.prune.enable else None
+        self.train_step = dp.make_dp_train_step(
+            net, cfg, self.optimizer, self.lr_fn, mesh, penalty_fn=self.penalty_fn
+        )
+        self.eval_step = dp.make_dp_eval_step(net, cfg, mesh)
+        self.mask_update = jax.jit(masking.make_mask_update(net, cfg.prune)) if cfg.prune.enable else None
+        self.sync_check = dp.make_replica_sync_check(mesh)
+
+    def init_state(self, rng) -> steps.TrainState:
+        ts = steps.init_train_state(self.net, self.cfg, self.optimizer, rng)
+        if self.cfg.prune.enable:
+            ts = ts.replace(masks=masking.init_masks(self.net))
+        return mesh_lib.replicate(ts, self.mesh)
+
+    def abstract_state(self) -> steps.TrainState:
+        """Shape/dtype skeleton for checkpoint restore (ckpt phase 2)."""
+        return jax.eval_shape(lambda: self.init_state(jax.random.PRNGKey(0)))
+
+
+def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
+    """Two-phase resume (SURVEY.md §3.5): spec -> rebuild at pruned shape ->
+    weights. Returns (trainer, ts, extra) or None."""
+    spec = ckpt.restore_spec()
+    if spec is None:
+        return None
+    step, net, extra = spec
+    trainer = Trainer(cfg, net, mesh, log)
+    tree = ckpt.restore_tree(step, steps.train_state_to_dict(trainer.abstract_state()))
+    ts = mesh_lib.replicate(steps.TrainState(**tree), mesh)
+    return trainer, ts, extra
+
+
+def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=True) -> dict:
+    """Validation pass on the EMA shadow weights (reference: eval-on-shadow,
+    SURVEY.md §2 #8); falls back to the live weights when EMA is off."""
+    params = ts.ema_params if (use_ema and cfg.ema.enable) else ts.params
+    state = ts.ema_state if (use_ema and cfg.ema.enable) else ts.state
+    # round the local eval batch up to mesh divisibility (padding rows carry
+    # label=-1 and are masked out of every count)
+    n_dev = trainer.mesh.size
+    local_eval = -(-cfg.train.eval_batch_size // n_dev) * n_dev
+    ds = data_lib.make_eval_dataset(cfg.data, local_eval, jax.process_index(), jax.process_count())
+    totals = {"top1": 0.0, "top5": 0.0, "n": 0.0, "loss_sum": 0.0}
+    for batch in data_lib.as_numpy(ds):
+        b = mesh_lib.shard_batch(batch, trainer.mesh)
+        m = trainer.eval_step(params, state, b, ts.masks)
+        for k in totals:
+            totals[k] += float(m[k])
+    n = max(totals["n"], 1.0)
+    return {"top1": totals["top1"] / n, "top5": totals["top5"] / n, "loss": totals["loss_sum"] / n, "n": int(n)}
+
+
+def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
+    """Physical shrink at coarse cadence (SURVEY.md §3.2 TPU translation).
+    Returns (trainer, ts) — possibly rebuilt."""
+    cfg = trainer.cfg
+    summary = masking.mask_summary(trainer.net, ts.masks)
+    if summary["alive_atoms"] == summary["total_atoms"]:
+        return trainer, ts  # nothing died; skip the recompile
+    host_ts = jax.device_get(ts)
+    masks = {k: np.asarray(v) for k, v in host_ts.masks.items()}
+    new_net, new_p, new_s, new_masks, extras, report = rematerialize.rematerialize(
+        trainer.net, host_ts.params, host_ts.state, masks,
+        opt_state=host_ts.opt_state, ema_params=host_ts.ema_params, ema_state=host_ts.ema_state,
+    )
+    log.log(
+        f"rematerialize: atoms {report.atoms_before}->{report.atoms_after}, "
+        f"dropped blocks {report.dropped_blocks}, "
+        f"MACs {profile_network(trainer.net).total_macs/1e6:.1f}M->{profile_network(new_net).total_macs/1e6:.1f}M"
+    )
+    new_trainer = Trainer(cfg, new_net, trainer.mesh, log)
+    new_ts = steps.TrainState(
+        step=host_ts.step, params=new_p, state=new_s, opt_state=extras["opt_state"],
+        ema_params=extras.get("ema_params"), ema_state=extras.get("ema_state"), masks=new_masks,
+    )
+    return new_trainer, mesh_lib.replicate(new_ts, trainer.mesh)
+
+
+def run(cfg: Config) -> dict:
+    import dataclasses as dc
+
+    if cfg.data.dataset == "fake" and cfg.data.fake_num_classes is None:
+        cfg = dc.replace(cfg, data=dc.replace(cfg.data, fake_num_classes=cfg.model.num_classes))
+    is_coord = mesh_lib.is_coordinator()
+    log = Logger(cfg.train.log_dir, enabled=is_coord, tensorboard=bool(cfg.train.log_dir))
+    mesh = mesh_lib.make_mesh(cfg.dist.num_devices)
+    log.log(f"devices: {mesh.size} ({jax.devices()[0].platform}), hosts: {jax.process_count()}")
+
+    net = get_model(cfg.model, cfg.data.image_size)
+    prof = profile_network(net)
+    log.log(f"model {cfg.model.arch} x{cfg.model.width_mult}: {prof.total_params/1e6:.2f}M params, {prof.total_macs/1e6:.1f}M MACs")
+
+    ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt", max_to_keep=cfg.train.max_checkpoints)
+
+    # ---- eval-only path (acceptance config #1) ----
+    if cfg.train.test_only:
+        src = cfg.train.pretrained or cfg.train.log_dir + "/ckpt"
+        mgr = CheckpointManager(src) if cfg.train.pretrained else ckpt
+        restored = _restore(mgr, cfg, mesh, log)
+        if restored is None:
+            log.log("no checkpoint found; evaluating fresh init (smoke mode)")
+            trainer = Trainer(cfg, net, mesh, log)
+            ts = trainer.init_state(jax.random.PRNGKey(cfg.train.seed))
+        else:
+            trainer, ts, _ = restored
+        result = evaluate(trainer, ts, cfg)
+        log.log(format_metrics("eval:", result))
+        ckpt.close()
+        return result
+
+    # ---- training path ----
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    restored = _restore(ckpt, cfg, mesh, log) if cfg.train.resume else None
+    start_epoch = 0.0
+    if restored is not None:
+        trainer, ts, extra = restored
+        start_epoch = float(extra.get("epoch", int(ts.step) / trainer.steps_per_epoch))
+        log.log(f"resumed at step {int(ts.step)} (epoch {start_epoch:.2f})")
+    else:
+        trainer = Trainer(cfg, net, mesh, log)
+        ts = trainer.init_state(rng)
+
+    local_batch = mesh_lib.local_batch_slice(cfg.train.batch_size, mesh)
+    train_ds = data_lib.make_train_dataset(
+        cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count()
+    )
+    train_iter = data_lib.as_numpy(train_ds)
+
+    total_epochs = cfg.train.epochs
+    spe = trainer.steps_per_epoch
+    prune_stop_step = int(cfg.prune.stop_epoch_frac * total_epochs * spe)
+    metric_log = MetricLogger()
+    eval_result: dict = {}
+    epoch = start_epoch
+    host_step = int(ts.step)  # one sync at (re)start, then host-side counting
+
+    while epoch < total_epochs:
+        epoch_steps = min(spe, max(int((total_epochs - epoch) * spe), 1))
+        t_epoch = time.perf_counter()
+        for _ in range(epoch_steps):
+            batch = next(train_iter)
+            b = mesh_lib.shard_batch(batch, trainer.mesh)
+            ts, metrics = trainer.train_step(ts, b, rng)
+            # host-side counter: int(ts.step) would sync the host with the
+            # device every step and stall async dispatch
+            host_step += 1
+            step_i = host_step
+            metric_log.update(metrics, batch_images=cfg.train.batch_size)
+
+            if cfg.prune.enable and trainer.mask_update is not None and step_i % cfg.prune.mask_interval == 0:
+                if step_i <= prune_stop_step:
+                    summary = masking.mask_summary(trainer.net, ts.masks)
+                    if not (cfg.prune.target_flops and summary["effective_macs"] <= cfg.prune.target_flops):
+                        ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
+
+            if step_i % cfg.train.log_every == 0:
+                snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
+                if cfg.prune.enable:
+                    snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
+                log.log(format_metrics(f"step {step_i}:", snap))
+                log.scalars(step_i, snap, "train/")
+                if snap.get("finite", 1.0) < 1.0:
+                    log.error("non-finite loss detected; aborting")
+                    raise FloatingPointError("non-finite loss")
+            if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
+                div = float(trainer.sync_check(ts.params))
+                if div != 0.0:
+                    log.error(f"replica divergence {div} at step {step_i}")
+                    raise RuntimeError("replica divergence")
+        epoch += epoch_steps / spe
+        log.log(f"epoch {epoch:.2f} done in {time.perf_counter()-t_epoch:.1f}s")
+
+        # coarse-cadence physical shrink (recompile paid here, not per-step)
+        if cfg.prune.enable and cfg.prune.remat_epochs > 0 and (int(epoch) % max(int(cfg.prune.remat_epochs), 1) == 0):
+            trainer, ts = _maybe_rematerialize(trainer, ts, log)
+
+        if cfg.train.eval_every_epochs and (epoch % cfg.train.eval_every_epochs) < 1e-6 or epoch >= total_epochs:
+            eval_result = evaluate(trainer, ts, cfg)
+            log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
+            log.scalars(int(ts.step), eval_result, "eval/")
+
+        if cfg.train.checkpoint_every_epochs and (
+            (epoch % cfg.train.checkpoint_every_epochs) < 1e-6 or epoch >= total_epochs
+        ):
+            # orbax coordinates multi-host saves internally; every process calls in
+            ckpt.save(int(ts.step), trainer.net, jax.device_get(ts), extra={"epoch": epoch})
+
+    ckpt.wait()
+    ckpt.close()
+    final = {"epoch": epoch, **{f"eval_{k}": v for k, v in eval_result.items()}}
+    log.log(format_metrics("done:", final))
+    return final
+
+
+def main(argv=None):
+    cfg = parse_cli(sys.argv[1:] if argv is None else argv)
+    run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,268 @@
+"""ImageNet input pipeline (reference: DALI GPU pipes + LMDB + torchvision
+fallback, SURVEY.md §2 #6).
+
+TPU hosts have no GPU decoder, so the DALI role moves to the host CPU:
+tf.data reading TFRecord shards with parallel JPEG decode, Inception-style
+random-resized-crop + flip (+ optional color jitter) for train, and the
+resize-shorter-side/center-crop eval transform — the exact augmentation
+surface of the reference (SURVEY.md §7 hard part 2 lists these as top-1
+parity hazards; every knob is in DataConfig). A native C++ decode pipeline
+(native/) can replace the tf.data decode stage; a synthetic dataset serves
+integration tests and throughput benches.
+
+Per-host sharding: each process reads a disjoint shard slice
+(jax.process_index), yielding its local_batch rows; parallel/mesh.shard_batch
+assembles the global array (SURVEY.md §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+from ..config import DataConfig
+
+# tf is imported lazily: the heavy import (and its thread pools) should only
+# exist in processes that actually build an input pipeline.
+_tf = None
+
+
+def _tf_mod():
+    global _tf
+    if _tf is None:
+        import tensorflow as tf
+
+        tf.config.set_visible_devices([], "GPU")
+        tf.config.set_visible_devices([], "TPU")
+        _tf = tf
+    return _tf
+
+
+# ---------------------------------------------------------------------------
+# Decode + augment (tf graph functions)
+# ---------------------------------------------------------------------------
+
+
+def _decode_and_random_crop(tf, image_bytes, cfg: DataConfig):
+    """Inception-style random-resized-crop, the reference's train transform."""
+    shape = tf.io.extract_jpeg_shape(image_bytes)
+    bbox = tf.constant([0.0, 0.0, 1.0, 1.0], dtype=tf.float32, shape=[1, 1, 4])
+    begin, size, _ = tf.image.sample_distorted_bounding_box(
+        shape,
+        bounding_boxes=bbox,
+        min_object_covered=0.1,
+        aspect_ratio_range=(cfg.rrc_ratio_min, cfg.rrc_ratio_max),
+        area_range=(cfg.rrc_area_min, cfg.rrc_area_max),
+        max_attempts=10,
+        use_image_if_no_bounding_boxes=True,
+    )
+    offset_y, offset_x, _ = tf.unstack(begin)
+    target_h, target_w, _ = tf.unstack(size)
+    crop_window = tf.stack([offset_y, offset_x, target_h, target_w])
+    image = tf.image.decode_and_crop_jpeg(image_bytes, crop_window, channels=3)
+    image = tf.image.resize(image, [cfg.image_size, cfg.image_size], method="bilinear")
+    return image
+
+
+def _decode_center_crop(tf, image_bytes, cfg: DataConfig):
+    """Eval: resize shorter side to eval_resize, center-crop image_size
+    (reference: Resize(256)/CenterCrop(224), SURVEY.md §3.3)."""
+    shape = tf.io.extract_jpeg_shape(image_bytes)
+    h, w = shape[0], shape[1]
+    ratio = tf.cast(cfg.eval_resize, tf.float32) / tf.cast(tf.minimum(h, w), tf.float32)
+    rh = tf.cast(tf.round(tf.cast(h, tf.float32) * ratio), tf.int32)
+    rw = tf.cast(tf.round(tf.cast(w, tf.float32) * ratio), tf.int32)
+    image = tf.image.decode_jpeg(image_bytes, channels=3)
+    image = tf.image.resize(image, [rh, rw], method="bilinear")
+    top = (rh - cfg.image_size) // 2
+    left = (rw - cfg.image_size) // 2
+    return tf.image.crop_to_bounding_box(image, top, left, cfg.image_size, cfg.image_size)
+
+
+def _color_jitter(tf, image, strength: float):
+    image = tf.image.random_brightness(image, max_delta=strength)
+    image = tf.image.random_contrast(image, 1.0 - strength, 1.0 + strength)
+    image = tf.image.random_saturation(image, 1.0 - strength, 1.0 + strength)
+    return image
+
+
+def _normalize(tf, image, cfg: DataConfig):
+    image = tf.cast(image, tf.float32) / 255.0
+    mean = tf.constant(cfg.mean, dtype=tf.float32)
+    std = tf.constant(cfg.std, dtype=tf.float32)
+    return (image - mean) / std
+
+
+def _parse_example(tf, serialized):
+    features = {
+        "image/encoded": tf.io.FixedLenFeature([], tf.string),
+        "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+    }
+    parsed = tf.io.parse_single_example(serialized, features)
+    # TFRecord ImageNet convention stores labels 1..1000; 0 is background
+    label = tf.cast(parsed["image/class/label"], tf.int32) - 1
+    return parsed["image/encoded"], label
+
+
+# ---------------------------------------------------------------------------
+# Datasets
+# ---------------------------------------------------------------------------
+
+
+def _tfrecord_files(cfg: DataConfig, split: str) -> list[str]:
+    pattern = os.path.join(cfg.data_dir, f"{split}-*")
+    import glob
+
+    files = sorted(glob.glob(pattern))
+    if not files:
+        raise FileNotFoundError(f"no TFRecord shards matching {pattern}")
+    return files
+
+
+def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1):
+    tf = _tf_mod()
+    if cfg.dataset == "fake":
+        return _fake_dataset(cfg, local_batch, seed, train=True)
+    files = _tfrecord_files(cfg, cfg.train_split)
+    ds = tf.data.Dataset.from_tensor_slices(files)
+    ds = ds.shard(process_count, process_index)
+    ds = ds.shuffle(len(files), seed=seed, reshuffle_each_iteration=True)
+    ds = ds.interleave(
+        lambda f: tf.data.TFRecordDataset(f, buffer_size=16 * 1024 * 1024),
+        cycle_length=cfg.decode_threads,
+        num_parallel_calls=tf.data.AUTOTUNE,
+        deterministic=False,
+    )
+    ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
+    ds = ds.repeat()
+
+    def map_fn(serialized):
+        image_bytes, label = _parse_example(tf, serialized)
+        image = _decode_and_random_crop(tf, image_bytes, cfg)
+        image = tf.image.random_flip_left_right(image)
+        if cfg.color_jitter > 0:
+            image = _color_jitter(tf, image, cfg.color_jitter)
+        image = _normalize(tf, image, cfg)
+        image.set_shape([cfg.image_size, cfg.image_size, 3])
+        return {"image": image, "label": label}
+
+    ds = ds.map(map_fn, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(local_batch, drop_remainder=True)
+    ds = ds.prefetch(cfg.prefetch)
+    return ds
+
+
+def eval_batches_per_host(cfg: DataConfig, local_batch: int, process_count: int = 1) -> int:
+    """Fixed number of eval batches EVERY host must run. The eval step is a
+    collective program: if hosts ran different batch counts the stragglers
+    would deadlock in the all-reduce, so each host pads its finite stream up
+    to this count (derived from the declared eval set size, the only number
+    all hosts agree on without communicating)."""
+    n = cfg.fake_eval_size if cfg.dataset == "fake" else cfg.num_eval_examples
+    per_host = -(-n // process_count)  # ceil
+    return max(-(-per_host // local_batch), 1)
+
+
+def make_eval_dataset(cfg: DataConfig, local_batch: int, process_index: int = 0, process_count: int = 1):
+    """Finite, exactly eval_batches_per_host batches on every host; the tail
+    (and any all-dummy equalization batches) is padded with label=-1, which
+    the eval step masks out so each example counts exactly once."""
+    tf = _tf_mod()
+    target = eval_batches_per_host(cfg, local_batch, process_count)
+    if cfg.dataset == "fake":
+        ds = _fake_dataset(cfg, local_batch, seed=0, train=False)
+    else:
+        files = _tfrecord_files(cfg, cfg.val_split)
+        ds = tf.data.Dataset.from_tensor_slices(files)
+        ds = ds.interleave(tf.data.TFRecordDataset, cycle_length=4, num_parallel_calls=tf.data.AUTOTUNE)
+        # record-level sharding: per-host example counts differ by at most 1
+        # (file-level sharding can differ by whole shards — or leave a host
+        # with zero files when process_count > len(files))
+        ds = ds.shard(process_count, process_index)
+
+        def map_fn(serialized):
+            image_bytes, label = _parse_example(tf, serialized)
+            image = _decode_center_crop(tf, image_bytes, cfg)
+            image = _normalize(tf, image, cfg)
+            image.set_shape([cfg.image_size, cfg.image_size, 3])
+            return {"image": image, "label": label}
+
+        ds = ds.map(map_fn, num_parallel_calls=tf.data.AUTOTUNE)
+        ds = ds.batch(local_batch, drop_remainder=False)
+        ds = ds.map(lambda b: _pad_batch(tf, b, local_batch))
+    # equalize: append all-dummy batches, then cut to the agreed count
+    dummy = tf.data.Dataset.from_tensors({
+        "image": tf.zeros([local_batch, cfg.image_size, cfg.image_size, 3], tf.float32),
+        "label": -tf.ones([local_batch], tf.int32),
+    }).repeat(target)
+    ds = ds.concatenate(dummy).take(target)
+    return ds.prefetch(cfg.prefetch)
+
+
+def _pad_batch(tf, batch, local_batch):
+    n = tf.shape(batch["label"])[0]
+    pad = local_batch - n
+
+    def pad_t(t):
+        padding = [[0, pad]] + [[0, 0]] * (len(t.shape) - 1)
+        return tf.pad(t, padding)
+
+    return {
+        "image": pad_t(batch["image"]),
+        "label": tf.concat([batch["label"], -tf.ones([pad], tf.int32)], 0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Fake data (integration tests / benches without ImageNet)
+# ---------------------------------------------------------------------------
+
+
+def _fake_dataset(cfg: DataConfig, local_batch: int, seed: int, train: bool):
+    """Learnable synthetic classification: each class has a fixed random
+    template; samples are noisy copies. A real model reaches high accuracy in
+    a few epochs — which is what the loss-decreases integration tests need
+    (SURVEY.md §4.3)."""
+    tf = _tf_mod()
+    n_classes = cfg.fake_num_classes or 1000
+    n = cfg.fake_train_size if train else cfg.fake_eval_size
+    # Class templates are SHARED between train and eval (fixed seed) — only
+    # the per-sample noise differs — otherwise eval measures an unlearnable
+    # disjoint task and stays at chance forever.
+    rng = np.random.RandomState(777)
+    templates = rng.normal(0, 1, (n_classes, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    labels = (np.arange(n) % n_classes).astype(np.int32)
+    noise_rng = np.random.RandomState(seed + 1 if train else 987654)
+    images = templates[labels] + 0.3 * noise_rng.normal(0, 1, (n, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    ds = tf.data.Dataset.from_tensor_slices({"image": images, "label": labels})
+    if train:
+        ds = ds.shuffle(n, seed=seed).repeat()
+        ds = ds.batch(local_batch, drop_remainder=True)
+    else:
+        ds = ds.batch(local_batch, drop_remainder=False)
+        ds = ds.map(lambda b: _pad_batch(tf, b, local_batch))
+    return ds.prefetch(2)
+
+
+# ---------------------------------------------------------------------------
+# numpy iterators
+# ---------------------------------------------------------------------------
+
+
+def as_numpy(ds) -> Iterator[dict]:
+    for batch in ds.as_numpy_iterator():
+        yield batch
+
+
+def synthetic_device_batches(cfg: DataConfig, local_batch: int, num_classes: int) -> Iterator[dict]:
+    """Pure on-device batches (no host pipeline at all) — isolates model
+    throughput from input throughput in benches."""
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": rng.normal(0, 1, (local_batch, cfg.image_size, cfg.image_size, 3)).astype(np.float32),
+        "label": (np.arange(local_batch) % num_classes).astype(np.int32),
+    }
+    while True:
+        yield batch

@@ -35,6 +35,15 @@ class TrainState:
     masks: Any  # {} when pruning disabled; {block_idx(str): (expanded,)} else
 
 
+# single source of truth for the checkpoint tree layout (ckpt/manager.py and
+# resume both build from this; adding a TrainState field updates every site)
+TRAIN_STATE_FIELDS = ("step", "params", "state", "opt_state", "ema_params", "ema_state", "masks")
+
+
+def train_state_to_dict(ts: TrainState) -> dict:
+    return {k: getattr(ts, k) for k in TRAIN_STATE_FIELDS}
+
+
 def init_train_state(net: Network, cfg: Config, optimizer: optax.GradientTransformation, rng) -> TrainState:
     params, state = net.init(rng)
     opt_state = optimizer.init(params)

@@ -1,0 +1,34 @@
+"""Structured stdout + TensorBoard logging on the coordinator only
+(reference: master-only logging + TB scalars, SURVEY.md §5 observability)."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class Logger:
+    def __init__(self, log_dir: str | None = None, enabled: bool = True, tensorboard: bool = False):
+        self.enabled = enabled
+        self._tb = None
+        if enabled and tensorboard and log_dir:
+            import tensorflow as tf
+
+            self._tb = tf.summary.create_file_writer(log_dir)
+
+    def log(self, msg: str):
+        if self.enabled:
+            ts = time.strftime("%H:%M:%S")
+            print(f"[{ts}] {msg}", flush=True)
+
+    def scalars(self, step: int, metrics: dict, prefix: str = ""):
+        if self._tb is None:
+            return
+        import tensorflow as tf
+
+        with self._tb.as_default():
+            for k, v in metrics.items():
+                tf.summary.scalar(f"{prefix}{k}", float(v), step=step)
+
+    def error(self, msg: str):
+        print(f"ERROR: {msg}", file=sys.stderr, flush=True)
