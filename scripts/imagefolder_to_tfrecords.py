@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Dataset prep: ImageFolder tree -> TFRecord shards (reference: the LMDB
+build scripts, SURVEY.md §2 #15; TFRecord is the TPU-native storage per the
+native-dependency table in SURVEY.md §2).
+
+Writes shards with the standard ImageNet keys (image/encoded JPEG bytes,
+image/class/label 1-based) that data/pipeline.py reads.
+
+Usage:
+  python scripts/imagefolder_to_tfrecords.py --src /data/imagenet/train \
+      --dst /data/tfrecords --split train --shards 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--src", required=True, help="ImageFolder root (class subdirs)")
+    ap.add_argument("--dst", required=True)
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--shards", type=int, default=128)
+    args = ap.parse_args()
+
+    import tensorflow as tf
+
+    from yet_another_mobilenet_series_tpu.data.native_loader import list_image_folder
+
+    paths, labels, classes = list_image_folder(args.src)
+    print(f"{len(paths)} images, {len(classes)} classes -> {args.shards} shards")
+    os.makedirs(args.dst, exist_ok=True)
+
+    writers = [
+        tf.io.TFRecordWriter(os.path.join(args.dst, f"{args.split}-{i:05d}-of-{args.shards:05d}"))
+        for i in range(args.shards)
+    ]
+    for i, (p, l) in enumerate(zip(paths, labels)):
+        with open(p, "rb") as f:
+            data = f.read()
+        ex = tf.train.Example(features=tf.train.Features(feature={
+            "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(value=[data])),
+            # 1-based labels: 0 is the background class in the ImageNet
+            # TFRecord convention (data/pipeline.py subtracts 1)
+            "image/class/label": tf.train.Feature(int64_list=tf.train.Int64List(value=[l + 1])),
+        }))
+        writers[i % args.shards].write(ex.SerializeToString())
+        if (i + 1) % 10000 == 0:
+            print(f"  {i + 1}/{len(paths)}")
+    for w in writers:
+        w.close()
+    with open(os.path.join(args.dst, f"{args.split}-classes.txt"), "w") as f:
+        f.write("\n".join(classes))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Single-host launcher (reference: scripts/*.sh wrapping
+# torch.distributed.launch, SURVEY.md §2 #15). On TPU there is one process
+# per HOST, not per chip — the in-process mesh covers all local chips.
+#
+# Usage: scripts/train.sh apps/mobilenet_v3_large.yml [key=value ...]
+set -euo pipefail
+APP=${1:?usage: train.sh <app.yml> [overrides...]}
+shift
+exec python -m yet_another_mobilenet_series_tpu.cli.train "app:${APP}" "$@"

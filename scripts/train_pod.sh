@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher (reference: the multi-node
+# torch.distributed.launch + MASTER_ADDR/PORT path, SURVEY.md §2 #15).
+#
+# Run THIS SAME command on every host of the pod slice (e.g. via
+# `gcloud compute tpus tpu-vm ssh $TPU --worker=all --command=...`).
+# jax.distributed.initialize() (enabled by dist.multihost=true) discovers the
+# coordinator from the TPU metadata — no MASTER_ADDR plumbing needed; that is
+# the env:// rendezvous equivalent.
+#
+# Usage: scripts/train_pod.sh apps/atomnas_c_se.yml [key=value ...]
+set -euo pipefail
+APP=${1:?usage: train_pod.sh <app.yml> [overrides...]}
+shift
+exec python -m yet_another_mobilenet_series_tpu.cli.train "app:${APP}" dist.multihost=true "$@"

@@ -1,0 +1,103 @@
+"""Input pipeline tests: TFRecord round-trip through the prep script, train/
+eval transforms, padding/equalization semantics (SURVEY.md §4.3)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from yet_another_mobilenet_series_tpu.config import DataConfig
+from yet_another_mobilenet_series_tpu.data import pipeline as data_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tfrecord_dir(tmp_path_factory):
+    from PIL import Image
+
+    src = tmp_path_factory.mktemp("imgfolder")
+    rng = np.random.RandomState(0)
+    for c, color in enumerate([(220, 30, 30), (30, 220, 30), (30, 30, 220)]):
+        d = src / f"class_{c}"
+        d.mkdir()
+        for i in range(8):
+            arr = np.clip(np.asarray(color)[None, None, :] + rng.normal(0, 20, (70, 90, 3)), 0, 255)
+            Image.fromarray(arr.astype(np.uint8)).save(d / f"im{i}.jpg", quality=92)
+    dst = tmp_path_factory.mktemp("tfr")
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "imagefolder_to_tfrecords.py"),
+         "--src", str(src), "--dst", str(dst), "--split", "validation", "--shards", "2"],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "imagefolder_to_tfrecords.py"),
+         "--src", str(src), "--dst", str(dst), "--split", "train", "--shards", "2"],
+        check=True, capture_output=True,
+    )
+    return str(dst)
+
+
+def _cfg(tfrecord_dir, **over):
+    kw = dict(
+        dataset="imagenet", data_dir=tfrecord_dir, image_size=32, eval_resize=36,
+        num_eval_examples=24, shuffle_buffer=64,
+    )
+    kw.update(over)
+    return DataConfig(**kw)
+
+
+def test_eval_tfrecords_every_example_once(tfrecord_dir):
+    cfg = _cfg(tfrecord_dir)
+    ds = data_lib.make_eval_dataset(cfg, local_batch=10)
+    batches = list(data_lib.as_numpy(ds))
+    assert len(batches) == data_lib.eval_batches_per_host(cfg, 10)  # 24 -> 3 batches
+    labels = np.concatenate([b["label"] for b in batches])
+    valid = labels[labels >= 0]
+    assert len(valid) == 24
+    assert sorted(np.bincount(valid).tolist()) == [8, 8, 8]
+    imgs = np.concatenate([b["image"] for b in batches])
+    assert imgs.shape == (30, 32, 32, 3)
+    # normalized: solid-ish colors -> bounded values, non-constant
+    assert np.isfinite(imgs).all() and imgs.std() > 0.1
+
+
+def test_eval_equalization_pads_all_dummy_batches(tfrecord_dir):
+    cfg = _cfg(tfrecord_dir, num_eval_examples=50)  # declared > actual
+    ds = data_lib.make_eval_dataset(cfg, local_batch=10)
+    batches = list(data_lib.as_numpy(ds))
+    assert len(batches) == 5  # fixed count from the declared size
+    labels = np.concatenate([b["label"] for b in batches])
+    assert (labels >= 0).sum() == 24  # real examples still counted once
+
+
+def test_train_tfrecords_stream_and_augment(tfrecord_dir):
+    cfg = _cfg(tfrecord_dir)
+    ds = data_lib.make_train_dataset(cfg, local_batch=6, seed=0)
+    it = data_lib.as_numpy(ds)
+    b1 = next(it)
+    b2 = next(it)
+    assert b1["image"].shape == (6, 32, 32, 3)
+    assert set(np.concatenate([b1["label"], b2["label"]]).tolist()) <= {0, 1, 2}
+    # infinite stream: can pull more batches than the dataset holds
+    for _ in range(8):
+        next(it)
+
+
+def test_fake_dataset_train_eval_share_templates():
+    cfg = DataConfig(dataset="fake", image_size=16, fake_num_classes=4, fake_train_size=32, fake_eval_size=16)
+    tr = next(data_lib.as_numpy(data_lib.make_train_dataset(cfg, 8, seed=0)))
+    ev = next(data_lib.as_numpy(data_lib.make_eval_dataset(cfg, 8)))
+    assert tr["image"].shape == (8, 16, 16, 3) and ev["image"].shape == (8, 16, 16, 3)
+    # same class template underneath (noise differs): same-class means correlate
+    t0 = tr["image"][tr["label"] == 0].mean(axis=0).ravel()
+    e0 = ev["image"][ev["label"] == 0].mean(axis=0).ravel()
+    assert np.corrcoef(t0, e0)[0, 1] > 0.7
+
+
+def test_missing_tfrecords_clear_error(tmp_path):
+    cfg = DataConfig(dataset="imagenet", data_dir=str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        data_lib.make_train_dataset(cfg, 8, seed=0)

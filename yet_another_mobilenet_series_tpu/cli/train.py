@@ -19,7 +19,7 @@ import numpy as np
 
 from ..ckpt.manager import CheckpointManager
 from ..config import Config, parse_cli
-from ..data import pipeline as data_lib
+from .. import data as data_lib
 from ..models import get_model
 from ..models.specs import Network
 from ..nas import masking, penalty, rematerialize
@@ -93,9 +93,9 @@ def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=Tru
     # label=-1 and are masked out of every count)
     n_dev = trainer.mesh.size
     local_eval = -(-cfg.train.eval_batch_size // n_dev) * n_dev
-    ds = data_lib.make_eval_dataset(cfg.data, local_eval, jax.process_index(), jax.process_count())
+    batches = data_lib.make_eval_source(cfg.data, local_eval, jax.process_index(), jax.process_count())
     totals = {"top1": 0.0, "top5": 0.0, "n": 0.0, "loss_sum": 0.0}
-    for batch in data_lib.as_numpy(ds):
+    for batch in batches:
         b = mesh_lib.shard_batch(batch, trainer.mesh)
         m = trainer.eval_step(params, state, b, ts.masks)
         for k in totals:
@@ -133,6 +133,10 @@ def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
 def run(cfg: Config) -> dict:
     import dataclasses as dc
 
+    if cfg.dist.multihost:
+        # multi-host rendezvous: the reference's torch.distributed env://
+        # init; on TPU pods the coordinator/process env is auto-discovered.
+        jax.distributed.initialize()
     if cfg.data.dataset == "fake" and cfg.data.fake_num_classes is None:
         cfg = dc.replace(cfg, data=dc.replace(cfg.data, fake_num_classes=cfg.model.num_classes))
     is_coord = mesh_lib.is_coordinator()
@@ -175,10 +179,9 @@ def run(cfg: Config) -> dict:
         ts = trainer.init_state(rng)
 
     local_batch = mesh_lib.local_batch_slice(cfg.train.batch_size, mesh)
-    train_ds = data_lib.make_train_dataset(
+    train_iter = data_lib.make_train_source(
         cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count()
     )
-    train_iter = data_lib.as_numpy(train_ds)
 
     total_epochs = cfg.train.epochs
     spe = trainer.steps_per_epoch
@@ -187,57 +190,75 @@ def run(cfg: Config) -> dict:
     eval_result: dict = {}
     epoch = start_epoch
     host_step = int(ts.step)  # one sync at (re)start, then host-side counting
+    trace_active = False
 
-    while epoch < total_epochs:
-        epoch_steps = min(spe, max(int((total_epochs - epoch) * spe), 1))
-        t_epoch = time.perf_counter()
-        for _ in range(epoch_steps):
-            batch = next(train_iter)
-            b = mesh_lib.shard_batch(batch, trainer.mesh)
-            ts, metrics = trainer.train_step(ts, b, rng)
-            # host-side counter: int(ts.step) would sync the host with the
-            # device every step and stall async dispatch
-            host_step += 1
-            step_i = host_step
-            metric_log.update(metrics, batch_images=cfg.train.batch_size)
+    try:
+        while epoch < total_epochs:
+            epoch_steps = min(spe, max(int((total_epochs - epoch) * spe), 1))
+            t_epoch = time.perf_counter()
+            for _ in range(epoch_steps):
+                batch = next(train_iter)
+                b = mesh_lib.shard_batch(batch, trainer.mesh)
+                ts, metrics = trainer.train_step(ts, b, rng)
+                # host-side counter: int(ts.step) would sync the host with the
+                # device every step and stall async dispatch
+                host_step += 1
+                step_i = host_step
+                metric_log.update(metrics, batch_images=cfg.train.batch_size)
 
-            if cfg.prune.enable and trainer.mask_update is not None and step_i % cfg.prune.mask_interval == 0:
-                if step_i <= prune_stop_step:
-                    summary = masking.mask_summary(trainer.net, ts.masks)
-                    if not (cfg.prune.target_flops and summary["effective_macs"] <= cfg.prune.target_flops):
-                        ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
+                if cfg.train.profile_start_step and is_coord:
+                    if step_i == cfg.train.profile_start_step:
+                        jax.profiler.start_trace(cfg.train.log_dir + "/trace")
+                        trace_active = True
+                    elif trace_active and step_i >= cfg.train.profile_start_step + cfg.train.profile_num_steps:
+                        jax.block_until_ready(metrics["loss"])
+                        jax.profiler.stop_trace()
+                        trace_active = False
+                        log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
 
-            if step_i % cfg.train.log_every == 0:
-                snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
-                if cfg.prune.enable:
-                    snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
-                log.log(format_metrics(f"step {step_i}:", snap))
-                log.scalars(step_i, snap, "train/")
-                if snap.get("finite", 1.0) < 1.0:
-                    log.error("non-finite loss detected; aborting")
-                    raise FloatingPointError("non-finite loss")
-            if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
-                div = float(trainer.sync_check(ts.params))
-                if div != 0.0:
-                    log.error(f"replica divergence {div} at step {step_i}")
-                    raise RuntimeError("replica divergence")
-        epoch += epoch_steps / spe
-        log.log(f"epoch {epoch:.2f} done in {time.perf_counter()-t_epoch:.1f}s")
+                if cfg.prune.enable and trainer.mask_update is not None and step_i % cfg.prune.mask_interval == 0:
+                    if step_i <= prune_stop_step:
+                        summary = masking.mask_summary(trainer.net, ts.masks)
+                        if not (cfg.prune.target_flops and summary["effective_macs"] <= cfg.prune.target_flops):
+                            ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
 
-        # coarse-cadence physical shrink (recompile paid here, not per-step)
-        if cfg.prune.enable and cfg.prune.remat_epochs > 0 and (int(epoch) % max(int(cfg.prune.remat_epochs), 1) == 0):
-            trainer, ts = _maybe_rematerialize(trainer, ts, log)
+                if step_i % cfg.train.log_every == 0:
+                    snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
+                    if cfg.prune.enable:
+                        snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
+                    log.log(format_metrics(f"step {step_i}:", snap))
+                    log.scalars(step_i, snap, "train/")
+                    if snap.get("finite", 1.0) < 1.0:
+                        log.error("non-finite loss detected; aborting")
+                        raise FloatingPointError("non-finite loss")
+                if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
+                    div = float(trainer.sync_check(ts.params))
+                    if div != 0.0:
+                        log.error(f"replica divergence {div} at step {step_i}")
+                        raise RuntimeError("replica divergence")
+            epoch += epoch_steps / spe
+            log.log(f"epoch {epoch:.2f} done in {time.perf_counter()-t_epoch:.1f}s")
 
-        if cfg.train.eval_every_epochs and (epoch % cfg.train.eval_every_epochs) < 1e-6 or epoch >= total_epochs:
-            eval_result = evaluate(trainer, ts, cfg)
-            log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
-            log.scalars(int(ts.step), eval_result, "eval/")
+            # coarse-cadence physical shrink (recompile paid here, not per-step)
+            if cfg.prune.enable and cfg.prune.remat_epochs > 0 and (int(epoch) % max(int(cfg.prune.remat_epochs), 1) == 0):
+                trainer, ts = _maybe_rematerialize(trainer, ts, log)
 
-        if cfg.train.checkpoint_every_epochs and (
-            (epoch % cfg.train.checkpoint_every_epochs) < 1e-6 or epoch >= total_epochs
-        ):
-            # orbax coordinates multi-host saves internally; every process calls in
-            ckpt.save(int(ts.step), trainer.net, jax.device_get(ts), extra={"epoch": epoch})
+            if cfg.train.eval_every_epochs and (epoch % cfg.train.eval_every_epochs) < 1e-6 or epoch >= total_epochs:
+                eval_result = evaluate(trainer, ts, cfg)
+                log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
+                log.scalars(int(ts.step), eval_result, "eval/")
+
+            if cfg.train.checkpoint_every_epochs and (
+                (epoch % cfg.train.checkpoint_every_epochs) < 1e-6 or epoch >= total_epochs
+            ):
+                # orbax coordinates multi-host saves internally; every process calls in
+                ckpt.save(int(ts.step), trainer.net, jax.device_get(ts), extra={"epoch": epoch})
+
+    finally:
+        if trace_active:
+            # training ended (or raised) inside the capture window:
+            # flush the trace rather than losing it
+            jax.profiler.stop_trace()
 
     ckpt.wait()
     ckpt.close()

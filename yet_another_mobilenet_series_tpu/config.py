@@ -196,12 +196,19 @@ class TrainConfig:
     # debug guards (SURVEY.md §5 race-detection analogue)
     check_finite_every: int = 0  # 0 = off
     param_checksum_every: int = 0  # cross-replica divergence check, 0 = off
+    # jax.profiler trace capture (SURVEY.md §5 tracing): start at this step
+    # for profile_num_steps steps; trace lands in log_dir/trace. 0 = off.
+    profile_start_step: int = 0
+    profile_num_steps: int = 5
 
 
 @dataclass(frozen=True)
 class DistConfig:
     # number of data-parallel shards; 0 = use all visible devices
     num_devices: int = 0
+    # call jax.distributed.initialize() at startup (multi-host pods; the
+    # torch.distributed.launch/env:// rendezvous equivalent, SURVEY.md §2 #12)
+    multihost: bool = False
     sync_bn: bool = True
     # ZeRO-style cross-replica sharded weight update (PAPERS.md:5); optional.
     shard_optimizer: bool = False

@@ -1,0 +1,52 @@
+"""Input pipelines: tf.data (TFRecord/fake) and the native C++ loader.
+
+make_train_source / make_eval_source are the single dispatch point for which
+pipeline feeds the trainer — keyed on (dataset, loader) with invalid
+combinations rejected up front, so the train and eval halves of a run can
+never pick incompatible pipelines.
+
+Valid combinations:
+  dataset=imagenet + loader=tfdata   -> TFRecord shards via tf.data
+  dataset=fake     + loader=tfdata   -> synthetic learnable data
+  dataset=folder   + loader=native   -> ImageFolder tree via native/ C++
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ..config import DataConfig
+from . import pipeline as _pipeline
+
+
+def _check(cfg: DataConfig) -> None:
+    ok = {("imagenet", "tfdata"), ("fake", "tfdata"), ("folder", "native"), ("fake", "synthetic")}
+    if (cfg.dataset, cfg.loader) not in ok:
+        raise ValueError(
+            f"unsupported data config: dataset={cfg.dataset!r} loader={cfg.loader!r}; valid: {sorted(ok)}"
+        )
+
+
+def make_train_source(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1) -> Iterator[dict]:
+    """Infinite iterator of {'image','label'} numpy batches (this host's shard)."""
+    _check(cfg)
+    if cfg.loader == "native":
+        from . import native_loader
+
+        return iter(native_loader.make_native_train_iter(cfg, local_batch, seed, process_index, process_count))
+    if cfg.loader == "synthetic":
+        return _pipeline.synthetic_device_batches(cfg, local_batch, cfg.fake_num_classes or 1000)
+    ds = _pipeline.make_train_dataset(cfg, local_batch, seed, process_index, process_count)
+    return _pipeline.as_numpy(ds)
+
+
+def make_eval_source(cfg: DataConfig, local_batch: int, process_index: int = 0, process_count: int = 1) -> Iterator[dict]:
+    """Finite iterator for one eval pass; identical batch count on every host."""
+    _check(cfg)
+    if cfg.loader == "native":
+        from . import native_loader
+
+        loader, n_batches = native_loader.make_native_eval_loader(cfg, local_batch, process_index, process_count)
+        return (loader.next_batch() for _ in range(n_batches))
+    ds = _pipeline.make_eval_dataset(cfg, local_batch, process_index, process_count)
+    return _pipeline.as_numpy(ds)

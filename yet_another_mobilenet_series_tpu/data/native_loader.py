@@ -1,0 +1,183 @@
+"""ctypes binding for the native C++ input pipeline (native/yamt_loader.cc)
+— the DALI-replacement decode+augment path (SURVEY.md §2 #6 native table).
+
+Covers ImageFolder-style directory trees (the reference's torchvision
+fallback): ``root/<class_name>/<image>.jpg``, classes sorted
+lexicographically to indices — plus explicit (path, label) lists. Yields the
+same {'image','label'} numpy batches as the tf.data pipeline, so the trainer
+is agnostic to which pipeline feeds it (cfg.data.loader == 'native').
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from ..config import DataConfig
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native", "libyamt_loader.so")
+_lib = None
+
+
+def build_library(force: bool = False) -> str:
+    """Compiles native/libyamt_loader.so if missing (g++ + libjpeg)."""
+    if force or not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)], check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(build_library())
+    lib.loader_create.restype = ctypes.c_void_p
+    lib.loader_create.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+    ]
+    lib.loader_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+    lib.loader_start.argtypes = [ctypes.c_void_p]
+    lib.loader_start.restype = ctypes.c_int
+    lib.loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
+    lib.loader_next.restype = ctypes.c_int
+    lib.loader_num_samples.argtypes = [ctypes.c_void_p]
+    lib.loader_num_samples.restype = ctypes.c_int64
+    lib.loader_decode_failures.argtypes = [ctypes.c_void_p]
+    lib.loader_decode_failures.restype = ctypes.c_int64
+    lib.loader_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def list_image_folder(root: str) -> tuple[list[str], list[int], list[str]]:
+    """(paths, labels, class_names) for a root/<class>/<img>.jpg tree."""
+    classes = sorted(d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise FileNotFoundError(f"no class directories under {root}")
+    paths: list[str] = []
+    labels: list[int] = []
+    for idx, c in enumerate(classes):
+        cdir = os.path.join(root, c)
+        for f in sorted(os.listdir(cdir)):
+            if f.lower().endswith((".jpg", ".jpeg")):
+                paths.append(os.path.join(cdir, f))
+                labels.append(idx)
+    return paths, labels, classes
+
+
+class NativeLoader:
+    """Iterator over decoded/augmented batches from the C++ pipeline.
+
+    Streams epochs continuously (train semantics; eval order is file order
+    with a fresh pass every num_samples//batch batches, remainder dropped).
+    The ring prefetches ahead, so the first batches of the next epoch may
+    already be decoding while the current one is consumed."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        labels: Sequence[int],
+        cfg: DataConfig,
+        batch: int,
+        *,
+        train: bool,
+        seed: int = 0,
+        num_threads: int | None = None,
+    ):
+        lib = _load()
+        mean = (ctypes.c_float * 3)(*cfg.mean)
+        std = (ctypes.c_float * 3)(*cfg.std)
+        self._lib = lib
+        self._batch = batch
+        self._size = cfg.image_size
+        self._handle = lib.loader_create(
+            cfg.image_size, cfg.eval_resize, batch,
+            num_threads or cfg.decode_threads, int(train), seed, mean, std,
+            cfg.rrc_area_min, cfg.rrc_area_max, cfg.rrc_ratio_min, cfg.rrc_ratio_max,
+        )
+        for p, l in zip(paths, labels):
+            lib.loader_add_file(self._handle, os.fsencode(p), int(l))
+        if lib.loader_start(self._handle) != 0:
+            lib.loader_destroy(self._handle)
+            self._handle = None
+            raise ValueError(f"need at least one full batch of samples ({batch}); got {len(paths)}")
+
+    @property
+    def num_samples(self) -> int:
+        return int(self._lib.loader_num_samples(self._handle))
+
+    @property
+    def decode_failures(self) -> int:
+        return int(self._lib.loader_decode_failures(self._handle))
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next_batch()
+
+    def next_batch(self) -> dict:
+        images = np.empty((self._batch, self._size, self._size, 3), np.float32)
+        labels = np.empty((self._batch,), np.int32)
+        rc = self._lib.loader_next(
+            self._handle,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc != 0:
+            raise StopIteration
+        return {"image": images, "label": labels}
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def _host_shard(paths, labels, process_index: int, process_count: int):
+    """Disjoint per-host slice (the tf.data path's ds.shard equivalent —
+    without it every host would decode the identical stream and global
+    batches would hold process_count duplicates of each sample)."""
+    return paths[process_index::process_count], labels[process_index::process_count]
+
+
+def make_native_train_iter(
+    cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1
+) -> NativeLoader:
+    paths, labels, _ = list_image_folder(os.path.join(cfg.data_dir, cfg.train_split))
+    paths, labels = _host_shard(paths, labels, process_index, process_count)
+    # per-host seed offset decorrelates shuffle order across hosts
+    return NativeLoader(paths, labels, cfg, local_batch, train=True, seed=seed + process_index)
+
+
+def make_native_eval_loader(
+    cfg: DataConfig, local_batch: int, process_index: int = 0, process_count: int = 1
+) -> tuple[NativeLoader, int]:
+    """Returns (loader, num_batches) for one eval pass over this host's
+    shard. num_batches is computed from the SMALLEST host shard so every
+    host runs the same number of collective eval steps (no deadlock); the
+    native path additionally drops each shard's tail remainder — use the
+    tf.data eval pipeline when exact every-example-once counting matters."""
+    paths, labels, _ = list_image_folder(os.path.join(cfg.data_dir, cfg.val_split))
+    total = len(paths)
+    paths, labels = _host_shard(paths, labels, process_index, process_count)
+    loader = NativeLoader(paths, labels, cfg, local_batch, train=False)
+    min_shard = total // process_count  # smallest host shard size
+    return loader, min_shard // local_batch
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--build" in sys.argv:
+        print(build_library(force=True))

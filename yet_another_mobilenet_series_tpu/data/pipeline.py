@@ -112,7 +112,9 @@ def _parse_example(tf, serialized):
 
 
 def _tfrecord_files(cfg: DataConfig, split: str) -> list[str]:
-    pattern = os.path.join(cfg.data_dir, f"{split}-*")
+    # shard names are {split}-00000-of-00128; the -of- keeps sidecars like
+    # {split}-classes.txt out of the match
+    pattern = os.path.join(cfg.data_dir, f"{split}-*-of-*")
     import glob
 
     files = sorted(glob.glob(pattern))
