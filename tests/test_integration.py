@@ -79,8 +79,12 @@ def test_eval_only_with_pretrained(tmp_path):
     np.testing.assert_allclose(result["top1"], trained["eval_top1"], atol=1e-6)
 
 
-def test_atomnas_search_shrinks_and_resumes(tmp_path, capsys):
+@pytest.mark.parametrize("zero", [False, True], ids=["replicated", "zero"])
+def test_atomnas_search_shrinks_and_resumes(tmp_path, capsys, zero):
     over = {
+        # zero=True exercises the shipped atomnas_c_se combination: remat must
+        # gather the ZeRO shards before slicing and re-scatter after
+        "dist.shard_optimizer": zero,
         "model.arch": "atomnas_supernet",
         "model.block_specs": [
             {"t": 6, "c": 16, "n": 2, "s": 2, "k": [3, 5, 7]},
@@ -100,6 +104,10 @@ def test_atomnas_search_shrinks_and_resumes(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "penalty=" in out
     assert result["epoch"] == pytest.approx(2.0)
+    _check_resume(tmp_path, over, capsys)
+
+
+def _check_resume(tmp_path, over, capsys):
     # the saved spec sidecar must encode the (possibly pruned) live network
     metas = sorted(glob.glob(str(tmp_path) + "/ckpt/*/meta/*"))
     assert metas

@@ -50,25 +50,67 @@ class Trainer:
         self.lr_fn = schedules.make_lr_schedule(
             cfg.schedule, cfg.train.batch_size, self.steps_per_epoch, cfg.train.epochs
         )
-        params_example, _ = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
-        self.optimizer = optim.make_optimizer(cfg.optim, self.lr_fn, params_example)
+        self.params_example, _ = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
+        self.optimizer = optim.make_optimizer(cfg.optim, self.lr_fn, self.params_example)
         self.penalty_fn = penalty.make_penalty_fn(net, cfg.prune) if cfg.prune.enable else None
         self.train_step = dp.make_dp_train_step(
-            net, cfg, self.optimizer, self.lr_fn, mesh, penalty_fn=self.penalty_fn
+            net, cfg, self.optimizer, self.lr_fn, mesh,
+            penalty_fn=self.penalty_fn, params_example=self.params_example,
         )
         self.eval_step = dp.make_dp_eval_step(net, cfg, mesh)
         self.mask_update = jax.jit(masking.make_mask_update(net, cfg.prune)) if cfg.prune.enable else None
         self.sync_check = dp.make_replica_sync_check(mesh)
 
     def init_state(self, rng) -> steps.TrainState:
-        ts = steps.init_train_state(self.net, self.cfg, self.optimizer, rng)
+        zero_opt = self.cfg.dist.shard_optimizer
+        ts = steps.init_train_state(self.net, self.cfg, self.optimizer, rng, with_opt=not zero_opt)
         if self.cfg.prune.enable:
             ts = ts.replace(masks=masking.init_masks(self.net))
-        return mesh_lib.replicate(ts, self.mesh)
+        ts = mesh_lib.replicate(ts, self.mesh)
+        if zero_opt:
+            from ..parallel import zero
+
+            ts = ts.replace(opt_state=zero.init_opt_state(self.optimizer, ts.params, self.mesh))
+        return ts
 
     def abstract_state(self) -> steps.TrainState:
-        """Shape/dtype skeleton for checkpoint restore (ckpt phase 2)."""
-        return jax.eval_shape(lambda: self.init_state(jax.random.PRNGKey(0)))
+        """Shape/dtype skeleton of the CHECKPOINT format (ckpt phase 2).
+
+        Checkpoints always carry the optimizer state params-shaped and
+        replicated — even under ZeRO — so they are portable across chip
+        counts (train on 8 chips, resume on 256) and multi-host saves never
+        need a cross-host device_get. The flat sharded form exists only
+        inside the live mesh (parallel/zero.py)."""
+
+        def build():
+            ts = steps.init_train_state(self.net, self.cfg, self.optimizer, jax.random.PRNGKey(0))
+            if self.cfg.prune.enable:
+                ts = ts.replace(masks=masking.init_masks(self.net))
+            return ts
+
+        return jax.eval_shape(build)
+
+    def place_state(self, ts: steps.TrainState) -> steps.TrainState:
+        """Puts a checkpoint-format TrainState onto the mesh: everything
+        replicated; under ZeRO the params-shaped optimizer state is scattered
+        to this mesh's flat shards (any chip count)."""
+        if self.cfg.dist.shard_optimizer:
+            from ..parallel import zero
+
+            opt = ts.opt_state
+            ts = mesh_lib.replicate(ts.replace(opt_state=None), self.mesh)
+            return ts.replace(opt_state=zero.scatter_opt_state(opt, ts.params, self.mesh))
+        return mesh_lib.replicate(ts, self.mesh)
+
+    def checkpoint_view(self, ts: steps.TrainState) -> steps.TrainState:
+        """Converts a live TrainState to the checkpoint format (gathers the
+        ZeRO flat shards back to params-shaped; identity otherwise)."""
+        if self.cfg.dist.shard_optimizer:
+            from ..parallel import zero
+
+            gathered = jax.jit(zero.gather_opt_state)(ts.opt_state, ts.params)
+            return ts.replace(opt_state=gathered)
+        return ts
 
 
 def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
@@ -80,7 +122,7 @@ def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
     step, net, extra = spec
     trainer = Trainer(cfg, net, mesh, log)
     tree = ckpt.restore_tree(step, steps.train_state_to_dict(trainer.abstract_state()))
-    ts = mesh_lib.replicate(steps.TrainState(**tree), mesh)
+    ts = trainer.place_state(steps.TrainState(**tree))
     return trainer, ts, extra
 
 
@@ -111,7 +153,9 @@ def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
     summary = masking.mask_summary(trainer.net, ts.masks)
     if summary["alive_atoms"] == summary["total_atoms"]:
         return trainer, ts  # nothing died; skip the recompile
-    host_ts = jax.device_get(ts)
+    # checkpoint_view: remat's channel slicers need the optimizer state in
+    # params shape, not ZeRO's flat shards
+    host_ts = jax.device_get(trainer.checkpoint_view(ts))
     masks = {k: np.asarray(v) for k, v in host_ts.masks.items()}
     new_net, new_p, new_s, new_masks, extras, report = rematerialize.rematerialize(
         trainer.net, host_ts.params, host_ts.state, masks,
@@ -127,7 +171,7 @@ def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
         step=host_ts.step, params=new_p, state=new_s, opt_state=extras["opt_state"],
         ema_params=extras.get("ema_params"), ema_state=extras.get("ema_state"), masks=new_masks,
     )
-    return new_trainer, mesh_lib.replicate(new_ts, trainer.mesh)
+    return new_trainer, new_trainer.place_state(new_ts)
 
 
 def run(cfg: Config) -> dict:
@@ -251,8 +295,11 @@ def run(cfg: Config) -> dict:
             if cfg.train.checkpoint_every_epochs and (
                 (epoch % cfg.train.checkpoint_every_epochs) < 1e-6 or epoch >= total_epochs
             ):
-                # orbax coordinates multi-host saves internally; every process calls in
-                ckpt.save(int(ts.step), trainer.net, jax.device_get(ts), extra={"epoch": epoch})
+                # orbax coordinates multi-host saves internally; every process
+                # calls in. device_get: the async save must not read buffers
+                # the next step will donate. checkpoint_view makes the tree
+                # fully replicated first, so the host copy is multi-host-safe.
+                ckpt.save(int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)), extra={"epoch": epoch})
 
     finally:
         if trace_active:

@@ -86,21 +86,7 @@ def _apply_slicers(slicer_tree, tree):
     return jax.tree.map(lambda fn, leaf: fn(leaf), slicer_tree, tree)
 
 
-def _map_params_shaped(obj, params_structure, fn):
-    """Recursively apply fn to every subtree of obj whose pytree structure
-    equals the params structure (used to slice optax accumulators)."""
-    try:
-        if jax.tree.structure(obj) == params_structure:
-            return fn(obj)
-    except Exception:
-        pass
-    if isinstance(obj, dict):
-        return {k: _map_params_shaped(v, params_structure, fn) for k, v in obj.items()}
-    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
-        return type(obj)(*(_map_params_shaped(v, params_structure, fn) for v in obj))
-    if isinstance(obj, (tuple, list)):
-        return type(obj)(_map_params_shaped(v, params_structure, fn) for v in obj)
-    return obj
+from ..utils.treeutil import map_params_shaped as _map_params_shaped
 
 
 def rematerialize(

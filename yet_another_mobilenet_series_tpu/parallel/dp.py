@@ -34,24 +34,44 @@ def make_dp_train_step(
     mesh: Mesh,
     *,
     penalty_fn=None,
+    params_example=None,
 ):
     """jitted (ts, batch, rng) -> (ts, metrics) over the mesh.
 
     ts is fully replicated; batch is sharded on the 'data' axis. The per-shard
     rng is folded with the device's axis index so dropout/augment noise is
-    decorrelated across replicas.
+    decorrelated across replicas. With cfg.dist.shard_optimizer the optimizer
+    accumulators are sharded on 'data' and the update runs ZeRO-style
+    (parallel/zero.py).
     """
-    inner = make_train_step(net, cfg, optimizer, lr_fn, axis_name=DATA_AXIS, penalty_fn=penalty_fn)
+    shard_opt = cfg.dist.shard_optimizer
+    sharded_update = None
+    opt_spec = P()
+    if shard_opt:
+        if cfg.optim.grad_clip_norm > 0:
+            raise NotImplementedError("grad_clip_norm with shard_optimizer: per-shard clip would use the wrong norm")
+        from . import zero
+
+        sharded_update = zero.make_zero_update(optimizer, mesh.size)
+        if params_example is None:
+            params_example, _ = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
+        opt_spec = zero.opt_state_specs(optimizer, params_example, mesh.size)
+    inner = make_train_step(
+        net, cfg, optimizer, lr_fn, axis_name=DATA_AXIS, penalty_fn=penalty_fn, sharded_update=sharded_update
+    )
 
     def shard_fn(ts: TrainState, batch, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
         return inner(ts, batch, rng)
 
+    ts_spec = TrainState(
+        step=P(), params=P(), state=P(), opt_state=opt_spec, ema_params=P(), ema_state=P(), masks=P()
+    )
     fn = shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P(DATA_AXIS), P()),
-        out_specs=(P(), P()),
+        in_specs=(ts_spec, P(DATA_AXIS), P()),
+        out_specs=(ts_spec, P()),
         check_vma=False,
     )
     return jax.jit(fn, donate_argnums=(0,))

@@ -44,9 +44,13 @@ def train_state_to_dict(ts: TrainState) -> dict:
     return {k: getattr(ts, k) for k in TRAIN_STATE_FIELDS}
 
 
-def init_train_state(net: Network, cfg: Config, optimizer: optax.GradientTransformation, rng) -> TrainState:
+def init_train_state(
+    net: Network, cfg: Config, optimizer: optax.GradientTransformation, rng, *, with_opt: bool = True
+) -> TrainState:
+    """with_opt=False leaves opt_state None — the ZeRO path builds its
+    sharded accumulators on the mesh instead (parallel/zero.py)."""
     params, state = net.init(rng)
-    opt_state = optimizer.init(params)
+    opt_state = optimizer.init(params) if with_opt else None
     # Real copies: the shadow must not alias the live buffers (aliasing breaks
     # buffer donation of the whole TrainState).
     ema_p = jax.tree.map(jnp.copy, params) if cfg.ema.enable else None
@@ -74,12 +78,18 @@ def make_train_step(
     *,
     axis_name: str | None = None,
     penalty_fn: Callable[[Any, Mapping[str, Any]], jax.Array] | None = None,
+    sharded_update: Callable | None = None,
 ):
     """Returns step_fn(ts, batch, rng) -> (ts, metrics).
 
     ``penalty_fn(params, masks)`` is the AtomNAS FLOPs-weighted BN-gamma L1
     hook (SURVEY.md §3.2); None for plain training. ``batch`` is
     {'image': (N,H,W,C), 'label': (N,)} already on device.
+
+    ``sharded_update(grads_local, opt_state_shard, params)`` replaces the
+    replicated pmean+optax update with the ZeRO cross-replica sharded update
+    (parallel/zero.py); it receives un-averaged local grads (the mean rides
+    the psum_scatter).
     """
     compute_dtype = _dtype(cfg.train.compute_dtype)
 
@@ -104,10 +114,14 @@ def make_train_step(
         (loss, (new_state, logits, ce, pen)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             ts.params, ts.state, batch, ts.masks, rng
         )
-        if axis_name is not None:
-            grads = lax.pmean(grads, axis_name)
-        updates, new_opt_state = optimizer.update(grads, ts.opt_state, ts.params)
-        new_params = optax.apply_updates(ts.params, updates)
+        if sharded_update is not None:
+            new_params, new_opt_state, grad_norm = sharded_update(grads, ts.opt_state, ts.params)
+        else:
+            if axis_name is not None:
+                grads = lax.pmean(grads, axis_name)
+            updates, new_opt_state = optimizer.update(grads, ts.opt_state, ts.params)
+            new_params = optax.apply_updates(ts.params, updates)
+            grad_norm = optax.global_norm(grads)
         new_ema_p = ema_update(cfg.ema, ts.ema_params, new_params, ts.step) if cfg.ema.enable else None
         new_ema_s = ema_update(cfg.ema, ts.ema_state, new_state, ts.step) if cfg.ema.enable else None
 
@@ -119,7 +133,7 @@ def make_train_step(
             "penalty": pen,
             "top1": correct / n,
             "lr": lr_fn(ts.step),
-            "grad_norm": optax.global_norm(grads),
+            "grad_norm": grad_norm,
             "finite": jnp.isfinite(loss).astype(jnp.float32),
         }
         if axis_name is not None:

@@ -1,0 +1,25 @@
+"""Pytree structure utilities shared by NAS rematerialization and the ZeRO
+optimizer-state transforms."""
+
+from __future__ import annotations
+
+import jax
+
+
+def map_params_shaped(obj, params_structure, fn):
+    """Recursively applies ``fn`` to every subtree of ``obj`` whose pytree
+    structure equals ``params_structure`` (optax states wrap params-shaped
+    accumulator trees inside NamedTuples; this finds them without knowing the
+    optimizer's composition)."""
+    try:
+        if jax.tree.structure(obj) == params_structure:
+            return fn(obj)
+    except Exception:
+        pass
+    if isinstance(obj, dict):
+        return {k: map_params_shaped(v, params_structure, fn) for k, v in obj.items()}
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # NamedTuple
+        return type(obj)(*(map_params_shaped(v, params_structure, fn) for v in obj))
+    if isinstance(obj, (tuple, list)):
+        return type(obj)(map_params_shaped(v, params_structure, fn) for v in obj)
+    return obj
