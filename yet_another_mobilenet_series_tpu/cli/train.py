@@ -60,6 +60,12 @@ class Trainer:
         self.eval_step = dp.make_dp_eval_step(net, cfg, mesh)
         self.mask_update = jax.jit(masking.make_mask_update(net, cfg.prune)) if cfg.prune.enable else None
         self.sync_check = dp.make_replica_sync_check(mesh)
+        if cfg.dist.shard_optimizer:
+            from ..parallel import zero
+
+            # jitted ONCE: a fresh jax.jit per checkpoint would retrace the
+            # full gather program every save
+            self._gather_opt = jax.jit(zero.gather_opt_state)
 
     def init_state(self, rng) -> steps.TrainState:
         zero_opt = self.cfg.dist.shard_optimizer
@@ -106,10 +112,7 @@ class Trainer:
         """Converts a live TrainState to the checkpoint format (gathers the
         ZeRO flat shards back to params-shaped; identity otherwise)."""
         if self.cfg.dist.shard_optimizer:
-            from ..parallel import zero
-
-            gathered = jax.jit(zero.gather_opt_state)(ts.opt_state, ts.params)
-            return ts.replace(opt_state=gathered)
+            return ts.replace(opt_state=self._gather_opt(ts.opt_state, ts.params))
         return ts
 
 

@@ -1,0 +1,136 @@
+"""Pallas TPU kernels for the depthwise hot path.
+
+Depthwise convolution is the one MobileNet op that cannot use the MXU (no
+contraction dimension: it is C independent k x k stencils), so it runs on
+the VPU and is HBM-bandwidth-bound. The XLA lowering materializes the conv
+output, then the BatchNorm affine, then the activation, then the AtomNAS
+mask — up to four HBM round trips over the widest tensors in the network.
+``fused_depthwise_inference`` does all of it in one VMEM residency:
+
+    y = act((dw_conv(x, w)) * scale + shift) * mask
+
+with the BN folded into per-channel scale/shift (eval semantics — training
+BN needs batch stats of the conv output, which requires a second pass; the
+train path keeps the XLA lowering, which the compiler already fuses well).
+
+A ``jax.custom_vjp`` wrapper makes the fused forward safe to drop into
+differentiated code: the backward pass recomputes with the reference XLA
+ops (correctness over speed — profiling on real hardware decides whether a
+hand-written backward is worth it; SURVEY.md §2 native table says "Pallas
+kernel only if profiling shows a gap", and the gap could not be measured
+this round — the sandbox TPU died mid-session).
+
+Everything is validated against the ``ops.layers`` reference in Pallas
+interpret mode (tests/test_pallas.py), so the kernels are exercised on CPU
+and compile-ready for TPU.
+
+Status: OPT-IN (ops/blocks.py does not call these yet); enable once real-
+hardware profiling confirms the win.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .activations import get_activation
+
+
+def _dw_kernel(x_ref, w_ref, scale_ref, shift_ref, mask_ref, o_ref, *, k: int, stride: int, act: str, out_h: int, out_w: int):
+    """One image per grid step: x_ref is the pre-padded (H+2p, W+2p, C)
+    input; the k*k taps are static Python loops (fully unrolled VPU
+    multiply-accumulates over strided slices)."""
+    x = x_ref[0]  # (H+2p, W+2p, C): drop the size-1 N-block axis
+    acc = None
+    for i in range(k):
+        for j in range(k):
+            # strided window of the padded input aligned to output (h, w)
+            sl = x[i : i + out_h * stride : stride, j : j + out_w * stride : stride, :]
+            term = sl * w_ref[i, j, :]
+            acc = term if acc is None else acc + term
+    y = acc * scale_ref[...] + shift_ref[...]
+    y = get_activation(act)(y)
+    o_ref[0] = (y * mask_ref[...]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("stride", "act", "interpret"))
+def _fused_dw_fwd(x, w, scale, shift, mask, *, stride: int, act: str, interpret: bool = False):
+    n, h, wd, c = x.shape
+    k = w.shape[0]
+    pad = k // 2
+    out_h = (h - 1) // stride + 1
+    out_w = (wd - 1) // stride + 1
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+
+    kernel = functools.partial(_dw_kernel, k=k, stride=stride, act=act, out_h=out_h, out_w=out_w)
+    return pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, h + 2 * pad, wd + 2 * pad, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((k, k, c), lambda i: (0, 0, 0)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((c,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((1, out_h, out_w, c), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, out_h, out_w, c), x.dtype),
+        interpret=interpret,
+    )(xp, w, scale, shift, mask)
+
+
+def _reference_fwd(x, w, scale, shift, mask, *, stride: int, act: str):
+    """The XLA lowering the kernel replaces (also the VJP recompute path)."""
+    from jax import lax
+
+    k = w.shape[0]
+    pad = k // 2
+    c = x.shape[-1]
+    y = lax.conv_general_dilated(
+        x.astype(jnp.float32),
+        w[:, :, None, :].astype(jnp.float32),  # (k,k,1,C) HWIO depthwise
+        window_strides=(stride, stride),
+        padding=((pad, pad), (pad, pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )
+    y = y * scale + shift
+    y = get_activation(act)(y)
+    return (y * mask).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def fused_depthwise_inference(x, w, scale, shift, mask, stride: int = 1, act: str = "relu6", interpret: bool = False):
+    """Fused dw-conv + folded-BN + activation + mask.
+
+    Args:
+      x: (N,H,W,C); w: (k,k,C) depthwise taps; scale/shift: (C,) folded BN
+      (scale = gamma*rsqrt(var+eps), shift = beta - mean*scale);
+      mask: (C,) AtomNAS atom mask (ones when unused).
+      interpret: run the Pallas interpreter (CPU testing).
+    """
+    return _fused_dw_fwd(x, w, scale, shift, mask, stride=stride, act=act, interpret=interpret)
+
+
+def _vjp_fwd(x, w, scale, shift, mask, stride, act, interpret):
+    y = _fused_dw_fwd(x, w, scale, shift, mask, stride=stride, act=act, interpret=interpret)
+    return y, (x, w, scale, shift, mask)
+
+
+def _vjp_bwd(stride, act, interpret, res, g):
+    x, w, scale, shift, mask = res
+    # correctness-first backward: differentiate the reference lowering
+    _, vjp = jax.vjp(lambda *a: _reference_fwd(*a, stride=stride, act=act), x, w, scale, shift, mask)
+    return vjp(g)
+
+
+fused_depthwise_inference.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def fold_bn(gamma, beta, mean, var, eps: float = 1e-5):
+    """BN eval affine folded to (scale, shift) for the fused kernel."""
+    scale = gamma * jax.lax.rsqrt(var + eps)
+    return scale, beta - mean * scale
