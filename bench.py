@@ -48,41 +48,62 @@ def main():
     n_chips = len(jax.devices())
     # batch sized for one v5e-class chip; scale with the mesh. The CPU path
     # exists only as a smoke test (this sandbox has 1 core) — the recorded
-    # number comes from the driver's real-TPU run.
+    # number comes from the driver's real-TPU run. On HBM pressure the
+    # fallback loop halves the batch (and finally enables activation remat).
     per_chip_batch = 256 if platform == "tpu" else 8
     image_size = 224 if platform == "tpu" else 64
     batch = per_chip_batch * n_chips
     log(f"bench: {platform} x{n_chips}, global batch {batch}, image {image_size}")
 
-    cfg = config_from_dict({
-        "model": {"arch": "mobilenet_v3_large", "dropout": 0.2},
-        "optim": {"optimizer": "rmsprop", "weight_decay": 1e-5},
-        "schedule": {"schedule": "exp_decay", "base_lr": 0.064, "warmup_epochs": 5.0},
-        "ema": {"enable": True},
-        "train": {"batch_size": batch, "compute_dtype": "bfloat16"},
-    })
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+
     mesh = mesh_lib.make_mesh(n_chips)
-    net = get_model(cfg.model, image_size)
-    steps_per_epoch = 1281167 // batch
-    lr_fn = schedules.make_lr_schedule(cfg.schedule, batch, steps_per_epoch, 350)
-    params, _ = net.init(jax.random.PRNGKey(0))
-    optimizer = optim.make_optimizer(cfg.optim, lr_fn, params)
-    ts = steps.init_train_state(net, cfg, optimizer, jax.random.PRNGKey(0))
-    ts = mesh_lib.replicate(ts, mesh)
-    step_fn = dp.make_dp_train_step(net, cfg, optimizer, lr_fn, mesh)
+    net = get_model(ModelConfig(arch="mobilenet_v3_large", dropout=0.2), image_size)
 
-    rng = np.random.RandomState(0)
-    host_batch = {
-        "image": rng.normal(0, 1, (batch, image_size, image_size, 3)).astype(np.float32),
-        "label": (np.arange(batch) % 1000).astype(np.int32),
-    }
-    b = mesh_lib.shard_batch(host_batch, mesh)
+    def build(batch, remat):
+        cfg = config_from_dict({
+            "model": {"arch": "mobilenet_v3_large", "dropout": 0.2},
+            "optim": {"optimizer": "rmsprop", "weight_decay": 1e-5},
+            "schedule": {"schedule": "exp_decay", "base_lr": 0.064, "warmup_epochs": 5.0},
+            "ema": {"enable": True},
+            "train": {"batch_size": batch, "compute_dtype": "bfloat16", "remat": remat},
+        })
+        steps_per_epoch = 1281167 // batch
+        lr_fn = schedules.make_lr_schedule(cfg.schedule, batch, steps_per_epoch, 350)
+        params, _ = net.init(jax.random.PRNGKey(0))
+        optimizer = optim.make_optimizer(cfg.optim, lr_fn, params)
+        ts = steps.init_train_state(net, cfg, optimizer, jax.random.PRNGKey(0))
+        ts = mesh_lib.replicate(ts, mesh)
+        step_fn = dp.make_dp_train_step(net, cfg, optimizer, lr_fn, mesh)
+        rng = np.random.RandomState(0)
+        host_batch = {
+            "image": rng.normal(0, 1, (batch, image_size, image_size, 3)).astype(np.float32),
+            "label": (np.arange(batch) % 1000).astype(np.int32),
+        }
+        b = mesh_lib.shard_batch(host_batch, mesh)
+        return step_fn, ts, b
+
     key = jax.random.PRNGKey(0)
-
-    t0 = time.perf_counter()
-    ts, metrics = step_fn(ts, b, key)
-    jax.block_until_ready(metrics["loss"])
-    log(f"compile+first step: {time.perf_counter()-t0:.1f}s")
+    attempts = [(batch, False), (batch // 2, False), (batch // 2, True), (batch // 4, True)]
+    step_fn = ts = b = None
+    for try_batch, remat in attempts:
+        try:
+            step_fn, ts, b = build(try_batch, remat)
+            t0 = time.perf_counter()
+            ts, metrics = step_fn(ts, b, key)
+            jax.block_until_ready(metrics["loss"])
+            batch = try_batch
+            log(f"batch {batch} remat={remat}: compile+first step {time.perf_counter()-t0:.1f}s")
+            break
+        except Exception as e:  # XlaRuntimeError RESOURCE_EXHAUSTED etc.
+            if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
+                raise
+            log(f"batch {try_batch} remat={remat} OOM; falling back")
+            # drop the failed attempt's device buffers BEFORE rebuilding, or
+            # they stay pinned in HBM and the smaller attempt OOMs too
+            step_fn = ts = b = None
+    if step_fn is None:
+        raise RuntimeError("all batch-size fallbacks exhausted")
 
     # warmup
     for _ in range(3):

@@ -1,0 +1,75 @@
+"""Golden-image test of the tf.data eval transform (SURVEY.md §4.3):
+resize-shorter-side + center-crop + normalize vs an independent PIL
+reference. Resamplers differ slightly (tf bilinear vs PIL), so geometry is
+asserted exactly (via a structured gradient image) and intensities within a
+small tolerance."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image
+
+import tensorflow as tf
+
+from yet_another_mobilenet_series_tpu.config import DataConfig
+from yet_another_mobilenet_series_tpu.data import pipeline as data_lib
+
+
+def _make_jpeg(w, h):
+    # smooth two-axis gradient: sensitive to crop offsets and resize scale,
+    # tolerant to resampler differences
+    x = np.linspace(0, 255, w, dtype=np.float32)[None, :, None]
+    y = np.linspace(0, 255, h, dtype=np.float32)[:, None, None]
+    arr = np.concatenate([np.broadcast_to(x, (h, w, 1)), np.broadcast_to(y, (h, w, 1)), np.full((h, w, 1), 128.0)], -1)
+    img = Image.fromarray(arr.astype(np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=97)
+    return buf.getvalue(), img
+
+
+def _pil_reference(img: Image.Image, cfg: DataConfig):
+    w, h = img.size
+    scale = cfg.eval_resize / min(w, h)
+    rw, rh = int(round(w * scale)), int(round(h * scale))
+    img = img.resize((rw, rh), Image.BILINEAR)
+    left = (rw - cfg.image_size) // 2
+    top = (rh - cfg.image_size) // 2
+    img = img.crop((left, top, left + cfg.image_size, top + cfg.image_size))
+    arr = np.asarray(img, np.float32) / 255.0
+    return (arr - np.asarray(cfg.mean, np.float32)) / np.asarray(cfg.std, np.float32)
+
+
+@pytest.mark.parametrize("w,h", [(320, 240), (240, 320), (500, 375), (224, 224)])
+def test_eval_transform_matches_pil_reference(w, h):
+    cfg = DataConfig(image_size=224, eval_resize=256)
+    jpeg, img = _make_jpeg(w, h)
+    out = data_lib._decode_center_crop(tf, tf.constant(jpeg), cfg)
+    out = data_lib._normalize(tf, out, cfg).numpy()
+    ref = _pil_reference(img, cfg)
+    assert out.shape == ref.shape == (224, 224, 3)
+    # un-normalize for an interpretable pixel-value tolerance
+    std = np.asarray(cfg.std, np.float32)
+    diff_px = np.abs(out - ref) * std * 255.0
+    assert np.mean(diff_px) < 2.0, np.mean(diff_px)   # avg within 2/255
+    assert np.percentile(diff_px, 99) < 8.0, np.percentile(diff_px, 99)
+
+
+def test_train_transform_statistics():
+    """Random-resized-crop output is in normalized range and actually varies
+    crop windows across samples (area/ratio knobs respected in aggregate)."""
+    cfg = DataConfig(image_size=64, rrc_area_min=0.25)
+    jpeg, _ = _make_jpeg(128, 128)
+    outs = []
+    tf.random.set_seed(0)
+    for _ in range(8):
+        img = data_lib._decode_and_random_crop(tf, tf.constant(jpeg), cfg)
+        outs.append(data_lib._normalize(tf, img, cfg).numpy())
+    outs = np.stack(outs)
+    assert outs.shape == (8, 64, 64, 3)
+    assert np.isfinite(outs).all()
+    # different random crops -> different images
+    assert np.std(outs.mean(axis=(1, 2, 3))) > 1e-3

@@ -1,0 +1,86 @@
+"""Composite-block numeric parity against a hand-built torch implementation
+(SURVEY.md §4.1: 'verify against torchvision's MBV2 numerically for the
+forward pass'). torchvision is absent in this image, so the torch side is
+built from torch.nn primitives with the exact reference semantics (symmetric
+k//2 padding, BN momentum 0.1/eps 1e-5, linear bottleneck, residual)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from yet_another_mobilenet_series_tpu.ops.blocks import InvertedResidual  # noqa: E402
+
+
+class TorchMBConv(tnn.Module):
+    """Reference-style MBConv: expand 1x1 -> BN -> ReLU6 -> dw kxk -> BN ->
+    ReLU6 -> [SE] -> project 1x1 -> BN (+residual)."""
+
+    def __init__(self, cin, cout, exp, k, stride, se_ch=0):
+        super().__init__()
+        self.expand = tnn.Conv2d(cin, exp, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(exp)
+        self.dw = tnn.Conv2d(exp, exp, k, stride, padding=k // 2, groups=exp, bias=False)
+        self.bn2 = tnn.BatchNorm2d(exp)
+        self.se_ch = se_ch
+        if se_ch:
+            self.se_reduce = tnn.Linear(exp, se_ch)
+            self.se_expand = tnn.Linear(se_ch, exp)
+        self.project = tnn.Conv2d(exp, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout)
+        self.residual = stride == 1 and cin == cout
+
+    def forward(self, x):
+        h = tnn.functional.relu6(self.bn1(self.expand(x)))
+        h = tnn.functional.relu6(self.bn2(self.dw(h)))
+        if self.se_ch:
+            s = h.mean(dim=(2, 3))
+            s = self.se_expand(tnn.functional.relu(self.se_reduce(s)))
+            gate = tnn.functional.hardsigmoid(s)  # torch: relu6(x+3)/6
+            h = h * gate[:, :, None, None]
+        h = self.bn3(self.project(h))
+        return h + x if self.residual else h
+
+
+@pytest.mark.parametrize("cin,cout,exp,k,stride,se", [
+    (16, 16, 64, 3, 1, 0),    # residual, no SE
+    (16, 24, 64, 5, 2, 0),    # stride 2, k=5
+    (16, 16, 48, 3, 1, 16),   # SE + residual
+])
+def test_mbconv_block_matches_torch(cin, cout, exp, k, stride, se):
+    spec = InvertedResidual(
+        in_channels=cin, out_channels=cout, expanded_channels=exp, stride=stride,
+        kernel_sizes=(k,), active_fn="relu6", se_channels=se, se_gate_fn="hsigmoid",
+    )
+    params, state = spec.init(jax.random.PRNGKey(0))
+
+    tm = TorchMBConv(cin, cout, exp, k, stride, se).double().eval()
+    with torch.no_grad():
+        # copy OUR params into the torch module (HWIO -> OIHW)
+        tm.expand.weight.copy_(torch.from_numpy(np.asarray(params["expand"]["w"], np.float64).transpose(3, 2, 0, 1)))
+        tm.dw.weight.copy_(torch.from_numpy(np.asarray(params[f"dw0_k{k}"]["w"], np.float64).transpose(3, 2, 0, 1)))
+        tm.project.weight.copy_(torch.from_numpy(np.asarray(params["project"]["w"], np.float64).transpose(3, 2, 0, 1)))
+        for bn_t, key in [(tm.bn1, "expand_bn"), (tm.bn2, "dw_bn"), (tm.bn3, "project_bn")]:
+            bn_t.weight.copy_(torch.from_numpy(np.asarray(params[key]["gamma"], np.float64)))
+            bn_t.bias.copy_(torch.from_numpy(np.asarray(params[key]["beta"], np.float64)))
+            # non-trivial running stats so eval mode is a real test
+            mean = np.random.RandomState(hash(key) % 2**31).normal(0, 0.3, bn_t.weight.shape[0])
+            var = np.random.RandomState(hash(key) % 2**31 + 1).uniform(0.5, 1.5, bn_t.weight.shape[0])
+            bn_t.running_mean.copy_(torch.from_numpy(mean))
+            bn_t.running_var.copy_(torch.from_numpy(var))
+            state[key] = {"mean": jnp.asarray(mean, jnp.float32), "var": jnp.asarray(var, jnp.float32)}
+        if se:
+            tm.se_reduce.weight.copy_(torch.from_numpy(np.asarray(params["se"]["reduce"]["w"], np.float64).T))
+            tm.se_reduce.bias.copy_(torch.from_numpy(np.asarray(params["se"]["reduce"]["b"], np.float64)))
+            tm.se_expand.weight.copy_(torch.from_numpy(np.asarray(params["se"]["expand"]["w"], np.float64).T))
+            tm.se_expand.bias.copy_(torch.from_numpy(np.asarray(params["se"]["expand"]["b"], np.float64)))
+
+    x = np.random.RandomState(7).normal(size=(2, 9, 9, cin)).astype(np.float32)
+    y_ours, _ = spec.apply(params, state, jnp.asarray(x), train=False)
+    with torch.no_grad():
+        y_torch = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)).double()).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y_ours), y_torch, rtol=1e-4, atol=1e-5)

@@ -193,7 +193,8 @@ def run(cfg: Config) -> dict:
 
     net = get_model(cfg.model, cfg.data.image_size)
     prof = profile_network(net)
-    log.log(f"model {cfg.model.arch} x{cfg.model.width_mult}: {prof.total_params/1e6:.2f}M params, {prof.total_macs/1e6:.1f}M MACs")
+    arch_name = cfg.model.network_spec or f"{cfg.model.arch} x{cfg.model.width_mult}"
+    log.log(f"model {arch_name}: {prof.total_params/1e6:.2f}M params, {prof.total_macs/1e6:.1f}M MACs")
 
     ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt", max_to_keep=cfg.train.max_checkpoints)
 
@@ -222,12 +223,15 @@ def run(cfg: Config) -> dict:
         start_epoch = float(extra.get("epoch", int(ts.step) / trainer.steps_per_epoch))
         log.log(f"resumed at step {int(ts.step)} (epoch {start_epoch:.2f})")
     else:
+        log.mark_fresh_run()  # truncate metrics.jsonl: steps restart at 0
         trainer = Trainer(cfg, net, mesh, log)
         ts = trainer.init_state(rng)
 
     local_batch = mesh_lib.local_batch_slice(cfg.train.batch_size, mesh)
-    train_iter = data_lib.make_train_source(
-        cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count()
+    train_iter = mesh_lib.prefetch_to_mesh(
+        data_lib.make_train_source(cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count()),
+        mesh,
+        depth=cfg.data.device_prefetch,
     )
 
     total_epochs = cfg.train.epochs
@@ -244,8 +248,7 @@ def run(cfg: Config) -> dict:
             epoch_steps = min(spe, max(int((total_epochs - epoch) * spe), 1))
             t_epoch = time.perf_counter()
             for _ in range(epoch_steps):
-                batch = next(train_iter)
-                b = mesh_lib.shard_batch(batch, trainer.mesh)
+                b = next(train_iter)  # already on-mesh (prefetch_to_mesh)
                 ts, metrics = trainer.train_step(ts, b, rng)
                 # host-side counter: int(ts.step) would sync the host with the
                 # device every step and stall async dispatch
@@ -310,10 +313,37 @@ def run(cfg: Config) -> dict:
             # flush the trace rather than losing it
             jax.profiler.stop_trace()
 
+    if cfg.prune.enable:
+        # apply any remaining masks physically and emit the searched result
+        # as a standalone spec (reference: 'final architecture == surviving
+        # channels; emit as block-spec', SURVEY.md §3.2)
+        trainer, ts = _maybe_rematerialize(trainer, ts, log)
+        from ..models.serialize import network_to_dict
+
+        prof_final = profile_network(trainer.net)
+        if is_coord:
+            import json
+            import os
+
+            payload = {
+                "network": network_to_dict(trainer.net),
+                "macs": int(prof_final.total_macs),
+                "params": int(prof_final.total_params),
+                "step": int(ts.step),
+            }
+            path = os.path.join(cfg.train.log_dir, "searched_arch.json")
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1)
+            log.log(
+                f"searched architecture -> {path} "
+                f"({prof_final.total_macs/1e6:.1f}M MACs, {prof_final.total_params/1e6:.2f}M params)"
+            )
+
     ckpt.wait()
     ckpt.close()
     final = {"epoch": epoch, **{f"eval_{k}": v for k, v in eval_result.items()}}
     log.log(format_metrics("done:", final))
+    log.close()
     return final
 
 

@@ -76,6 +76,10 @@ class ModelConfig:
     # Explicit block specs override `arch`. Each entry is a mapping accepted
     # by models.specs.BlockSpec.from_dict.
     block_specs: Sequence[Mapping[str, Any]] | None = None
+    # Path to a serialized Network (e.g. a search run's searched_arch.json);
+    # overrides arch/block_specs entirely — this is how an emitted AtomNAS
+    # result is trained/evaluated as a standalone model.
+    network_spec: str = ""
     # Stem / head channel overrides (None = arch default).
     stem_channels: int | None = None
     head_channels: int | None = None
@@ -106,7 +110,11 @@ class DataConfig:
     # input pipeline
     loader: str = "tfdata"  # tfdata | native | synthetic
     shuffle_buffer: int = 16384
-    prefetch: int = 4
+    prefetch: int = 4  # host-side tf.data prefetch depth
+    # device-HBM prefetch depth (batches pinned on the mesh ahead of compute;
+    # independent of the host-side knob — each unit costs a full global batch
+    # of HBM)
+    device_prefetch: int = 2
     decode_threads: int = 8
     # augmentation (Inception-style random-resized-crop defaults)
     rrc_area_min: float = 0.08
@@ -185,6 +193,9 @@ class TrainConfig:
     eval_batch_size: int = 250
     seed: int = 0
     compute_dtype: str = "bfloat16"  # matmul/conv compute dtype on TPU
+    # jax.checkpoint the forward pass: recompute activations in backward to
+    # trade FLOPs for HBM (enables larger per-chip batches)
+    remat: bool = False
     log_every: int = 100
     eval_every_epochs: float = 1.0
     checkpoint_every_epochs: float = 1.0

@@ -13,6 +13,22 @@ __all__ = ["ArchDef", "Network", "build_network", "get_arch", "get_model", "ARCH
 
 def get_model(cfg: ModelConfig, image_size: int = 224) -> Network:
     """Resolve a ModelConfig into a concrete Network spec."""
+    if cfg.network_spec:
+        # a serialized Network (e.g. searched_arch.json emitted by an AtomNAS
+        # run) IS the architecture; classifier width must match num_classes
+        import dataclasses as _dc
+        import json
+
+        from .serialize import network_from_dict
+
+        with open(cfg.network_spec) as f:
+            payload = json.load(f)
+        net = network_from_dict(payload.get("network", payload))
+        if net.classifier.out_features != cfg.num_classes:
+            raise ValueError(
+                f"network_spec has {net.classifier.out_features} classes, config wants {cfg.num_classes}"
+            )
+        return _dc.replace(net, dropout=cfg.dropout, image_size=image_size)
     arch = get_arch(cfg.arch)
     overrides = {}
     if cfg.stem_channels is not None:

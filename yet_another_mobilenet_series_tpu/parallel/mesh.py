@@ -54,6 +54,42 @@ def replicate(tree, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, s), tree)
 
 
+def prefetch_to_mesh(batch_iter, mesh: Mesh, depth: int = 2):
+    """Wraps a host batch iterator so device_put of the NEXT batch overlaps
+    the CURRENT step's device compute (jax device_put is async). This is the
+    prefetch-to-device stage of SURVEY.md §3.1's TPU hot loop — without it
+    the chip idles for the H2D transfer every step. Each unit of ``depth``
+    pins one global batch in device memory.
+
+    Eager wrapper: depth validation (and the first transfers) happen at
+    construction, not at the first next() deep inside the training loop.
+    """
+    import collections
+
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    buf = collections.deque()
+
+    def fill():
+        try:
+            buf.append(shard_batch(next(batch_iter), mesh))
+            return True
+        except StopIteration:
+            return False
+
+    for _ in range(depth):
+        if not fill():
+            break
+
+    def gen():
+        while buf:
+            nxt = buf.popleft()
+            fill()
+            yield nxt
+
+    return gen()
+
+
 # --- multi-host glue (reference: is_master guards / master_only decorators) --
 
 

@@ -93,18 +93,26 @@ def make_train_step(
     """
     compute_dtype = _dtype(cfg.train.compute_dtype)
 
-    def loss_fn(params, state, batch, masks, rng):
+    def forward(params, state, image, masks, rng):
         imasks = {int(k): v for k, v in masks.items()} or None
-        logits, new_state = net.apply(
+        return net.apply(
             params,
             state,
-            batch["image"].astype(compute_dtype),
+            image,
             train=True,
             axis_name=axis_name,
             compute_dtype=compute_dtype,
             masks=imasks,
             rng=rng,
         )
+
+    if cfg.train.remat:
+        # recompute activations during backward: HBM for FLOPs
+        # (jax.checkpoint; SURVEY.md §0 HBM-bandwidth note)
+        forward = jax.checkpoint(forward)
+
+    def loss_fn(params, state, batch, masks, rng):
+        logits, new_state = forward(params, state, batch["image"].astype(compute_dtype), masks, rng)
         ce = cross_entropy_label_smooth(logits, batch["label"], cfg.optim.label_smoothing)
         pen = penalty_fn(params, masks) if penalty_fn is not None else jnp.zeros((), jnp.float32)
         return ce + pen, (new_state, logits, ce, pen)

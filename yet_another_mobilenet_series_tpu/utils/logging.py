@@ -11,10 +11,26 @@ class Logger:
     def __init__(self, log_dir: str | None = None, enabled: bool = True, tensorboard: bool = False):
         self.enabled = enabled
         self._tb = None
-        if enabled and tensorboard and log_dir:
-            import tensorflow as tf
+        self._jsonl = None
+        self._jsonl_path = None
+        self._append = True
+        if enabled and log_dir:
+            import os
 
-            self._tb = tf.summary.create_file_writer(log_dir)
+            os.makedirs(log_dir, exist_ok=True)
+            # metrics.jsonl is opened lazily at the first scalars() write so
+            # mark_fresh_run() — callable only after the checkpoint-restore
+            # decision — can truncate it and keep step rows monotonic
+            self._jsonl_path = os.path.join(log_dir, "metrics.jsonl")
+            if tensorboard:
+                import tensorflow as tf
+
+                self._tb = tf.summary.create_file_writer(log_dir)
+
+    def mark_fresh_run(self):
+        """No checkpoint was restored: truncate the metrics stream instead of
+        appending behind a previous run's rows."""
+        self._append = False
 
     def log(self, msg: str):
         if self.enabled:
@@ -22,6 +38,16 @@ class Logger:
             print(f"[{ts}] {msg}", flush=True)
 
     def scalars(self, step: int, metrics: dict, prefix: str = ""):
+        if self._jsonl is None and self._jsonl_path is not None:
+            self._jsonl = open(self._jsonl_path, "a" if self._append else "w")
+            self._jsonl_path = None
+        if self._jsonl is not None:
+            import json
+
+            row = {"step": int(step)}
+            row.update({f"{prefix}{k}": float(v) for k, v in metrics.items()})
+            self._jsonl.write(json.dumps(row) + "\n")
+            self._jsonl.flush()
         if self._tb is None:
             return
         import tensorflow as tf
@@ -32,3 +58,11 @@ class Logger:
 
     def error(self, msg: str):
         print(f"ERROR: {msg}", file=sys.stderr, flush=True)
+
+    def close(self):
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
