@@ -15,6 +15,15 @@ Surgery per block, given its keep-set of expanded channels:
 - optimizer/EMA accumulators are sliced identically (params-shaped subtrees
   inside the optax state are located by tree-structure match), so RMSProp/
   momentum history survives the rebuild.
+
+NOTE on BN-stat recalibration: the reference recalibrates BatchNorm running
+stats after each shrink (SURVEY.md §2 #11) because its gamma~=0 pruning only
+*approximately* removes a channel (the BN beta still leaks through), so the
+shrunk network computes a slightly different function whose downstream
+statistics drifted. Here pruning is a hard mask applied after BN+act and the
+rebuild is proven bit-exact against the masked forward (tests/test_nas.py),
+so every surviving BN's statistics are unchanged by construction and no
+recalibration pass is needed.
 """
 
 from __future__ import annotations

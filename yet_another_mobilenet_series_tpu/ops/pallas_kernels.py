@@ -24,8 +24,10 @@ Everything is validated against the ``ops.layers`` reference in Pallas
 interpret mode (tests/test_pallas.py), so the kernels are exercised on CPU
 and compile-ready for TPU.
 
-Status: OPT-IN (ops/blocks.py does not call these yet); enable once real-
-hardware profiling confirms the win.
+Status: OPT-IN — wired into InvertedResidual.apply(fused_eval=True) and
+reachable via cfg.model.fused_eval_kernels on the eval step, default OFF;
+flip the default once real-hardware profiling confirms the win. Off-TPU the
+blocks fall back to the XLA path unless YAMT_PALLAS_INTERPRET=1 (tests).
 """
 
 from __future__ import annotations
