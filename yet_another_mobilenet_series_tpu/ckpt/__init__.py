@@ -1,0 +1,5 @@
+"""Checkpointing: Orbax manager with network-spec sidecar."""
+
+from .manager import CheckpointManager
+
+__all__ = ["CheckpointManager"]

@@ -1,0 +1,11 @@
+"""AtomNAS search machinery: penalty, masking, rematerialization."""
+
+from . import rematerialize  # submodule (rematerialize.rematerialize is the entry point)
+from .masking import init_masks, make_mask_update, mask_summary, prunable_blocks
+from .penalty import atom_cost_table, make_penalty_fn
+from .rematerialize import RematReport
+
+__all__ = [
+    "init_masks", "make_mask_update", "mask_summary", "prunable_blocks",
+    "atom_cost_table", "make_penalty_fn", "RematReport", "rematerialize",
+]

@@ -156,6 +156,15 @@ class InvertedResidual:
     def _bn(self, c):
         return BatchNorm(c, self.bn_momentum, self.bn_eps)
 
+    def _branches(self):
+        """Yields (branch_index, kernel_size, group_channels, offset) —
+        single source of truth for the expanded-channel layout used by both
+        the XLA and fused-kernel paths."""
+        offset = 0
+        for i, (k, g) in enumerate(zip(self.kernel_sizes, self.group_channels)):
+            yield i, k, g, offset
+            offset += g
+
     def init(self, key):
         keys = jax.random.split(key, 3 + len(self.kernel_sizes))
         params, state = {}, {}
@@ -219,8 +228,7 @@ class InvertedResidual:
             )
             interpret = not on_tpu
             branches = []
-            offset = 0
-            for i, (k, g) in enumerate(zip(self.kernel_sizes, self.group_channels)):
+            for i, k, g, offset in self._branches():
                 sl = h[..., offset : offset + g].astype(compute_dtype)
                 m = jnp.ones((g,), h.dtype) if mask is None else mask[offset : offset + g]
                 branches.append(
@@ -230,18 +238,15 @@ class InvertedResidual:
                         m, self.stride, self.active_fn, interpret,
                     )
                 )
-                offset += g
             h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
             new_state["dw_bn"] = state["dw_bn"]
         else:
             branches = []
-            offset = 0
-            for i, (k, g) in enumerate(zip(self.kernel_sizes, self.group_channels)):
+            for i, k, g, offset in self._branches():
                 sl = h[..., offset : offset + g]
                 branches.append(
                     Conv2D(g, g, k, self.stride, groups=g).apply(params[f"dw{i}_k{k}"], sl, compute_dtype=compute_dtype)
                 )
-                offset += g
             h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
             h, new_state["dw_bn"] = self._bn(self.expanded_channels).apply(
                 params["dw_bn"], state["dw_bn"], h, train=train, axis_name=axis_name

@@ -1,0 +1,18 @@
+"""Distributed substrate: mesh, data-parallel steps, ZeRO sharded update."""
+
+from .dp import make_dp_eval_step, make_dp_train_step, make_replica_sync_check
+from .mesh import (
+    DATA_AXIS,
+    is_coordinator,
+    local_batch_slice,
+    make_mesh,
+    prefetch_to_mesh,
+    replicate,
+    shard_batch,
+)
+
+__all__ = [
+    "DATA_AXIS", "make_mesh", "shard_batch", "replicate", "prefetch_to_mesh",
+    "local_batch_slice", "is_coordinator",
+    "make_dp_train_step", "make_dp_eval_step", "make_replica_sync_check",
+]

@@ -1,0 +1,20 @@
+"""Training mechanics: losses, optimizers, schedules, EMA, step builders."""
+
+from .ema import ema_update
+from .losses import cross_entropy_label_smooth, topk_correct
+from .optim import make_optimizer, wd_mask
+from .schedules import make_lr_schedule
+from .steps import (
+    TrainState,
+    init_train_state,
+    make_eval_step,
+    make_train_step,
+    train_state_to_dict,
+)
+
+__all__ = [
+    "ema_update", "cross_entropy_label_smooth", "topk_correct",
+    "make_optimizer", "wd_mask", "make_lr_schedule",
+    "TrainState", "init_train_state", "make_eval_step", "make_train_step",
+    "train_state_to_dict",
+]
