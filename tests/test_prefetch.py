@@ -1,0 +1,38 @@
+"""prefetch_to_mesh unit tests: ordering, finite drain, eager validation."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from yet_another_mobilenet_series_tpu.parallel import mesh as mesh_lib
+
+
+def _batches(n):
+    for i in range(n):
+        yield {"image": np.full((8, 2, 2, 3), i, np.float32), "label": np.full((8,), i, np.int32)}
+
+
+def test_prefetch_preserves_order_and_drains():
+    m = mesh_lib.make_mesh(8)
+    it = mesh_lib.prefetch_to_mesh(_batches(5), m, depth=3)
+    seen = [int(np.asarray(b["label"])[0]) for b in it]
+    assert seen == [0, 1, 2, 3, 4]
+
+
+def test_prefetch_shorter_than_depth():
+    m = mesh_lib.make_mesh(8)
+    it = mesh_lib.prefetch_to_mesh(_batches(2), m, depth=4)
+    assert len(list(it)) == 2
+
+
+def test_prefetch_batches_are_on_mesh():
+    m = mesh_lib.make_mesh(8)
+    b = next(mesh_lib.prefetch_to_mesh(_batches(1), m, depth=1))
+    assert b["image"].sharding.spec == jax.sharding.PartitionSpec("data")
+
+
+def test_prefetch_depth_validated_eagerly():
+    m = mesh_lib.make_mesh(8)
+    with pytest.raises(ValueError):
+        mesh_lib.prefetch_to_mesh(_batches(3), m, depth=0)  # no next() needed

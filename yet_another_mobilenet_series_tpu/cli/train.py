@@ -240,6 +240,7 @@ def run(cfg: Config) -> dict:
     metric_log = MetricLogger()
     eval_result: dict = {}
     epoch = start_epoch
+    best_top1 = float(restored[2].get("best_top1", 0.0)) if restored is not None else 0.0
     host_step = int(ts.step)  # one sync at (re)start, then host-side counting
     trace_active = False
 
@@ -295,6 +296,9 @@ def run(cfg: Config) -> dict:
 
             if cfg.train.eval_every_epochs and (epoch % cfg.train.eval_every_epochs) < 1e-6 or epoch >= total_epochs:
                 eval_result = evaluate(trainer, ts, cfg)
+                if eval_result["top1"] > best_top1:  # reference: best-acc tracking
+                    best_top1 = eval_result["top1"]
+                eval_result["best_top1"] = best_top1
                 log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
                 log.scalars(int(ts.step), eval_result, "eval/")
 
@@ -305,7 +309,10 @@ def run(cfg: Config) -> dict:
                 # calls in. device_get: the async save must not read buffers
                 # the next step will donate. checkpoint_view makes the tree
                 # fully replicated first, so the host copy is multi-host-safe.
-                ckpt.save(int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)), extra={"epoch": epoch})
+                ckpt.save(
+                    int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)),
+                    extra={"epoch": epoch, "best_top1": best_top1},
+                )
 
     finally:
         if trace_active:
