@@ -113,6 +113,36 @@ def test_dp_eval_counts_match_single(setup):
         np.testing.assert_allclose(float(ms[k]), float(md[k]), rtol=1e-5, err_msg=k)
 
 
+def test_sync_bn_off_gives_per_replica_stats(setup):
+    """dist.sync_bn=false must actually disable the BN psum: running stats
+    then differ from the full-batch (SyncBN) result while grads stay
+    allreduced (params remain replica-identical)."""
+    import dataclasses as dc
+
+    cfg, net, lr_fn, opt, ts, batch = setup
+    m = mesh_lib.make_mesh(8)
+    b = mesh_lib.shard_batch(batch, m)
+
+    cfg_off = dc.replace(cfg, dist=dc.replace(cfg.dist, sync_bn=False))
+    step_on = dp.make_dp_train_step(net, cfg, opt, lr_fn, m)
+    step_off = dp.make_dp_train_step(net, cfg_off, opt, lr_fn, m)
+    ts_on, _ = step_on(mesh_lib.replicate(jax.tree.map(jnp.copy, ts), m), b, jax.random.PRNGKey(5))
+    ts_off, _ = step_off(mesh_lib.replicate(jax.tree.map(jnp.copy, ts), m), b, jax.random.PRNGKey(5))
+
+    # BN running stats must differ (per-replica vs global moments)...
+    diffs = [
+        float(jnp.abs(a - c).max())
+        for a, c in zip(jax.tree.leaves(ts_on.state), jax.tree.leaves(ts_off.state))
+    ]
+    assert max(diffs) > 1e-6, diffs
+    # ...but replicas stay in sync either way: grads are pmean'd and the
+    # running stats are explicitly broadcast from device 0 (DDP rank-0
+    # buffer semantics), so BOTH params and state remain replica-identical.
+    check = dp.make_replica_sync_check(m)
+    assert float(check(ts_off.params)) == 0.0
+    assert float(check(ts_off.state)) == 0.0
+
+
 def test_mesh_validation():
     with pytest.raises(ValueError):
         mesh_lib.make_mesh(999)

@@ -282,6 +282,11 @@ def run(cfg: Config) -> dict:
                     if snap.get("finite", 1.0) < 1.0:
                         log.error("non-finite loss detected; aborting")
                         raise FloatingPointError("non-finite loss")
+                if cfg.train.check_finite_every and step_i % cfg.train.check_finite_every == 0:
+                    # forced host sync — a debug guard, off by default
+                    if float(metrics["finite"]) < 1.0:
+                        log.error(f"non-finite loss at step {step_i}")
+                        raise FloatingPointError("non-finite loss")
                 if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
                     div = float(trainer.sync_check(ts.params))
                     if div != 0.0:

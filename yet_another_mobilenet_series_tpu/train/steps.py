@@ -92,6 +92,12 @@ def make_train_step(
     the psum_scatter).
     """
     compute_dtype = _dtype(cfg.train.compute_dtype)
+    # dist.sync_bn=False: per-replica batch statistics in the NORMALIZATION
+    # (grad allreduce still uses axis_name) — the reference's non-SyncBN DDP
+    # mode. DDP broadcasts rank 0's buffers, so the updated running stats are
+    # explicitly broadcast from device 0 below; without that the "replicated"
+    # state would silently diverge across replicas (and across hosts).
+    bn_axis = axis_name if cfg.dist.sync_bn else None
 
     def forward(params, state, image, masks, rng):
         imasks = {int(k): v for k, v in masks.items()} or None
@@ -100,7 +106,7 @@ def make_train_step(
             state,
             image,
             train=True,
-            axis_name=axis_name,
+            axis_name=bn_axis,
             compute_dtype=compute_dtype,
             masks=imasks,
             rng=rng,
@@ -122,6 +128,14 @@ def make_train_step(
         (loss, (new_state, logits, ce, pen)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             ts.params, ts.state, batch, ts.masks, rng
         )
+        if axis_name is not None and bn_axis is None:
+            # non-SyncBN mode: restore the replication invariant by
+            # broadcasting device 0's updated running stats (DDP rank-0
+            # buffer semantics, globally — incl. multi-host)
+            idx = lax.axis_index(axis_name)
+            new_state = jax.tree.map(
+                lambda s: lax.psum(jnp.where(idx == 0, s, jnp.zeros_like(s)), axis_name), new_state
+            )
         if sharded_update is not None:
             new_params, new_opt_state, grad_norm = sharded_update(grads, ts.opt_state, ts.params)
         else:
