@@ -51,6 +51,9 @@ PEAK_FLOPS_BY_KIND = [
 WORKER_TIMEOUT_S = 1800  # generous: killing a mid-compile TPU job can wedge the tunnel
 RETRIES = 3
 BACKOFF_S = (5, 20)  # sleeps between the RETRIES attempts (len == RETRIES - 1)
+# stop launching TPU attempts past this point so the CPU fallback always gets
+# to run (observed: a dead tunnel burns ~25 min per failed backend init)
+TPU_DEADLINE_S = 2400
 
 
 def log(msg: str):
@@ -257,7 +260,11 @@ def main():
         return
 
     last_err = "unknown"
+    t_start = time.monotonic()
     for attempt in range(RETRIES):
+        if attempt > 0 and time.monotonic() - t_start > TPU_DEADLINE_S:
+            last_err += f"; TPU deadline {TPU_DEADLINE_S}s exceeded, skipping remaining retries"
+            break
         try:
             result = run_worker(force_cpu=False)
         except WorkerTimeout:

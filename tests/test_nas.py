@@ -56,6 +56,34 @@ def test_penalty_value_hand_computed():
     np.testing.assert_allclose(got, 2.0 * expected, rtol=1e-5)
 
 
+def test_rho_ramp_and_mult_scale_penalty():
+    """ramp schedule: penalty scales linearly with step over rho_ramp_epochs;
+    rho_mult multiplies on top (the adaptive controller's handle)."""
+    net = _supernet()
+    pcfg = PruneConfig(enable=True, rho=2.0, normalize_cost=False, rho_schedule="ramp", rho_ramp_epochs=1.0)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    masks = masking.init_masks(net)
+    pen_fn = penalty.make_penalty_fn(net, pcfg, steps_per_epoch=10)
+    base_fn = penalty.make_penalty_fn(net, PruneConfig(enable=True, rho=2.0, normalize_cost=False))
+    full = float(base_fn(params, masks))
+    assert float(pen_fn(params, masks, step=jnp.asarray(0))) == 0.0
+    np.testing.assert_allclose(float(pen_fn(params, masks, step=jnp.asarray(5))), 0.5 * full, rtol=1e-5)
+    np.testing.assert_allclose(float(pen_fn(params, masks, step=jnp.asarray(10))), full, rtol=1e-5)
+    np.testing.assert_allclose(float(pen_fn(params, masks, step=jnp.asarray(999))), full, rtol=1e-5)
+    got = float(pen_fn(params, masks, rho_mult=jnp.asarray(3.0), step=jnp.asarray(10)))
+    np.testing.assert_allclose(got, 3.0 * full, rtol=1e-5)
+    # without a step the ramp is skipped, mult still applies
+    np.testing.assert_allclose(float(pen_fn(params, masks, rho_mult=jnp.asarray(0.5))), 0.5 * full, rtol=1e-5)
+
+
+def test_rho_schedule_validation():
+    net = _supernet()
+    with pytest.raises(ValueError, match="rho_schedule"):
+        penalty.make_penalty_fn(net, PruneConfig(enable=True, rho_schedule="bogus"))
+    with pytest.raises(ValueError, match="steps_per_epoch"):
+        penalty.make_penalty_fn(net, PruneConfig(enable=True, rho_schedule="ramp", rho_ramp_epochs=1.0))
+
+
 def test_mask_update_thresholds_and_is_monotonic():
     net = _supernet()
     pcfg = PruneConfig(enable=True, gamma_threshold=0.5)

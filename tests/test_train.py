@@ -102,6 +102,67 @@ def test_rmsprop_tf_semantics_one_step():
     np.testing.assert_allclose(float(upd["w"]), -0.1 * mom, rtol=1e-5)
 
 
+def test_rmsprop_tf_momentum_order_across_lr_boundary():
+    """TF ordering bakes each step's LR into the momentum buffer; compare the
+    full optax chain against hand-computed TF-RMSProp across an LR decay
+    (0.1 -> 0.01 at step 2), where the torch ordering diverges."""
+    d, eps, m = 0.9, 0.01, 0.9
+    lrs = [0.1, 0.1, 0.01, 0.01]
+    grads = [0.5, -0.3, 0.2, 0.4]
+
+    cfg = OptimConfig(optimizer="rmsprop", momentum=m, rmsprop_decay=d, rmsprop_eps=eps, weight_decay=0.0)
+    params = {"w": jnp.asarray(2.0)}
+    opt = optim.make_optimizer(cfg, lambda s: jnp.asarray(lrs)[s], params)
+    st = opt.init(params)
+    p_opt = params
+    for g in grads:
+        upd, st = opt.update({"w": jnp.asarray(g)}, st, p_opt)
+        p_opt = {"w": p_opt["w"] + upd["w"]}
+
+    # hand-computed TF RMSProp: nu0=1; mom = m*mom + lr_t*g/sqrt(nu+eps)
+    nu, mom, p = 1.0, 0.0, 2.0
+    for lr, g in zip(lrs, grads):
+        nu = d * nu + (1 - d) * g * g
+        mom = m * mom + lr * g / np.sqrt(nu + eps)
+        p -= mom
+    np.testing.assert_allclose(float(p_opt["w"]), p, rtol=1e-6)
+
+    # torch ordering (switch off): mom accumulates unscaled rms, lr at apply
+    cfg_t = OptimConfig(optimizer="rmsprop", momentum=m, rmsprop_decay=d, rmsprop_eps=eps,
+                        weight_decay=0.0, rmsprop_tf_momentum_order=False)
+    opt_t = optim.make_optimizer(cfg_t, lambda s: jnp.asarray(lrs)[s], params)
+    st_t = opt_t.init(params)
+    p_torch = params
+    for g in grads:
+        upd, st_t = opt_t.update({"w": jnp.asarray(g)}, st_t, p_torch)
+        p_torch = {"w": p_torch["w"] + upd["w"]}
+    nu, mom, p2 = 1.0, 0.0, 2.0
+    for lr, g in zip(lrs, grads):
+        nu = d * nu + (1 - d) * g * g
+        mom = m * mom + g / np.sqrt(nu + eps)
+        p2 -= lr * mom
+    np.testing.assert_allclose(float(p_torch["w"]), p2, rtol=1e-6)
+    # the two orderings genuinely differ once LR decays
+    assert abs(p - p2) > 1e-4
+
+
+def test_rmsprop_orderings_agree_at_constant_lr():
+    grads = [0.5, -0.3, 0.2]
+    params = {"w": jnp.asarray(2.0)}
+    outs = []
+    for tf_order in (True, False):
+        cfg = OptimConfig(optimizer="rmsprop", momentum=0.9, rmsprop_decay=0.9,
+                          rmsprop_eps=0.01, weight_decay=0.0, rmsprop_tf_momentum_order=tf_order)
+        opt = optim.make_optimizer(cfg, lambda s: 0.1, params)
+        st = opt.init(params)
+        p = params
+        for g in grads:
+            upd, st = opt.update({"w": jnp.asarray(g)}, st, p)
+            p = {"w": p["w"] + upd["w"]}
+        outs.append(float(p["w"]))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+
+
 def test_weight_decay_coupled_before_rms():
     cfg = OptimConfig(optimizer="sgd", momentum=0.0, weight_decay=0.1)
     params = {"conv": {"w": jnp.asarray(2.0)}}

@@ -52,7 +52,9 @@ class Trainer:
         )
         self.params_example, _ = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
         self.optimizer = optim.make_optimizer(cfg.optim, self.lr_fn, self.params_example)
-        self.penalty_fn = penalty.make_penalty_fn(net, cfg.prune) if cfg.prune.enable else None
+        self.penalty_fn = (
+            penalty.make_penalty_fn(net, cfg.prune, self.steps_per_epoch) if cfg.prune.enable else None
+        )
         self.train_step = dp.make_dp_train_step(
             net, cfg, self.optimizer, self.lr_fn, mesh,
             penalty_fn=self.penalty_fn, params_example=self.params_example,
@@ -173,6 +175,7 @@ def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
     new_ts = steps.TrainState(
         step=host_ts.step, params=new_p, state=new_s, opt_state=extras["opt_state"],
         ema_params=extras.get("ema_params"), ema_state=extras.get("ema_state"), masks=new_masks,
+        rho_mult=host_ts.rho_mult,
     )
     return new_trainer, new_trainer.place_state(new_ts)
 
@@ -242,6 +245,10 @@ def run(cfg: Config) -> dict:
     epoch = start_epoch
     best_top1 = float(restored[2].get("best_top1", 0.0)) if restored is not None else 0.0
     host_step = int(ts.step)  # one sync at (re)start, then host-side counting
+    # host mirror of the adaptive rho multiplier (device copy is the one the
+    # step reads; TrainState carries it through checkpoints, so resume picks
+    # the adapted value back up here — one sync at (re)start)
+    rho_mult_host = float(jax.device_get(ts.rho_mult)) if ts.rho_mult is not None else 1.0
     trace_active = False
 
     try:
@@ -267,16 +274,36 @@ def run(cfg: Config) -> dict:
                         trace_active = False
                         log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
 
-                if cfg.prune.enable and trainer.mask_update is not None and step_i % cfg.prune.mask_interval == 0:
-                    if step_i <= prune_stop_step:
+                if (
+                    cfg.prune.enable
+                    and trainer.mask_update is not None
+                    and step_i % cfg.prune.mask_interval == 0
+                    and step_i <= prune_stop_step
+                ):
+                    # mask_summary is a host sync (np.asarray on device masks);
+                    # only pay it when a target-FLOPs decision needs it
+                    reached = False
+                    if cfg.prune.target_flops:
                         summary = masking.mask_summary(trainer.net, ts.masks)
-                        if not (cfg.prune.target_flops and summary["effective_macs"] <= cfg.prune.target_flops):
-                            ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
+                        reached = summary["effective_macs"] <= cfg.prune.target_flops
+                    if cfg.prune.rho_schedule == "adaptive" and cfg.prune.target_flops:
+                        # FLOPs-gap feedback: push harder while above target,
+                        # anneal once reached (SURVEY.md §2 #11)
+                        rate = cfg.prune.rho_adapt_rate
+                        rho_mult_host *= (1.0 - rate) if reached else (1.0 + rate)
+                        rho_mult_host = min(max(rho_mult_host, cfg.prune.rho_adapt_min), cfg.prune.rho_adapt_max)
+                        ts = ts.replace(
+                            rho_mult=mesh_lib.replicate(np.float32(rho_mult_host), trainer.mesh)
+                        )
+                    if not reached:
+                        ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
 
                 if step_i % cfg.train.log_every == 0:
                     snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
                     if cfg.prune.enable:
                         snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
+                        if cfg.prune.rho_schedule == "adaptive":
+                            snap["rho_mult"] = rho_mult_host
                     log.log(format_metrics(f"step {step_i}:", snap))
                     log.scalars(step_i, snap, "train/")
                     if snap.get("finite", 1.0) < 1.0:

@@ -136,6 +136,12 @@ class OptimConfig:
     # TF-style RMSProp constants (eps inside the sqrt; SURVEY.md §7 hard part 2)
     rmsprop_decay: float = 0.9
     rmsprop_eps: float = 0.002
+    # TF momentum ordering: mom = m*mom + lr*g/sqrt(nu+eps), i.e. each step's
+    # LR is baked into the buffer at accumulation time, so past contributions
+    # keep their old LR across decay boundaries. False = torch-RMSprop
+    # ordering (LR multiplies the whole buffer at apply time); the two only
+    # differ while LR changes.
+    rmsprop_tf_momentum_order: bool = True
     weight_decay: float = 1e-5
     # weight-decay exemptions, reference-style (SURVEY.md §2 #7)
     wd_skip_bn: bool = True
@@ -187,6 +193,18 @@ class PruneConfig:
     target_flops: float = 0.0
     # normalize per-channel flops cost by total network flops
     normalize_cost: bool = True
+    # rho dynamics (SURVEY.md §2 #11 "penalty weight (rho) schedule"):
+    #   constant — rho as-is
+    #   ramp     — linear 0 -> rho over the first rho_ramp_epochs
+    #   adaptive — ramp, then multiplicative feedback on the FLOPs gap at the
+    #              mask cadence: x(1+rate) while effective MACs > target_flops,
+    #              x(1-rate) once at/below (anneal), clamped to
+    #              [rho_adapt_min, rho_adapt_max] x rho. Requires target_flops.
+    rho_schedule: str = "constant"
+    rho_ramp_epochs: float = 0.0
+    rho_adapt_rate: float = 0.05
+    rho_adapt_min: float = 0.1
+    rho_adapt_max: float = 10.0
 
 
 @dataclass(frozen=True)

@@ -32,12 +32,27 @@ def atom_cost_table(net: Network, cfg: PruneConfig) -> dict[str, np.ndarray]:
     return {str(i): (c * scale).astype(np.float32) for i, c in prof.atom_costs.items() if i in keep}
 
 
-def make_penalty_fn(net: Network, cfg: PruneConfig):
-    """Returns penalty_fn(params, masks) -> float32 scalar for the train step."""
+def make_penalty_fn(net: Network, cfg: PruneConfig, steps_per_epoch: int | None = None):
+    """Returns penalty_fn(params, masks, rho_mult=None, step=None) -> float32
+    scalar for the train step.
+
+    The effective penalty weight is ``rho * ramp(step) * rho_mult``
+    (SURVEY.md §2 #11 "penalty weight (rho) schedule"): ``ramp`` is the in-jit
+    linear warmup over cfg.rho_ramp_epochs (identity for the constant
+    schedule), and ``rho_mult`` is the adaptive FLOPs-gap multiplier the train
+    loop maintains in TrainState — a traced scalar, so adaptation never
+    recompiles the step."""
+    if cfg.rho_schedule not in ("constant", "ramp", "adaptive"):
+        raise ValueError(f"unknown rho_schedule {cfg.rho_schedule!r}")
     costs = {k: jnp.asarray(v) for k, v in atom_cost_table(net, cfg).items()}
     rho = float(cfg.rho)
+    ramp_steps = 0
+    if cfg.rho_schedule in ("ramp", "adaptive") and cfg.rho_ramp_epochs > 0:
+        if steps_per_epoch is None:
+            raise ValueError("rho_ramp_epochs needs steps_per_epoch")
+        ramp_steps = max(int(cfg.rho_ramp_epochs * steps_per_epoch), 1)
 
-    def penalty_fn(params, masks):
+    def penalty_fn(params, masks, rho_mult=None, step=None):
         total = jnp.zeros((), jnp.float32)
         for k, cost in costs.items():
             gamma = params["blocks"][k]["dw_bn"]["gamma"].astype(jnp.float32)
@@ -45,6 +60,11 @@ def make_penalty_fn(net: Network, cfg: PruneConfig):
             if masks and k in masks:
                 term = term * masks[k].astype(jnp.float32)
             total = total + jnp.sum(term)
-        return rho * total
+        r = jnp.asarray(rho, jnp.float32)
+        if ramp_steps and step is not None:
+            r = r * jnp.clip(step.astype(jnp.float32) / ramp_steps, 0.0, 1.0)
+        if rho_mult is not None:
+            r = r * rho_mult.astype(jnp.float32)
+        return r * total
 
     return penalty_fn

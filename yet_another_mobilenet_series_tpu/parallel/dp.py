@@ -65,7 +65,7 @@ def make_dp_train_step(
         return inner(ts, batch, rng)
 
     ts_spec = TrainState(
-        step=P(), params=P(), state=P(), opt_state=opt_spec, ema_params=P(), ema_state=P(), masks=P()
+        step=P(), params=P(), state=P(), opt_state=opt_spec, ema_params=P(), ema_state=P(), masks=P(), rho_mult=P()
     )
     fn = shard_map(
         shard_fn,

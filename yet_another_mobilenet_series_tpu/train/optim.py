@@ -4,7 +4,11 @@ SURVEY.md §2 #7).
 Reproduced semantics:
 - TF-style RMSProp: accumulator initialized to 1.0, eps *inside* the sqrt,
   heavy-ball momentum applied after the RMS normalization — the combination
-  the MNAS/MobileNet recipes assume (SURVEY.md §7 hard part 2).
+  the MNAS/MobileNet recipes assume (SURVEY.md §7 hard part 2). By default
+  the momentum buffer also accumulates the LR-scaled update (TF ordering:
+  ``mom = m*mom + lr*g/sqrt(nu+eps)``), which differs from torch-RMSprop's
+  apply-time LR across every LR decay boundary; ``rmsprop_tf_momentum_order
+  = false`` selects the torch ordering.
 - Coupled L2 weight decay added to the *gradient* before the optimizer
   transform (torch ``weight_decay=`` semantics, not AdamW-decoupled).
 - Per-parameter weight-decay exemptions: BN gamma/beta and biases (and
@@ -50,13 +54,21 @@ def make_optimizer(cfg: OptimConfig, lr_fn: Callable, params_example) -> optax.G
     if cfg.weight_decay > 0:
         mask = wd_mask(params_example, cfg)
         txs.append(optax.add_decayed_weights(cfg.weight_decay, mask=lambda p: mask))
+    lr_applied = False
     if cfg.optimizer == "rmsprop":
         # TF-style: nu0=1, update = g / sqrt(nu + eps); then momentum.
         txs.append(optax.scale_by_rms(decay=cfg.rmsprop_decay, eps=cfg.rmsprop_eps, initial_scale=1.0))
         if cfg.momentum > 0:
+            if cfg.rmsprop_tf_momentum_order:
+                # TF ordering: mom = m*mom + lr*g/sqrt(nu+eps) — LR scales the
+                # normalized gradient BEFORE it enters the buffer, so earlier
+                # contributions keep the LR of the step that produced them.
+                txs.append(optax.scale_by_learning_rate(lr_fn))
+                lr_applied = True
             txs.append(optax.trace(decay=cfg.momentum, nesterov=False))
     elif cfg.optimizer == "sgd":
         if cfg.momentum > 0:
+            # torch SGD semantics: buf = m*buf + g; param -= lr*buf.
             txs.append(optax.trace(decay=cfg.momentum, nesterov=False))
     elif cfg.optimizer == "adamw":
         # decoupled variant kept for experimentation; wd handled above stays
@@ -64,5 +76,6 @@ def make_optimizer(cfg: OptimConfig, lr_fn: Callable, params_example) -> optax.G
         txs.append(optax.scale_by_adam())
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
-    txs.append(optax.scale_by_learning_rate(lr_fn))
+    if not lr_applied:
+        txs.append(optax.scale_by_learning_rate(lr_fn))
     return optax.chain(*txs)

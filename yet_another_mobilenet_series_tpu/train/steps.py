@@ -33,11 +33,14 @@ class TrainState:
     ema_params: Any  # None when EMA disabled
     ema_state: Any
     masks: Any  # {} when pruning disabled; {block_idx(str): (expanded,)} else
+    # adaptive rho multiplier (nas/penalty.py); None when pruning disabled.
+    # Lives in TrainState so adaptation survives checkpoint/resume.
+    rho_mult: Any = None
 
 
 # single source of truth for the checkpoint tree layout (ckpt/manager.py and
 # resume both build from this; adding a TrainState field updates every site)
-TRAIN_STATE_FIELDS = ("step", "params", "state", "opt_state", "ema_params", "ema_state", "masks")
+TRAIN_STATE_FIELDS = ("step", "params", "state", "opt_state", "ema_params", "ema_state", "masks", "rho_mult")
 
 
 def train_state_to_dict(ts: TrainState) -> dict:
@@ -63,6 +66,7 @@ def init_train_state(
         ema_params=ema_p,
         ema_state=ema_s,
         masks={},
+        rho_mult=jnp.ones((), jnp.float32) if cfg.prune.enable else None,
     )
 
 
@@ -117,16 +121,20 @@ def make_train_step(
         # (jax.checkpoint; SURVEY.md §0 HBM-bandwidth note)
         forward = jax.checkpoint(forward)
 
-    def loss_fn(params, state, batch, masks, rng):
+    def loss_fn(params, state, batch, masks, rho_mult, step, rng):
         logits, new_state = forward(params, state, batch["image"].astype(compute_dtype), masks, rng)
         ce = cross_entropy_label_smooth(logits, batch["label"], cfg.optim.label_smoothing)
-        pen = penalty_fn(params, masks) if penalty_fn is not None else jnp.zeros((), jnp.float32)
+        pen = (
+            penalty_fn(params, masks, rho_mult=rho_mult, step=step)
+            if penalty_fn is not None
+            else jnp.zeros((), jnp.float32)
+        )
         return ce + pen, (new_state, logits, ce, pen)
 
     def step_fn(ts: TrainState, batch, rng):
         rng = jax.random.fold_in(rng, ts.step)
         (loss, (new_state, logits, ce, pen)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            ts.params, ts.state, batch, ts.masks, rng
+            ts.params, ts.state, batch, ts.masks, ts.rho_mult, ts.step, rng
         )
         if axis_name is not None and bn_axis is None:
             # non-SyncBN mode: restore the replication invariant by
