@@ -1,0 +1,163 @@
+"""Torch state_dict importer tests (VERDICT round-1 item #3): a tiny torch
+model with torchvision-MobileNetV2 child structure is exported, imported into
+our tree, and must produce identical logits; malformed checkpoints must fail
+loudly. (Real torchvision is not installed in this sandbox and no pretrained
+.pth exists on disk — the structural layout is replicated exactly here, so a
+real mobilenet_v2-*.pth imports through the same code path.)"""
+
+import numpy as np
+import pytest
+
+import torch
+import torch.nn as nn
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.ckpt import torch_import
+from yet_another_mobilenet_series_tpu.config import ModelConfig
+from yet_another_mobilenet_series_tpu.models import get_model
+
+
+def _convbnrelu(cin, cout, k, s):
+    return nn.Sequential(
+        nn.Conv2d(cin, cout, k, s, padding=k // 2, bias=False),
+        nn.BatchNorm2d(cout),
+        nn.ReLU6(inplace=False),
+    )
+
+
+class TorchInvRes(nn.Module):
+    """torchvision.models.mobilenetv2.InvertedResidual child layout."""
+
+    def __init__(self, cin, cout, expanded, k, s):
+        super().__init__()
+        layers = []
+        if expanded != cin:
+            layers.append(_convbnrelu(cin, expanded, 1, 1))
+        layers.append(
+            nn.Sequential(
+                nn.Conv2d(expanded, expanded, k, s, padding=k // 2, groups=expanded, bias=False),
+                nn.BatchNorm2d(expanded),
+                nn.ReLU6(inplace=False),
+            )
+        )
+        layers.append(nn.Conv2d(expanded, cout, 1, bias=False))
+        layers.append(nn.BatchNorm2d(cout))
+        self.conv = nn.Sequential(*layers)
+        self.use_res = s == 1 and cin == cout
+
+    def forward(self, x):
+        return x + self.conv(x) if self.use_res else self.conv(x)
+
+
+class TorchTinyMBV2(nn.Module):
+    """Dims derived from OUR net spec so the two always agree."""
+
+    def __init__(self, net, num_classes):
+        super().__init__()
+        feats = [_convbnrelu(3, net.stem.out_channels, 3, 2)]
+        for blk in net.blocks:
+            feats.append(
+                TorchInvRes(blk.in_channels, blk.out_channels, blk.expanded_channels, blk.kernel_sizes[0], blk.stride)
+            )
+        feats.append(_convbnrelu(net.head.in_channels, net.head.out_channels, 1, 1))
+        self.features = nn.Sequential(*feats)
+        self.classifier = nn.Sequential(nn.Dropout(0.2), nn.Linear(net.head.out_channels, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.mean([2, 3])
+        return self.classifier(x)
+
+
+def _tiny_net(num_classes=7):
+    cfg = ModelConfig(
+        arch="mobilenet_v2",
+        num_classes=num_classes,
+        dropout=0.0,
+        block_specs=(
+            {"t": 1, "c": 16, "n": 1, "s": 1, "k": 3},
+            {"t": 6, "c": 24, "n": 2, "s": 2, "k": 5},  # n=2: second block is residual
+        ),
+    )
+    return get_model(cfg, image_size=32)
+
+
+def _randomized_torch_model(net, num_classes, seed=0):
+    torch.manual_seed(seed)
+    tm = TorchTinyMBV2(net, num_classes)
+    # non-trivial BN running stats (fresh init would hide mean/var mapping bugs)
+    for m in tm.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn_like(m.running_mean) * 0.3)
+            m.running_var.copy_(torch.rand_like(m.running_var) * 2 + 0.5)
+            m.weight.data.copy_(torch.rand_like(m.weight) + 0.5)
+            m.bias.data.copy_(torch.randn_like(m.bias) * 0.2)
+    return tm.eval()
+
+
+def test_import_matches_torch_forward():
+    net = _tiny_net()
+    tm = _randomized_torch_model(net, 7)
+    params, state = torch_import.from_torchvision_mobilenet_v2(tm.state_dict(), net)
+
+    x = np.random.RandomState(0).normal(0, 1, (4, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    ours, _ = net.apply(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_import_round_trips_bn_buffers():
+    net = _tiny_net()
+    tm = _randomized_torch_model(net, 7, seed=1)
+    params, state = torch_import.from_torchvision_mobilenet_v2(tm.state_dict(), net)
+    # spot-check the buffer mapping on the stem BN
+    np.testing.assert_allclose(
+        np.asarray(state["stem"]["bn"]["mean"]), tm.features[0][1].running_mean.numpy(), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(state["stem"]["bn"]["var"]), tm.features[0][1].running_var.numpy(), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["blocks"]["1"]["expand_bn"]["gamma"]),
+        tm.features[2].conv[0][1].weight.detach().numpy(),
+        rtol=1e-6,
+    )
+
+
+def test_import_rejects_shape_mismatch():
+    net = _tiny_net()
+    tm = _randomized_torch_model(net, 7)
+    sd = dict(tm.state_dict())
+    sd["features.0.0.weight"] = torch.zeros(99, 3, 3, 3)
+    with pytest.raises(torch_import.CheckpointImportError, match="stem.conv"):
+        torch_import.from_torchvision_mobilenet_v2(sd, net)
+
+
+def test_import_rejects_missing_and_leftover_keys():
+    net = _tiny_net()
+    tm = _randomized_torch_model(net, 7)
+    sd = dict(tm.state_dict())
+    del sd["classifier.1.bias"]
+    with pytest.raises(torch_import.CheckpointImportError, match="missing"):
+        torch_import.from_torchvision_mobilenet_v2(sd, net)
+    sd = dict(tm.state_dict())
+    sd["features.99.whatever"] = torch.zeros(1)
+    with pytest.raises(torch_import.CheckpointImportError, match="unconsumed"):
+        torch_import.from_torchvision_mobilenet_v2(sd, net)
+
+
+def test_load_torch_checkpoint_file_with_ddp_prefix(tmp_path):
+    net = _tiny_net()
+    tm = _randomized_torch_model(net, 7, seed=2)
+    wrapped = {"state_dict": {f"module.{k}": v for k, v in tm.state_dict().items()}}
+    path = str(tmp_path / "ckpt.pth")
+    torch.save(wrapped, path)
+    params, state = torch_import.load_torch_checkpoint(path, net)
+    x = np.random.RandomState(1).normal(0, 1, (2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    ours, _ = net.apply(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-5)

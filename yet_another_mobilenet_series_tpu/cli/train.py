@@ -203,15 +203,32 @@ def run(cfg: Config) -> dict:
 
     # ---- eval-only path (acceptance config #1) ----
     if cfg.train.test_only:
-        src = cfg.train.pretrained or cfg.train.log_dir + "/ckpt"
-        mgr = CheckpointManager(src) if cfg.train.pretrained else ckpt
-        restored = _restore(mgr, cfg, mesh, log)
-        if restored is None:
-            log.log("no checkpoint found; evaluating fresh init (smoke mode)")
+        if cfg.train.torch_pretrained:
+            # real pretrained torch weights (torchvision MBV2 layout) — the
+            # "proves the model grammar against real weights" milestone
+            # (SURVEY.md §7 stage 2)
+            from ..ckpt.torch_import import load_torch_checkpoint
+
+            params, state = load_torch_checkpoint(cfg.train.torch_pretrained, net)
             trainer = Trainer(cfg, net, mesh, log)
             ts = trainer.init_state(jax.random.PRNGKey(cfg.train.seed))
+            rep = lambda t: mesh_lib.replicate(t, mesh)  # noqa: E731
+            ts = ts.replace(
+                params=rep(params), state=rep(state),
+                ema_params=rep(params) if cfg.ema.enable else None,
+                ema_state=rep(state) if cfg.ema.enable else None,
+            )
+            log.log(f"imported torch checkpoint {cfg.train.torch_pretrained}")
         else:
-            trainer, ts, _ = restored
+            src = cfg.train.pretrained or cfg.train.log_dir + "/ckpt"
+            mgr = CheckpointManager(src) if cfg.train.pretrained else ckpt
+            restored = _restore(mgr, cfg, mesh, log)
+            if restored is None:
+                log.log("no checkpoint found; evaluating fresh init (smoke mode)")
+                trainer = Trainer(cfg, net, mesh, log)
+                ts = trainer.init_state(jax.random.PRNGKey(cfg.train.seed))
+            else:
+                trainer, ts, _ = restored
         result = evaluate(trainer, ts, cfg)
         log.log(format_metrics("eval:", result))
         ckpt.close()

@@ -225,6 +225,9 @@ class TrainConfig:
     resume: bool = True
     test_only: bool = False
     pretrained: str = ""  # checkpoint path for eval/finetune
+    # torch .pth state_dict (torchvision MobileNetV2 layout) to import for
+    # eval — acceptance #1 against real pretrained weights (ckpt/torch_import)
+    torch_pretrained: str = ""
     # debug guards (SURVEY.md §5 race-detection analogue)
     check_finite_every: int = 0  # 0 = off
     param_checksum_every: int = 0  # cross-replica divergence check, 0 = off
