@@ -9,12 +9,20 @@
 // normalizes straight into pinned float32 NHWC batch buffers handed to
 // Python over a zero-copy ctypes API (data/native_loader.py).
 //
-// Threading model: N worker threads pull sample indices from a shared
-// cursor, decode into per-sample slots of a ring of batch buffers; a batch
-// becomes ready when all its samples are done. The consumer (Python) blocks
-// in loader_next() on the ready queue. Deterministic per-epoch shuffling
-// derives from (seed, epoch); per-sample augment RNG from (seed, index) so
-// results are reproducible regardless of thread interleaving.
+// Threading model: workers claim individual (batch, sample) tasks from the
+// oldest open batch first (work stealing WITHIN a batch — so time-to-first-
+// batch scales with cores, not with batch size), decoding into per-sample
+// slots of a ring of batch buffers; a batch becomes ready when all its
+// samples are done. The consumer (Python) blocks in loader_next() on the
+// ready queue. Deterministic per-epoch shuffling derives from (seed, epoch);
+// per-sample augment RNG from (seed, batch, index) so results are
+// reproducible regardless of thread interleaving or thread count.
+//
+// Eval exactness: with epoch_batches > 0 each pass is padded up to that many
+// batches and positions past the sample list carry label -1 (masked by the
+// eval step) — every example counts exactly once. Train decode failures are
+// retried on deterministically-resampled indices; eval failures yield
+// label -1 so a corrupt file can never count as a confident black image.
 
 #include <cstddef>
 #include <cstdio>
@@ -49,6 +57,13 @@ struct Config {
   float mean[3];
   float std[3];
   float rrc_area_min, rrc_area_max, rrc_ratio_min, rrc_ratio_max;
+  // torchvision-ColorJitter-style strength (brightness/contrast/saturation
+  // factors ~ U[1-s, 1+s]); 0 = off. Train only.
+  float color_jitter;
+  // >0: every pass serves exactly this many batches, padding positions past
+  // the sample list with label -1 (exact eval counting). 0: train semantics
+  // (drop remainder).
+  int64_t epoch_batches;
 };
 
 struct Sample {
@@ -113,10 +128,10 @@ bool decode_jpeg(const std::string& path, std::vector<uint8_t>* out, int* w, int
 // --- resize / crop ---------------------------------------------------------
 
 // Bilinear crop-and-resize from src (sw x sh RGB u8, crop rect) to a
-// dst_size x dst_size float32 HWC tile, normalized and optionally mirrored.
-void crop_resize_normalize(const uint8_t* src, int sw, int sh, int cx, int cy, int cw,
-                           int ch, float* dst, int dst_size, bool flip,
-                           const Config& cfg) {
+// dst_size x dst_size float32 HWC tile in [0, 255], optionally mirrored.
+// Jitter and normalization run as separate passes over the tile.
+void crop_resize(const uint8_t* src, int sw, int sh, int cx, int cy, int cw, int ch,
+                 float* dst, int dst_size, bool flip) {
   const float sx = float(cw) / dst_size;
   const float sy = float(ch) / dst_size;
   for (int y = 0; y < dst_size; ++y) {
@@ -136,11 +151,43 @@ void crop_resize_normalize(const uint8_t* src, int sw, int sh, int cx, int cy, i
         const float v01 = src[(size_t(y0) * sw + x1) * 3 + c];
         const float v10 = src[(size_t(y1) * sw + x0) * 3 + c];
         const float v11 = src[(size_t(y1) * sw + x1) * 3 + c];
-        const float v = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
-                        wy * ((1 - wx) * v10 + wx * v11);
-        d[c] = (v / 255.0f - cfg.mean[c]) / cfg.std[c];
+        d[c] = (1 - wy) * ((1 - wx) * v00 + wx * v01) +
+               wy * ((1 - wx) * v10 + wx * v11);
       }
     }
+  }
+}
+
+inline float luminance(const float* px) {
+  return 0.2989f * px[0] + 0.587f * px[1] + 0.114f * px[2];
+}
+
+// torchvision-ColorJitter semantics on a [0,255] tile, fixed order b->c->s:
+// brightness multiplies, contrast blends with the mean of the grayscale
+// image, saturation blends with the per-pixel grayscale; each op clamps to
+// the valid range (matching torchvision's saturating arithmetic). The
+// tf.data path implements the identical definition (data/pipeline.py
+// _color_jitter) so the two loaders' augmentations agree.
+void color_jitter(float* dst, int dst_size, float fb, float fc, float fs) {
+  const int n = dst_size * dst_size;
+  auto clamp255 = [](float v) { return std::clamp(v, 0.0f, 255.0f); };
+  for (int i = 0; i < n * 3; ++i) dst[i] = clamp255(dst[i] * fb);
+  double gsum = 0.0;
+  for (int i = 0; i < n; ++i) gsum += luminance(dst + size_t(i) * 3);
+  const float gm = float(gsum / n);
+  for (int i = 0; i < n * 3; ++i) dst[i] = clamp255(gm + (dst[i] - gm) * fc);
+  for (int i = 0; i < n; ++i) {
+    float* px = dst + size_t(i) * 3;
+    const float g = luminance(px);
+    for (int c = 0; c < 3; ++c) px[c] = clamp255(g + (px[c] - g) * fs);
+  }
+}
+
+void normalize(float* dst, int dst_size, const Config& cfg) {
+  const int n = dst_size * dst_size;
+  for (int i = 0; i < n; ++i) {
+    float* px = dst + size_t(i) * 3;
+    for (int c = 0; c < 3; ++c) px[c] = (px[c] / 255.0f - cfg.mean[c]) / cfg.std[c];
   }
 }
 
@@ -182,6 +229,16 @@ struct BatchBuf {
   int64_t batch_index = -1;  // global batch id this buffer holds
 };
 
+// A batch whose samples are still being claimed/decoded. Workers claim the
+// oldest open batch's next sample first, so all cores converge on the batch
+// the consumer needs next.
+struct OpenBatch {
+  int slot;
+  int64_t gb;
+  int next_i;  // claim cursor
+  int done;    // completed samples
+};
+
 struct Loader {
   Config cfg;
   std::vector<Sample> samples;
@@ -194,6 +251,7 @@ struct Loader {
   std::vector<BatchBuf> ring;
   std::map<int64_t, int> ready;     // batch index -> ring slot, consumer side
   std::queue<int> free_slots;       // ring slots available to fill
+  std::vector<OpenBatch> open;      // batches mid-decode (oldest first)
   std::mutex mu;
   std::condition_variable cv_ready, cv_free;
   std::atomic<int64_t> next_batch{0};   // producer cursor (global batch id)
@@ -203,6 +261,7 @@ struct Loader {
   std::atomic<int64_t> decode_failures{0};
 
   int64_t batches_per_epoch() const {
+    if (cfg.epoch_batches > 0) return cfg.epoch_batches;  // padded pass (eval)
     return int64_t(samples.size()) / cfg.batch;  // drop_remainder, like train
   }
 
@@ -226,30 +285,58 @@ struct Loader {
     return ord;
   }
 
+  void zero_sample(BatchBuf& buf, int i, int32_t label) {
+    float* dst = buf.images.data() + size_t(i) * cfg.image_size * cfg.image_size * 3;
+    std::memset(dst, 0, sizeof(float) * cfg.image_size * cfg.image_size * 3);
+    buf.labels[i] = label;
+  }
+
+  static constexpr int kDecodeAttempts = 8;
+
   void fill_sample(BatchBuf& buf, int64_t global_batch, int i) {
     const int64_t bpe = batches_per_epoch();
     const int64_t e = global_batch / bpe;
     const auto order_ptr = epoch_order(e);
     const std::vector<uint32_t>& order = *order_ptr;
     const int64_t pos = (global_batch % bpe) * cfg.batch + i;
-    const Sample& s = samples[order[pos]];
-    std::mt19937_64 rng(cfg.seed ^ (uint64_t(global_batch) << 20) ^ uint64_t(i) * 0x2545F4914F6CDD1DULL);
-
-    std::vector<uint8_t> rgb;
-    int w = 0, h = 0;
-    bool ok = decode_jpeg(s.path, &rgb, &w, &h, cfg.train ? 0 : cfg.eval_resize);
-    float* dst = buf.images.data() + size_t(i) * cfg.image_size * cfg.image_size * 3;
-    if (!ok || w <= 0 || h <= 0) {
-      decode_failures.fetch_add(1);
-      std::memset(dst, 0, sizeof(float) * cfg.image_size * cfg.image_size * 3);
-      buf.labels[i] = s.label;
+    if (pos >= int64_t(order.size())) {
+      // padded tail of an exact eval pass: label -1 is masked by the eval step
+      zero_sample(buf, i, -1);
       return;
     }
+    std::mt19937_64 rng(cfg.seed ^ (uint64_t(global_batch) << 20) ^ uint64_t(i) * 0x2545F4914F6CDD1DULL);
+
+    // Train: a corrupt file retries on deterministically-resampled indices
+    // (still reproducible across thread counts); eval keeps the file slot but
+    // yields label -1 so it can never count as a confidently-labeled black
+    // image. If every attempt fails the dataset is broken wholesale — emit
+    // zeros with the last label and let the decode_failures counter (logged
+    // by the train loop) surface it.
+    const int attempts = cfg.train ? kDecodeAttempts : 1;
+    std::vector<uint8_t> rgb;
+    int w = 0, h = 0;
+    const Sample* s = nullptr;
+    bool ok = false;
+    for (int a = 0; a < attempts && !ok; ++a) {
+      s = &samples[order[(pos + int64_t(a) * 9973) % order.size()]];
+      ok = decode_jpeg(s->path, &rgb, &w, &h, cfg.train ? 0 : cfg.eval_resize);
+      if (!ok) decode_failures.fetch_add(1);
+    }
+    if (!ok || w <= 0 || h <= 0) {
+      zero_sample(buf, i, cfg.train ? s->label : -1);
+      return;
+    }
+    float* dst = buf.images.data() + size_t(i) * cfg.image_size * cfg.image_size * 3;
     if (cfg.train) {
       int cx, cy, cw, ch;
       sample_rrc(rng, w, h, cfg, &cx, &cy, &cw, &ch);
       const bool flip = std::uniform_int_distribution<int>(0, 1)(rng) == 1;
-      crop_resize_normalize(rgb.data(), w, h, cx, cy, cw, ch, dst, cfg.image_size, flip, cfg);
+      crop_resize(rgb.data(), w, h, cx, cy, cw, ch, dst, cfg.image_size, flip);
+      if (cfg.color_jitter > 0.0f) {
+        std::uniform_real_distribution<float> uj(1.0f - cfg.color_jitter, 1.0f + cfg.color_jitter);
+        const float fb = uj(rng), fc = uj(rng), fs = uj(rng);
+        color_jitter(dst, cfg.image_size, fb, fc, fs);
+      }
     } else {
       // resize shorter side to eval_resize, center-crop image_size — done in
       // one bilinear pass by cropping the source rect that maps onto the
@@ -258,41 +345,61 @@ struct Loader {
       const float crop_src = cfg.image_size / scale;
       const float cx = (w - crop_src) / 2.0f;
       const float cy = (h - crop_src) / 2.0f;
-      crop_resize_normalize(rgb.data(), w, h, int(std::lround(cx)), int(std::lround(cy)),
-                            int(std::lround(crop_src)), int(std::lround(crop_src)), dst,
-                            cfg.image_size, false, cfg);
+      crop_resize(rgb.data(), w, h, int(std::lround(cx)), int(std::lround(cy)),
+                  int(std::lround(crop_src)), int(std::lround(crop_src)), dst,
+                  cfg.image_size, false);
     }
-    buf.labels[i] = s.label;
+    normalize(dst, cfg.image_size, cfg);
+    buf.labels[i] = s->label;
+  }
+
+  // True when a worker has something to do: an unclaimed sample in an open
+  // batch, or a free slot to open a new batch into. Call with mu held.
+  bool has_task_locked() const {
+    for (const auto& o : open)
+      if (o.next_i < cfg.batch) return true;
+    return !free_slots.empty();
   }
 
   void worker() {
     while (!stop.load()) {
       int slot;
       int64_t gb;
+      int i;
       {
         std::unique_lock<std::mutex> lk(mu);
-        cv_free.wait(lk, [&] { return stop.load() || !free_slots.empty(); });
+        cv_free.wait(lk, [&] { return stop.load() || has_task_locked(); });
         if (stop.load()) return;
-        slot = free_slots.front();
-        free_slots.pop();
-        gb = next_batch.fetch_add(1);
-        ring[slot].batch_index = gb;
+        OpenBatch* ob = nullptr;
+        for (auto& o : open)
+          if (o.next_i < cfg.batch) { ob = &o; break; }  // oldest first
+        if (ob == nullptr) {
+          const int s = free_slots.front();
+          free_slots.pop();
+          const int64_t g = next_batch.fetch_add(1);
+          ring[s].batch_index = g;
+          open.push_back(OpenBatch{s, g, 0, 0});
+          ob = &open.back();
+          if (cfg.batch > 1) cv_free.notify_all();  // more samples up for grabs
+        }
+        slot = ob->slot;
+        gb = ob->gb;
+        i = ob->next_i++;
       }
-      // decode the whole batch in this thread? No: split across threads by
-      // claiming per-sample work. Simplest correct scheme given one claim
-      // per slot: this thread fills the batch; other threads fill other
-      // slots concurrently. (One batch == one thread keeps memory locality;
-      // parallelism comes from the ring depth.)
-      BatchBuf& buf = ring[slot];
-      for (int i = 0; i < cfg.batch; ++i) {
-        if (stop.load()) return;
-        fill_sample(buf, gb, i);
-      }
+      fill_sample(ring[slot], gb, i);
       {
         std::lock_guard<std::mutex> lk(mu);
-        ready.emplace(buf.batch_index, slot);
+        for (auto it = open.begin(); it != open.end(); ++it) {
+          if (it->gb == gb) {
+            if (++(it->done) == cfg.batch) {
+              ready.emplace(gb, slot);
+              open.erase(it);
+              cv_ready.notify_all();
+            }
+            break;
+          }
+        }
       }
-      cv_ready.notify_all();
     }
   }
 
@@ -314,11 +421,13 @@ extern "C" {
 
 void* loader_create(int image_size, int eval_resize, int batch, int num_threads,
                     int train, uint64_t seed, const float* mean, const float* std_,
-                    float area_min, float area_max, float ratio_min, float ratio_max) {
+                    float area_min, float area_max, float ratio_min, float ratio_max,
+                    float color_jitter, int64_t epoch_batches) {
   auto* L = new Loader();
   L->cfg = Config{image_size, eval_resize, batch, num_threads, train, seed,
                   {mean[0], mean[1], mean[2]}, {std_[0], std_[1], std_[2]},
-                  area_min, area_max, ratio_min, ratio_max};
+                  area_min, area_max, ratio_min, ratio_max,
+                  color_jitter, epoch_batches};
   return L;
 }
 
@@ -329,7 +438,10 @@ void loader_add_file(void* handle, const char* path, int32_t label) {
 
 int loader_start(void* handle) {
   auto* L = static_cast<Loader*>(handle);
-  if (L->samples.empty() || int(L->samples.size()) < L->cfg.batch) return -1;
+  // padded (exact-eval) passes may hold less than one full batch; streaming
+  // drop-remainder passes need at least one
+  if (L->samples.empty()) return -1;
+  if (L->cfg.epoch_batches <= 0 && int(L->samples.size()) < L->cfg.batch) return -1;
   const int depth = std::max(2 * L->cfg.num_threads, 4);
   L->ring.resize(depth);
   for (int i = 0; i < depth; ++i) {

@@ -101,3 +101,22 @@ def test_missing_tfrecords_clear_error(tmp_path):
     cfg = DataConfig(dataset="imagenet", data_dir=str(tmp_path))
     with pytest.raises(FileNotFoundError):
         data_lib.make_train_dataset(cfg, 8, seed=0)
+
+
+def test_tf_color_jitter_matches_native_semantics():
+    """Same invariant as test_native_loader's jitter test: a uniform gray
+    image stays uniform (blend-with-gray contrast/saturation) and scales
+    multiplicatively within [1-s, 1+s] across samples."""
+    tf = data_lib._tf_mod()
+    s = 0.4
+    img = tf.fill([32, 32, 3], 128.0)
+    ratios = []
+    for i in range(32):
+        tf.random.set_seed(i)
+        out = data_lib._color_jitter(tf, img, s).numpy()
+        assert float(out.std()) < 1e-3  # uniform in, uniform out
+        ratios.append(float(out.mean()) / 128.0)
+    ratios = np.asarray(ratios)
+    assert np.all(ratios >= 1 - s - 1e-5) and np.all(ratios <= 1 + s + 1e-5)
+    # multiplicative brightness: the factor spreads across the range
+    assert ratios.max() - ratios.min() > 0.2, ratios

@@ -111,20 +111,113 @@ def test_too_few_samples_rejected(image_tree):
         native_loader.NativeLoader(paths[:3], labels[:3], cfg, batch=6, train=True, seed=0)
 
 
-def test_corrupt_jpeg_is_counted_not_fatal(image_tree, tmp_path):
+def test_corrupt_jpeg_eval_yields_masked_label(image_tree, tmp_path):
     cfg = _cfg()
     bad = tmp_path / "bad.jpg"
     bad.write_bytes(b"not a jpeg at all")
     paths, labels, _ = native_loader.list_image_folder(image_tree)
     paths = list(paths[:5]) + [str(bad)]
-    labels = list(labels[:5]) + [0]
+    labels = list(labels[:5]) + [2]
     ld = native_loader.NativeLoader(paths, labels, cfg, batch=6, train=False, seed=0, num_threads=2)
     batch = ld.next_batch()
     assert batch["image"].shape == (6, 32, 32, 3)
     # the loader streams epochs continuously and the ring prefetches ahead, so
     # the counter may already include re-decodes from later epochs: >= 1.
     assert ld.decode_failures >= 1
-    # the corrupt sample itself decodes to zeros; the good ones are intact
+    # eval: the corrupt sample is zeros with label -1 — masked by the eval
+    # step, never a confidently-labeled black image
     assert float(np.abs(batch["image"][5]).mean()) == 0.0
+    assert batch["label"][5] == -1
     assert float(np.abs(batch["image"][0]).mean()) > 0.5
+    assert list(batch["label"][:5]) == labels[:5]
     ld.close()
+
+
+def test_corrupt_jpeg_train_resamples_a_real_image(image_tree, tmp_path):
+    cfg = _cfg()
+    bad = tmp_path / "bad.jpg"
+    bad.write_bytes(b"not a jpeg")
+    paths, labels, _ = native_loader.list_image_folder(image_tree)
+    paths = list(paths[:5]) + [str(bad)]
+    labels = list(labels[:5]) + [2]
+    ld = native_loader.NativeLoader(paths, labels, cfg, batch=6, train=True, seed=0, num_threads=2)
+    batch = ld.next_batch()
+    assert ld.decode_failures >= 1
+    # every slot holds a real decoded image (the corrupt one was resampled)
+    # with a valid label
+    for img, lab in zip(batch["image"], batch["label"]):
+        assert float(np.abs(img).mean()) > 0.1
+        assert 0 <= lab <= 2
+    ld.close()
+
+
+def test_eval_pad_batches_counts_every_example_once(image_tree):
+    """Exact eval counting: 18 files / batch 8 -> 3 padded batches; all 18
+    labels appear once, the 6 pad rows carry label -1 and zero images."""
+    cfg = _cfg()
+    paths, labels, _ = native_loader.list_image_folder(image_tree)
+    ld = native_loader.NativeLoader(paths, labels, cfg, batch=8, train=False, seed=0, num_threads=2, pad_batches=3)
+    got_labels, got_images = [], []
+    for _ in range(3):
+        b = ld.next_batch()
+        got_labels.extend(b["label"].tolist())
+        got_images.extend(list(b["image"]))
+    assert got_labels[:18] == labels
+    assert got_labels[18:] == [-1] * 6
+    for img in got_images[18:]:
+        assert float(np.abs(img).mean()) == 0.0
+    # the next pass repeats the same exact layout (streaming)
+    b = ld.next_batch()
+    assert b["label"].tolist() == labels[:8]
+    ld.close()
+
+
+def test_make_native_eval_loader_multi_host_equal_batches(image_tree, monkeypatch):
+    """Both hosts run the same batch count; the union of real labels is
+    exactly the full file list."""
+    import dataclasses as dc
+
+    cfg = dc.replace(_cfg(), data_dir=os.path.dirname(image_tree), val_split=os.path.basename(image_tree))
+    _, all_labels, _ = native_loader.list_image_folder(image_tree)
+    seen = []
+    counts = []
+    for pi in range(2):
+        ld, n = native_loader.make_native_eval_loader(cfg, local_batch=4, process_index=pi, process_count=2)
+        counts.append(n)
+        for _ in range(n):
+            seen.extend(l for l in ld.next_batch()["label"].tolist() if l >= 0)
+        ld.close()
+    assert counts[0] == counts[1] == 3  # ceil(ceil(18/2)/4)
+    assert sorted(seen) == sorted(all_labels)
+
+
+def test_native_color_jitter_is_multiplicative_and_bounded(tmp_path_factory):
+    """A uniform gray image is a fixed point of contrast/saturation blending,
+    so with jitter on, the output stays uniform and its scale relative to the
+    source spreads across [1-s, 1+s] (multiplicative brightness) — the same
+    invariant the tf.data jitter satisfies (test_data.py)."""
+    root = tmp_path_factory.mktemp("gray")
+    d = root / "c0"
+    d.mkdir()
+    for i in range(8):
+        Image.new("RGB", (64, 64), (128, 128, 128)).save(d / f"g{i}.jpg", quality=98)
+    paths, labels, _ = native_loader.list_image_folder(str(root))
+    import dataclasses as dc
+
+    cfg = dc.replace(_cfg(), color_jitter=0.4, rrc_area_min=0.9, rrc_area_max=1.0)
+    ld = native_loader.NativeLoader(paths, labels, cfg, batch=8, train=True, seed=0, num_threads=2)
+    mean = np.asarray(cfg.mean, np.float32)
+    std = np.asarray(cfg.std, np.float32)
+    ratios = []
+    for _ in range(4):
+        for img in ld.next_batch()["image"]:
+            rgb = img * std + mean  # back to [0,1]
+            assert float(rgb.std()) < 0.02  # uniform in, uniform out
+            ratios.append(float(rgb.mean()) / (128.0 / 255.0))
+    ld.close()
+    ratios = np.asarray(ratios)
+    s = cfg.color_jitter
+    assert np.all(ratios > 1 - s - 0.05) and np.all(ratios < 1 + s + 0.05)
+    # multiplicative: the factor genuinely spreads (additive-at-255-scale or
+    # disabled jitter would collapse this to ~0)
+    assert ratios.max() - ratios.min() > 0.2, ratios

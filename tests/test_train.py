@@ -173,6 +173,44 @@ def test_weight_decay_coupled_before_rms():
     np.testing.assert_allclose(float(upd["conv"]["w"]), -0.2, rtol=1e-6)
 
 
+def test_step_cadence_fires_every_boundary_exactly_once():
+    from yet_another_mobilenet_series_tpu.utils.cadence import StepCadence
+
+    # fractional-epoch chunks (spe=7, epochs=2.43): checks happen at chunk
+    # ends 7, 14, 17 — boundaries 7 and 14 fire once each, 17 is no boundary
+    cad = StepCadence(1.0, 7)
+    assert [cad.due(s) for s in (7, 14, 17)] == [True, True, False]
+
+    # no float drift over many epochs (the `epoch % every < 1e-6` failure)
+    cad = StepCadence(1.0, 3)
+    fired = sum(cad.due(s) for s in range(3, 301, 3))
+    assert fired == 100
+
+    # cadence coarser than a step-chunk: 2.5 epochs * 4 spe = every 10 steps
+    cad = StepCadence(2.5, 4)
+    fired_at = [s for s in range(1, 25) if cad.due(s)]
+    assert fired_at == [10, 20]
+
+    # a jump over several boundaries fires once, then resumes normally
+    cad = StepCadence(1.0, 5)
+    assert cad.due(17) is True  # crossed 5, 10, 15 -> one event
+    assert cad.due(19) is False
+    assert cad.due(20) is True
+
+    # resume anchoring: boundaries at or before start_step already fired
+    cad = StepCadence(1.0, 7, start_step=14)
+    assert cad.due(14) is False
+    assert cad.due(21) is True
+
+    # disabled
+    cad = StepCadence(0.0, 7)
+    assert not any(cad.due(s) for s in range(100))
+
+    # sub-step cadence clamps to every step, never to zero
+    cad = StepCadence(0.25, 2)
+    assert [cad.due(s) for s in (1, 2, 3)] == [True, True, True]
+
+
 def _tiny_cfg(**over):
     d = {
         "model": {

@@ -25,6 +25,7 @@ from ..models.specs import Network
 from ..nas import masking, penalty, rematerialize
 from ..parallel import dp, mesh as mesh_lib
 from ..train import optim, schedules, steps
+from ..utils.cadence import StepCadence
 from ..utils.logging import Logger
 from ..utils.meters import MetricLogger, format_metrics
 from ..utils.profiling import profile_network
@@ -136,10 +137,13 @@ def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=Tru
     SURVEY.md §2 #8); falls back to the live weights when EMA is off."""
     params = ts.ema_params if (use_ema and cfg.ema.enable) else ts.params
     state = ts.ema_state if (use_ema and cfg.ema.enable) else ts.state
-    # round the local eval batch up to mesh divisibility (padding rows carry
-    # label=-1 and are masked out of every count)
+    # eval_batch_size is GLOBAL (matching train's batch_size semantics):
+    # round up to device divisibility, then give each host its share —
+    # per-device eval memory stays constant as host count grows (padding
+    # rows carry label=-1 and are masked out of every count)
     n_dev = trainer.mesh.size
-    local_eval = -(-cfg.train.eval_batch_size // n_dev) * n_dev
+    per_device = -(-cfg.train.eval_batch_size // n_dev)
+    local_eval = per_device * (n_dev // jax.process_count())
     batches = data_lib.make_eval_source(cfg.data, local_eval, jax.process_index(), jax.process_count())
     totals = {"top1": 0.0, "top5": 0.0, "n": 0.0, "loss_sum": 0.0}
     for batch in batches:
@@ -267,6 +271,10 @@ def run(cfg: Config) -> dict:
     # the adapted value back up here — one sync at (re)start)
     rho_mult_host = float(jax.device_get(ts.rho_mult)) if ts.rho_mult is not None else 1.0
     trace_active = False
+    # integer-step cadences (exact boundaries under fractional epochs/resume)
+    eval_cad = StepCadence(cfg.train.eval_every_epochs, spe, host_step)
+    ckpt_cad = StepCadence(cfg.train.checkpoint_every_epochs, spe, host_step)
+    remat_cad = StepCadence(cfg.prune.remat_epochs, spe, host_step)
 
     try:
         while epoch < total_epochs:
@@ -321,6 +329,12 @@ def run(cfg: Config) -> dict:
                         snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
                         if cfg.prune.rho_schedule == "adaptive":
                             snap["rho_mult"] = rho_mult_host
+                    if cfg.data.loader == "native":
+                        # corrupt inputs must be visible, not silent
+                        # (train path resamples; the counter still climbs)
+                        from ..data import native_loader as _nl
+
+                        snap["decode_failures"] = float(_nl.total_decode_failures())
                     log.log(format_metrics(f"step {step_i}:", snap))
                     log.scalars(step_i, snap, "train/")
                     if snap.get("finite", 1.0) < 1.0:
@@ -340,10 +354,13 @@ def run(cfg: Config) -> dict:
             log.log(f"epoch {epoch:.2f} done in {time.perf_counter()-t_epoch:.1f}s")
 
             # coarse-cadence physical shrink (recompile paid here, not per-step)
-            if cfg.prune.enable and cfg.prune.remat_epochs > 0 and (int(epoch) % max(int(cfg.prune.remat_epochs), 1) == 0):
+            if cfg.prune.enable and remat_cad.due(host_step):
                 trainer, ts = _maybe_rematerialize(trainer, ts, log)
 
-            if cfg.train.eval_every_epochs and (epoch % cfg.train.eval_every_epochs) < 1e-6 or epoch >= total_epochs:
+            # final eval AND final checkpoint always run, symmetrically, even
+            # with the periodic knobs set to 0
+            final = epoch >= total_epochs
+            if eval_cad.due(host_step) or final:
                 eval_result = evaluate(trainer, ts, cfg)
                 if eval_result["top1"] > best_top1:  # reference: best-acc tracking
                     best_top1 = eval_result["top1"]
@@ -351,9 +368,7 @@ def run(cfg: Config) -> dict:
                 log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
                 log.scalars(int(ts.step), eval_result, "eval/")
 
-            if cfg.train.checkpoint_every_epochs and (
-                (epoch % cfg.train.checkpoint_every_epochs) < 1e-6 or epoch >= total_epochs
-            ):
+            if ckpt_cad.due(host_step) or final:
                 # orbax coordinates multi-host saves internally; every process
                 # calls in. device_get: the async save must not read buffers
                 # the next step will donate. checkpoint_view makes the tree

@@ -47,6 +47,16 @@ def make_eval_source(cfg: DataConfig, local_batch: int, process_index: int = 0, 
         from . import native_loader
 
         loader, n_batches = native_loader.make_native_eval_loader(cfg, local_batch, process_index, process_count)
-        return (loader.next_batch() for _ in range(n_batches))
+
+        def gen():
+            for _ in range(n_batches):
+                try:
+                    yield loader.next_batch()
+                except native_loader.LoaderExhausted:
+                    # early end of the native stream: clean exhaustion, not a
+                    # PEP 479 RuntimeError mid-eval
+                    return
+
+        return gen()
     ds = _pipeline.make_eval_dataset(cfg, local_batch, process_index, process_count)
     return _pipeline.as_numpy(ds)

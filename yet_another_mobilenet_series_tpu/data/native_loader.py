@@ -22,6 +22,17 @@ from ..config import DataConfig
 _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native", "libyamt_loader.so")
 _lib = None
 
+# live loaders, so the train loop can log aggregate decode failures without
+# holding a reference to the loader behind its iterator wrappers
+import weakref
+
+_live_loaders: "weakref.WeakSet[NativeLoader]" = weakref.WeakSet()
+
+
+def total_decode_failures() -> int:
+    """Sum of decode failures across live loaders (0 when none exist)."""
+    return sum(l.decode_failures for l in list(_live_loaders) if l._handle is not None)
+
 
 def build_library(force: bool = False) -> str:
     """Compiles native/libyamt_loader.so if missing (g++ + libjpeg)."""
@@ -40,6 +51,7 @@ def _load():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int64,
     ]
     lib.loader_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
     lib.loader_start.argtypes = [ctypes.c_void_p]
@@ -71,6 +83,12 @@ def list_image_folder(root: str) -> tuple[list[str], list[int], list[str]]:
     return paths, labels, classes
 
 
+class LoaderExhausted(Exception):
+    """The native stream ended (loader stopped/destroyed). A dedicated type —
+    NOT StopIteration, which PEP 479 turns into RuntimeError when raised
+    through a generator (data/__init__.py wraps next_batch in generators)."""
+
+
 class NativeLoader:
     """Iterator over decoded/augmented batches from the C++ pipeline.
 
@@ -89,7 +107,10 @@ class NativeLoader:
         train: bool,
         seed: int = 0,
         num_threads: int | None = None,
+        pad_batches: int = 0,
     ):
+        """pad_batches > 0: every pass serves exactly that many batches,
+        padding past the sample list with label=-1 (exact eval counting)."""
         lib = _load()
         mean = (ctypes.c_float * 3)(*cfg.mean)
         std = (ctypes.c_float * 3)(*cfg.std)
@@ -100,13 +121,17 @@ class NativeLoader:
             cfg.image_size, cfg.eval_resize, batch,
             num_threads or cfg.decode_threads, int(train), seed, mean, std,
             cfg.rrc_area_min, cfg.rrc_area_max, cfg.rrc_ratio_min, cfg.rrc_ratio_max,
+            cfg.color_jitter if train else 0.0, pad_batches,
         )
         for p, l in zip(paths, labels):
             lib.loader_add_file(self._handle, os.fsencode(p), int(l))
         if lib.loader_start(self._handle) != 0:
             lib.loader_destroy(self._handle)
             self._handle = None
+            if pad_batches:
+                raise ValueError("padded eval pass needs at least one sample")
             raise ValueError(f"need at least one full batch of samples ({batch}); got {len(paths)}")
+        _live_loaders.add(self)
 
     @property
     def num_samples(self) -> int:
@@ -118,7 +143,10 @@ class NativeLoader:
 
     def __iter__(self) -> Iterator[dict]:
         while True:
-            yield self.next_batch()
+            try:
+                yield self.next_batch()
+            except LoaderExhausted:
+                return
 
     def next_batch(self) -> dict:
         images = np.empty((self._batch, self._size, self._size, 3), np.float32)
@@ -129,7 +157,7 @@ class NativeLoader:
             labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         )
         if rc != 0:
-            raise StopIteration
+            raise LoaderExhausted
         return {"image": images, "label": labels}
 
     def close(self):
@@ -163,17 +191,19 @@ def make_native_train_iter(
 def make_native_eval_loader(
     cfg: DataConfig, local_batch: int, process_index: int = 0, process_count: int = 1
 ) -> tuple[NativeLoader, int]:
-    """Returns (loader, num_batches) for one eval pass over this host's
-    shard. num_batches is computed from the SMALLEST host shard so every
-    host runs the same number of collective eval steps (no deadlock); the
-    native path additionally drops each shard's tail remainder — use the
-    tf.data eval pipeline when exact every-example-once counting matters."""
+    """Returns (loader, num_batches) for one EXACT eval pass over this host's
+    shard: every example counts once. num_batches derives from the LARGEST
+    host shard (a number all hosts agree on without communicating), so every
+    host runs the same count of collective eval steps; shards smaller than
+    num_batches*batch pad the tail with label=-1 rows, which the eval step
+    masks out of every metric."""
     paths, labels, _ = list_image_folder(os.path.join(cfg.data_dir, cfg.val_split))
     total = len(paths)
     paths, labels = _host_shard(paths, labels, process_index, process_count)
-    loader = NativeLoader(paths, labels, cfg, local_batch, train=False)
-    min_shard = total // process_count  # smallest host shard size
-    return loader, min_shard // local_batch
+    max_shard = -(-total // process_count)  # largest host shard size (ceil)
+    n_batches = max(-(-max_shard // local_batch), 1)
+    loader = NativeLoader(paths, labels, cfg, local_batch, train=False, pad_batches=n_batches)
+    return loader, n_batches
 
 
 if __name__ == "__main__":

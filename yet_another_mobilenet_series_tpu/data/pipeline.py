@@ -82,9 +82,19 @@ def _decode_center_crop(tf, image_bytes, cfg: DataConfig):
 
 
 def _color_jitter(tf, image, strength: float):
-    image = tf.image.random_brightness(image, max_delta=strength)
-    image = tf.image.random_contrast(image, 1.0 - strength, 1.0 + strength)
-    image = tf.image.random_saturation(image, 1.0 - strength, 1.0 + strength)
+    """torchvision-ColorJitter semantics on a [0,255] float image, fixed
+    order brightness→contrast→saturation: brightness multiplies (additive
+    tf.image.random_brightness would be a no-op at this scale), contrast
+    blends with the mean of the grayscale image, saturation blends with the
+    per-pixel grayscale; each op clamps. The native C++ loader implements
+    the identical definition (native/yamt_loader.cc color_jitter) so the two
+    loaders' augmentations agree."""
+    lo, hi = 1.0 - strength, 1.0 + strength
+    image = tf.clip_by_value(image * tf.random.uniform([], lo, hi), 0.0, 255.0)
+    gray = tf.image.rgb_to_grayscale(image)  # luminance weights .2989/.587/.114
+    gm = tf.reduce_mean(gray)
+    image = tf.clip_by_value(gm + (image - gm) * tf.random.uniform([], lo, hi), 0.0, 255.0)
+    image = tf.clip_by_value(gray + (image - gray) * tf.random.uniform([], lo, hi), 0.0, 255.0)
     return image
 
 
