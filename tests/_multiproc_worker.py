@@ -1,0 +1,67 @@
+"""Worker for the true multi-process distributed test (tests/test_multiproc.py).
+
+Runs as `python tests/_multiproc_worker.py <pid> <nproc> <port> <tmpdir>`:
+joins a real jax.distributed cluster of <nproc> CPU processes (4 fake devices
+each), then drives the full cli_train.run() — per-process data sharding
+(make_array_from_process_local_data), psum SyncBN + grad pmean across hosts,
+eval batch-count equalization, coordinator-only logging, and the coordinated
+Orbax save. Prints one `RESULT {json}` line for the parent to compare.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid, nproc, port, tmpdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    import jax
+
+    # env var is not enough: sitecustomize force-registers the TPU platform
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * nproc
+
+    from yet_another_mobilenet_series_tpu.cli import train as cli_train
+    from yet_another_mobilenet_series_tpu.config import config_from_dict
+
+    cfg = config_from_dict({
+        "name": "multiproc",
+        "model": {
+            "arch": "mobilenet_v2",
+            "num_classes": 8,
+            "dropout": 0.0,
+            "block_specs": [
+                {"t": 3, "c": 16, "n": 1, "s": 2, "k": 3},
+                {"t": 3, "c": 24, "n": 1, "s": 2, "k": 3},
+            ],
+        },
+        # fake_eval_size 72 does NOT divide eval batches evenly: 72/2 hosts =
+        # 36 each, batch 16 -> 3 padded batches/host (equalization exercised)
+        "data": {"dataset": "fake", "image_size": 32, "fake_train_size": 1280, "fake_eval_size": 72},
+        "optim": {"optimizer": "sgd", "momentum": 0.9, "weight_decay": 1e-5},
+        "schedule": {"schedule": "constant", "base_lr": 0.05, "scale_by_batch": False, "warmup_epochs": 0.2},
+        "ema": {"enable": True, "decay": 0.99},
+        "train": {
+            "batch_size": 64,
+            "eval_batch_size": 32,
+            "epochs": 2,
+            "log_every": 2,
+            "compute_dtype": "float32",
+            "log_dir": tmpdir,
+            "eval_every_epochs": 1.0,
+            "param_checksum_every": 5,  # cross-HOST divergence check in-loop
+        },
+        "dist": {"num_devices": 4 * nproc},
+    })
+    result = cli_train.run(cfg)
+    # every process must agree on the metrics (they come out of collectives)
+    print(f"RESULT {json.dumps({'pid': pid, **{k: round(float(v), 6) for k, v in result.items()}})}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

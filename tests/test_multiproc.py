@@ -1,0 +1,63 @@
+"""True multi-process distributed test (VERDICT round-1 item #8 / SURVEY §7
+hard part 5): two real jax.distributed CPU processes x 4 fake devices run the
+full training CLI — exercising make_array_from_process_local_data batch
+assembly, cross-host psum/pmean, eval batch-count equalization, coordinator-
+only checkpointing — and must agree on every reported metric."""
+
+import glob
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_training_run(tmp_path):
+    port = _free_port()
+    nproc = 2
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(repo, "tests", "_multiproc_worker.py"),
+             str(pid), str(nproc), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=repo, env=env,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+
+    results = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, out[-2000:]
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+
+    r0, r1 = results
+    # metrics come out of cross-host collectives: both processes must agree
+    for k in r0:
+        if k == "pid":
+            continue
+        assert r0[k] == r1[k], (k, r0, r1)
+    # the padded-eval equalization must still count every example exactly once
+    assert r0["eval_n"] == 72
+    assert r0["epoch"] == 2.0
+    # exactly one coordinated checkpoint tree (written once, not per process)
+    metas = glob.glob(str(tmp_path) + "/ckpt/*/meta*")
+    assert metas, "no checkpoint written"
+    # training on the learnable fake set must beat 8-class chance
+    assert r0["eval_top1"] > 0.2, r0

@@ -136,7 +136,8 @@ def _tfrecord_files(cfg: DataConfig, split: str) -> list[str]:
 def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1):
     tf = _tf_mod()
     if cfg.dataset == "fake":
-        return _fake_dataset(cfg, local_batch, seed, train=True)
+        return _fake_dataset(cfg, local_batch, seed, train=True,
+                             process_index=process_index, process_count=process_count)
     files = _tfrecord_files(cfg, cfg.train_split)
     ds = tf.data.Dataset.from_tensor_slices(files)
     ds = ds.shard(process_count, process_index)
@@ -184,7 +185,8 @@ def make_eval_dataset(cfg: DataConfig, local_batch: int, process_index: int = 0,
     tf = _tf_mod()
     target = eval_batches_per_host(cfg, local_batch, process_count)
     if cfg.dataset == "fake":
-        ds = _fake_dataset(cfg, local_batch, seed=0, train=False)
+        ds = _fake_dataset(cfg, local_batch, seed=0, train=False,
+                           process_index=process_index, process_count=process_count)
     else:
         files = _tfrecord_files(cfg, cfg.val_split)
         ds = tf.data.Dataset.from_tensor_slices(files)
@@ -232,11 +234,14 @@ def _pad_batch(tf, batch, local_batch):
 # ---------------------------------------------------------------------------
 
 
-def _fake_dataset(cfg: DataConfig, local_batch: int, seed: int, train: bool):
+def _fake_dataset(cfg: DataConfig, local_batch: int, seed: int, train: bool,
+                  process_index: int = 0, process_count: int = 1):
     """Learnable synthetic classification: each class has a fixed random
     template; samples are noisy copies. A real model reaches high accuracy in
     a few epochs — which is what the loss-decreases integration tests need
-    (SURVEY.md §4.3)."""
+    (SURVEY.md §4.3). Sharded per host like the TFRecord path — without it
+    every host would serve the identical stream (duplicate rows in the global
+    train batch; double-counted-then-truncated eval)."""
     tf = _tf_mod()
     n_classes = cfg.fake_num_classes or 1000
     n = cfg.fake_train_size if train else cfg.fake_eval_size
@@ -248,6 +253,7 @@ def _fake_dataset(cfg: DataConfig, local_batch: int, seed: int, train: bool):
     labels = (np.arange(n) % n_classes).astype(np.int32)
     noise_rng = np.random.RandomState(seed + 1 if train else 987654)
     images = templates[labels] + 0.3 * noise_rng.normal(0, 1, (n, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    images, labels = images[process_index::process_count], labels[process_index::process_count]
     ds = tf.data.Dataset.from_tensor_slices({"image": images, "label": labels})
     if train:
         ds = ds.shuffle(n, seed=seed).repeat()
