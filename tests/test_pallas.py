@@ -33,6 +33,22 @@ def test_fused_matches_reference(k, stride, act):
     np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("c", [160, 200])
+def test_fused_channel_blocking_matches_reference(c):
+    """Channels beyond _C_BLOCK split across grid steps — including a
+    non-divisible count (200 = 128 + 72 with a padded tail block)."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.normal(size=(2, 9, 9, c)).astype(np.float32))
+    wt = jnp.asarray(rng.normal(size=(3, 3, c)).astype(np.float32) * 0.2)
+    scale = jnp.asarray(rng.uniform(0.5, 1.5, c).astype(np.float32))
+    shift = jnp.asarray(rng.uniform(-0.3, 0.3, c).astype(np.float32))
+    mask = jnp.ones(c).at[::5].set(0.0)
+    for stride in (1, 2):
+        y = pk.fused_depthwise_inference(x, wt, scale, shift, mask, stride, "hswish", True)
+        y_ref = pk._reference_fwd(x, wt, scale, shift, mask, stride=stride, act="hswish")
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-5)
+
+
 def test_fused_equals_layer_pipeline():
     """Kernel == Conv2D(depthwise) -> BN(eval) -> act -> mask from ops/."""
     c, k = 8, 3

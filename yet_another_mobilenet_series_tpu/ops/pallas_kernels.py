@@ -58,6 +58,14 @@ def _dw_kernel(x_ref, w_ref, scale_ref, shift_ref, mask_ref, o_ref, *, k: int, s
     o_ref[0] = (y * mask_ref[...]).astype(o_ref.dtype)
 
 
+# Channel tile: depthwise is channel-independent, so the channel axis blocks
+# freely for ANY stride (no halo logic needed, unlike spatial tiling). 128 =
+# one VPU lane register width; it bounds per-step VMEM at the widest blocks
+# (112x112 spatial x 128ch f32 in+out ~ 13 MB < ~16 MB VMEM; bf16 half that)
+# where the old one-image-per-step layout overflowed at real widths.
+_C_BLOCK = 128
+
+
 @functools.partial(jax.jit, static_argnames=("stride", "act", "interpret"))
 def _fused_dw_fwd(x, w, scale, shift, mask, *, stride: int, act: str, interpret: bool = False):
     n, h, wd, c = x.shape
@@ -67,18 +75,19 @@ def _fused_dw_fwd(x, w, scale, shift, mask, *, stride: int, act: str, interpret:
     out_w = (wd - 1) // stride + 1
     xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
 
+    cb = min(c, _C_BLOCK)
     kernel = functools.partial(_dw_kernel, k=k, stride=stride, act=act, out_h=out_h, out_w=out_w)
     return pl.pallas_call(
         kernel,
-        grid=(n,),
+        grid=(n, pl.cdiv(c, cb)),
         in_specs=[
-            pl.BlockSpec((1, h + 2 * pad, wd + 2 * pad, c), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((k, k, c), lambda i: (0, 0, 0)),
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((1, h + 2 * pad, wd + 2 * pad, cb), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((k, k, cb), lambda i, j: (0, 0, j)),
+            pl.BlockSpec((cb,), lambda i, j: (j,)),
+            pl.BlockSpec((cb,), lambda i, j: (j,)),
+            pl.BlockSpec((cb,), lambda i, j: (j,)),
         ],
-        out_specs=pl.BlockSpec((1, out_h, out_w, c), lambda i: (i, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, out_h, out_w, cb), lambda i, j: (i, 0, 0, j)),
         out_shape=jax.ShapeDtypeStruct((n, out_h, out_w, c), x.dtype),
         interpret=interpret,
     )(xp, w, scale, shift, mask)
