@@ -438,9 +438,10 @@ void loader_add_file(void* handle, const char* path, int32_t label) {
 
 int loader_start(void* handle) {
   auto* L = static_cast<Loader*>(handle);
-  // padded (exact-eval) passes may hold less than one full batch; streaming
-  // drop-remainder passes need at least one
-  if (L->samples.empty()) return -1;
+  // padded (exact-eval) passes may hold ANY sample count — including zero
+  // (a host whose shard is empty serves all-dummy label=-1 batches so the
+  // collective eval step count still matches its peers). Streaming
+  // drop-remainder passes need at least one full batch.
   if (L->cfg.epoch_batches <= 0 && int(L->samples.size()) < L->cfg.batch) return -1;
   const int depth = std::max(2 * L->cfg.num_threads, 4);
   L->ring.resize(depth);

@@ -120,3 +120,26 @@ def test_tf_color_jitter_matches_native_semantics():
     assert np.all(ratios >= 1 - s - 1e-5) and np.all(ratios <= 1 + s + 1e-5)
     # multiplicative brightness: the factor spreads across the range
     assert ratios.max() - ratios.min() > 0.2, ratios
+
+
+def test_tf_color_jitter_exact_semantics():
+    """Pin the exact op definition (matching native/yamt_loader.cc): mult
+    brightness -> blend with mean POST-brightness gray -> blend with
+    PER-PIXEL POST-CONTRAST gray, clamping each step. Factors are recovered
+    by replaying the seeded uniform sequence."""
+    tf = data_lib._tf_mod()
+    s = 0.4
+    rng = np.random.RandomState(3)
+    img_np = rng.uniform(0, 255, (6, 6, 3)).astype(np.float32)
+    tf.random.set_seed(123)
+    fb, fc, fs = (float(tf.random.uniform([], 1 - s, 1 + s)) for _ in range(3))
+    tf.random.set_seed(123)
+    out = data_lib._color_jitter(tf, tf.constant(img_np), s).numpy()
+
+    lum = np.array([0.2989, 0.587, 0.114], np.float32)
+    x = np.clip(img_np * fb, 0, 255)
+    gray = (x @ lum)[..., None]
+    x = np.clip(gray.mean() + (x - gray.mean()) * fc, 0, 255)
+    gray2 = (x @ lum)[..., None]  # recomputed AFTER contrast
+    x = np.clip(gray2 + (x - gray2) * fs, 0, 255)
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-2)

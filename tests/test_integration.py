@@ -164,3 +164,28 @@ def _check_resume(tmp_path, over, capsys):
     cfg3 = _base_cfg(tmp_path, **{**over, "train.epochs": 2.5})
     result2 = cli_train.run(cfg3)
     assert result2["epoch"] >= 2.0
+
+
+def test_resume_from_legacy_checkpoint_without_rho_mult(tmp_path, monkeypatch, capsys):
+    """Checkpoints written before TrainState grew rho_mult must still resume
+    (restore retries without the field and injects the neutral multiplier)."""
+    from yet_another_mobilenet_series_tpu.train import steps as steps_mod
+
+    over = {
+        "model.arch": "atomnas_supernet",
+        "model.block_specs": [{"t": 4, "c": 16, "n": 1, "s": 2, "k": [3, 5]}],
+        "prune.enable": True,
+        "prune.mask_interval": 4,
+        "prune.remat_epochs": 0.0,
+        "train.epochs": 1,
+    }
+    # simulate the legacy on-disk layout: save without the rho_mult leaf
+    legacy_fields = tuple(f for f in steps_mod.TRAIN_STATE_FIELDS if f != "rho_mult")
+    monkeypatch.setattr(steps_mod, "TRAIN_STATE_FIELDS", legacy_fields)
+    cli_train.run(_base_cfg(tmp_path, **over))
+    monkeypatch.undo()
+
+    result = cli_train.run(_base_cfg(tmp_path, **{**over, "train.epochs": 1.5}))
+    out = capsys.readouterr().out
+    assert "retrying as legacy checkpoint" in out
+    assert result["epoch"] >= 1.5

@@ -82,6 +82,9 @@ def test_rho_schedule_validation():
         penalty.make_penalty_fn(net, PruneConfig(enable=True, rho_schedule="bogus"))
     with pytest.raises(ValueError, match="steps_per_epoch"):
         penalty.make_penalty_fn(net, PruneConfig(enable=True, rho_schedule="ramp", rho_ramp_epochs=1.0))
+    # adaptive without a target would silently never engage — reject up front
+    with pytest.raises(ValueError, match="target_flops"):
+        penalty.make_penalty_fn(net, PruneConfig(enable=True, rho_schedule="adaptive"), steps_per_epoch=10)
 
 
 def test_mask_update_thresholds_and_is_monotonic():

@@ -191,6 +191,18 @@ def test_make_native_eval_loader_multi_host_equal_batches(image_tree, monkeypatc
     assert sorted(seen) == sorted(all_labels)
 
 
+def test_empty_shard_padded_eval_serves_all_dummy_batches():
+    """A host whose eval shard is empty must still run the agreed batch count
+    (all label=-1) or its peers deadlock in the collective eval step."""
+    cfg = _cfg()
+    ld = native_loader.NativeLoader([], [], cfg, batch=4, train=False, seed=0, num_threads=2, pad_batches=2)
+    for _ in range(2):
+        b = ld.next_batch()
+        assert b["label"].tolist() == [-1] * 4
+        assert float(np.abs(b["image"]).max()) == 0.0
+    ld.close()
+
+
 def test_native_color_jitter_is_multiplicative_and_bounded(tmp_path_factory):
     """A uniform gray image is a fixed point of contrast/saturation blending,
     so with jitter on, the output stays uniform and its scale relative to the

@@ -122,12 +122,24 @@ class Trainer:
 def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
     """Two-phase resume (SURVEY.md §3.5): spec -> rebuild at pruned shape ->
     weights. Returns (trainer, ts, extra) or None."""
+    import jax.numpy as jnp
+
     spec = ckpt.restore_spec()
     if spec is None:
         return None
     step, net, extra = spec
     trainer = Trainer(cfg, net, mesh, log)
-    tree = ckpt.restore_tree(step, steps.train_state_to_dict(trainer.abstract_state()))
+    abstract = steps.train_state_to_dict(trainer.abstract_state())
+    try:
+        tree = ckpt.restore_tree(step, abstract)
+    except Exception as e:  # noqa: BLE001 — orbax raises bare ValueError
+        if "rho_mult" not in abstract or abstract["rho_mult"] is None:
+            raise
+        # legacy checkpoint written before TrainState grew rho_mult: restore
+        # without it and inject the neutral multiplier
+        log.log(f"restore with rho_mult failed ({type(e).__name__}); retrying as legacy checkpoint")
+        tree = ckpt.restore_tree(step, {k: v for k, v in abstract.items() if k != "rho_mult"})
+        tree["rho_mult"] = jnp.ones((), jnp.float32)
     ts = trainer.place_state(steps.TrainState(**tree))
     return trainer, ts, extra
 

@@ -49,13 +49,17 @@ def make_eval_source(cfg: DataConfig, local_batch: int, process_index: int = 0, 
         loader, n_batches = native_loader.make_native_eval_loader(cfg, local_batch, process_index, process_count)
 
         def gen():
-            for _ in range(n_batches):
+            for served in range(n_batches):
                 try:
                     yield loader.next_batch()
                 except native_loader.LoaderExhausted:
-                    # early end of the native stream: clean exhaustion, not a
-                    # PEP 479 RuntimeError mid-eval
-                    return
+                    # a padded eval pass has a KNOWN length; ending early means
+                    # the loader died (stale .so, concurrent close) — and on a
+                    # pod this host would run fewer collective steps than its
+                    # peers, deadlocking them. Fail loudly with context.
+                    raise RuntimeError(
+                        f"native eval stream ended after {served}/{n_batches} batches"
+                    ) from None
 
         return gen()
     ds = _pipeline.make_eval_dataset(cfg, local_batch, process_index, process_count)

@@ -35,8 +35,13 @@ def total_decode_failures() -> int:
 
 
 def build_library(force: bool = False) -> str:
-    """Compiles native/libyamt_loader.so if missing (g++ + libjpeg)."""
-    if force or not os.path.exists(_LIB_PATH):
+    """Compiles native/libyamt_loader.so (g++ + libjpeg). Always runs make —
+    a no-op when up to date — so a stale prebuilt library can never be used
+    against newer ctypes signatures (the C ABI has grown arguments before;
+    extra args are silently dropped by the calling convention)."""
+    if force:
+        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH), "-B"], check=True, capture_output=True)
+    else:
         subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)], check=True, capture_output=True)
     return _LIB_PATH
 

@@ -94,6 +94,9 @@ def _color_jitter(tf, image, strength: float):
     gray = tf.image.rgb_to_grayscale(image)  # luminance weights .2989/.587/.114
     gm = tf.reduce_mean(gray)
     image = tf.clip_by_value(gm + (image - gm) * tf.random.uniform([], lo, hi), 0.0, 255.0)
+    # saturation blends with the grayscale of the POST-contrast image
+    # (recomputed, as the C++ loader does) — not the pre-contrast gray
+    gray = tf.image.rgb_to_grayscale(image)
     image = tf.clip_by_value(gray + (image - gray) * tf.random.uniform([], lo, hi), 0.0, 255.0)
     return image
 

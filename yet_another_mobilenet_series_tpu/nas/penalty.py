@@ -44,6 +44,8 @@ def make_penalty_fn(net: Network, cfg: PruneConfig, steps_per_epoch: int | None 
     recompiles the step."""
     if cfg.rho_schedule not in ("constant", "ramp", "adaptive"):
         raise ValueError(f"unknown rho_schedule {cfg.rho_schedule!r}")
+    if cfg.rho_schedule == "adaptive" and not cfg.target_flops:
+        raise ValueError("rho_schedule='adaptive' needs prune.target_flops (the controller feeds on the FLOPs gap)")
     costs = {k: jnp.asarray(v) for k, v in atom_cost_table(net, cfg).items()}
     rho = float(cfg.rho)
     ramp_steps = 0
