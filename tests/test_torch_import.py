@@ -149,6 +149,125 @@ def test_import_rejects_missing_and_leftover_keys():
         torch_import.from_torchvision_mobilenet_v2(sd, net)
 
 
+class TorchSE(nn.Module):
+    """torchvision.ops.SqueezeExcitation child layout (fc1/fc2 1x1 convs)."""
+
+    def __init__(self, c, se):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc1 = nn.Conv2d(c, se, 1)
+        self.fc2 = nn.Conv2d(se, c, 1)
+        self.activation = nn.ReLU()
+        self.scale_activation = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = self.scale_activation(self.fc2(self.activation(self.fc1(s))))
+        return x * s
+
+
+def _convbnact(cin, cout, k, s, act, groups=1):
+    return nn.Sequential(
+        nn.Conv2d(cin, cout, k, s, padding=k // 2, groups=groups, bias=False),
+        nn.BatchNorm2d(cout),
+        act,
+    )
+
+
+class TorchV3InvRes(nn.Module):
+    """torchvision.models.mobilenetv3.InvertedResidual child layout."""
+
+    def __init__(self, blk):
+        super().__init__()
+        act = nn.Hardswish() if blk.active_fn == "hswish" else nn.ReLU()
+        e, k, s = blk.expanded_channels, blk.kernel_sizes[0], blk.stride
+        layers = []
+        if blk.has_expand:
+            layers.append(_convbnact(blk.in_channels, e, 1, 1, act))
+        layers.append(_convbnact(e, e, k, s, act, groups=e))
+        if blk.se_channels:
+            layers.append(TorchSE(e, blk.se_channels))
+        layers.append(_convbnact(e, blk.out_channels, 1, 1, nn.Identity()))
+        self.block = nn.Sequential(*layers)
+        self.use_res = blk.has_residual
+
+    def forward(self, x):
+        return x + self.block(x) if self.use_res else self.block(x)
+
+
+class TorchTinyMBV3(nn.Module):
+    def __init__(self, net, num_classes):
+        super().__init__()
+        feats = [_convbnact(3, net.stem.out_channels, 3, 2, nn.Hardswish())]
+        feats.extend(TorchV3InvRes(blk) for blk in net.blocks)
+        feats.append(_convbnact(net.head.in_channels, net.head.out_channels, 1, 1, nn.Hardswish()))
+        self.features = nn.Sequential(*feats)
+        self.classifier = nn.Sequential(
+            nn.Linear(net.head.out_channels, net.feature.out_features),
+            nn.Hardswish(),
+            nn.Dropout(0.2),
+            nn.Linear(net.feature.out_features, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.mean([2, 3])
+        return self.classifier(x)
+
+
+def test_v3_import_matches_torch_forward():
+    """V3 layout: SE (fc1/fc2 1x1 convs with bias), hswish, feature FC head."""
+    from yet_another_mobilenet_series_tpu.models import zoo
+
+    cfg = ModelConfig(
+        arch="mobilenet_v3_large",
+        num_classes=5,
+        dropout=0.0,
+        block_specs=(
+            {"t": 1, "c": 16, "n": 1, "s": 1, "k": 3, "act": "relu"},
+            {"t": 4, "c": 24, "n": 1, "s": 2, "k": 5, "se": 0.25, "act": "hswish"},
+            {"t": 4, "c": 24, "n": 1, "s": 1, "k": 3, "act": "hswish"},  # residual
+        ),
+    )
+    net = get_model(cfg, image_size=32)
+    torch.manual_seed(0)
+    tm = TorchTinyMBV3(net, 5)
+    for m in tm.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn_like(m.running_mean) * 0.3)
+            m.running_var.copy_(torch.rand_like(m.running_var) * 2 + 0.5)
+            m.weight.data.copy_(torch.rand_like(m.weight) + 0.5)
+            m.bias.data.copy_(torch.randn_like(m.bias) * 0.2)
+    tm.eval()
+
+    params, state = torch_import.from_torchvision_mobilenet_v3(tm.state_dict(), net)
+    x = np.random.RandomState(2).normal(0, 1, (4, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    ours, _ = net.apply(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_load_torch_checkpoint_auto_detects_v3(tmp_path):
+    cfg = ModelConfig(
+        arch="mobilenet_v3_large",
+        num_classes=3,
+        dropout=0.0,
+        block_specs=({"t": 2, "c": 16, "n": 1, "s": 2, "k": 3, "se": 0.25, "act": "hswish"},),
+    )
+    net = get_model(cfg, image_size=32)
+    torch.manual_seed(1)
+    tm = TorchTinyMBV3(net, 3).eval()
+    path = str(tmp_path / "v3.pth")
+    torch.save(tm.state_dict(), path)
+    params, state = torch_import.load_torch_checkpoint(path, net)
+    x = np.random.RandomState(3).normal(0, 1, (2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    ours, _ = net.apply(params, state, jnp.asarray(x), train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-5)
+
+
 def test_load_torch_checkpoint_file_with_ddp_prefix(tmp_path):
     net = _tiny_net()
     tm = _randomized_torch_model(net, 7, seed=2)

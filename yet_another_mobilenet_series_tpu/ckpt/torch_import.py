@@ -150,9 +150,100 @@ def from_torchvision_mobilenet_v2(state_dict: Mapping[str, Any], net: Network) -
     return jax.tree.map(jnp.asarray, params), jax.tree.map(jnp.asarray, state)
 
 
+def from_torchvision_mobilenet_v3(state_dict: Mapping[str, Any], net: Network) -> tuple[dict, dict]:
+    """torchvision MobileNetV3 layout: blocks live under
+    ``features.{i+1}.block.{j}`` (expand / depthwise / SqueezeExcitation /
+    project sub-modules, SE as 1x1 convs fc1/fc2 WITH bias), the head conv at
+    ``features.{n+1}``, and the classifier as
+    ``classifier.0`` (the 1280-wide "feature" Linear) + ``classifier.3``.
+
+    Parity note: torchvision V3 BatchNorms use eps=1e-3 (momentum 0.01) —
+    build the target net with ``model.bn_eps=1e-3`` or evals will drift."""
+    sd = _SD(state_dict)
+    params: dict = {}
+    state: dict = {}
+
+    w = _conv_w(sd.take("features.0.0.weight"))
+    k = net.stem.kernel_size
+    params["stem"] = {"conv": {"w": _check("stem.conv", w, (k, k, 3, net.stem.out_channels))}}
+    bn_p, bn_s = sd.bn("features.0.1")
+    params["stem"]["bn"], state["stem"] = bn_p, {"bn": bn_s}
+
+    bp: dict = {}
+    bs: dict = {}
+    for i, blk in enumerate(net.blocks):
+        f = f"features.{i + 1}.block"
+        if len(blk.kernel_sizes) != 1:
+            raise CheckpointImportError(f"block {i}: multi-kernel supernet blocks are not a torchvision layout")
+        kd = blk.kernel_sizes[0]
+        e = blk.expanded_channels
+        p: dict = {}
+        s: dict = {}
+        j = 0
+        if blk.has_expand:
+            p["expand"] = {
+                "w": _check(f"block{i}.expand", _conv_w(sd.take(f"{f}.{j}.0.weight")), (1, 1, blk.in_channels, e))
+            }
+            p["expand_bn"], s["expand_bn"] = sd.bn(f"{f}.{j}.1")
+            j += 1
+        p[f"dw0_k{kd}"] = {
+            "w": _check(f"block{i}.dw", _conv_w(sd.take(f"{f}.{j}.0.weight")), (kd, kd, 1, e))
+        }
+        p["dw_bn"], s["dw_bn"] = sd.bn(f"{f}.{j}.1")
+        j += 1
+        if blk.se_channels:
+            se = blk.se_channels
+            fc1 = _np(sd.take(f"{f}.{j}.fc1.weight"))[:, :, 0, 0].T  # (se,C,1,1) -> (C,se)
+            fc2 = _np(sd.take(f"{f}.{j}.fc2.weight"))[:, :, 0, 0].T  # (C,se,1,1) -> (se,C)
+            p["se"] = {
+                "reduce": {"w": _check(f"block{i}.se.fc1", fc1, (e, se)),
+                           "b": _np(sd.take(f"{f}.{j}.fc1.bias"))},
+                "expand": {"w": _check(f"block{i}.se.fc2", fc2, (se, e)),
+                           "b": _np(sd.take(f"{f}.{j}.fc2.bias"))},
+            }
+            j += 1
+        p["project"] = {
+            "w": _check(f"block{i}.project", _conv_w(sd.take(f"{f}.{j}.0.weight")), (1, 1, e, blk.out_channels))
+        }
+        p["project_bn"], s["project_bn"] = sd.bn(f"{f}.{j}.1")
+        bp[str(i)], bs[str(i)] = p, s
+    params["blocks"], state["blocks"] = bp, bs
+
+    if net.head is None or net.feature is None:
+        raise CheckpointImportError("MobileNetV3 layout requires head conv + feature Linear")
+    hi = len(net.blocks) + 1
+    w = _conv_w(sd.take(f"features.{hi}.0.weight"))
+    params["head"] = {
+        "conv": {"w": _check("head.conv", w, (1, 1, net.head.in_channels, net.head.out_channels))}
+    }
+    bn_p, bn_s = sd.bn(f"features.{hi}.1")
+    params["head"]["bn"], state["head"] = bn_p, {"bn": bn_s}
+
+    params["feature"] = {
+        "w": _check("feature.w", _np(sd.take("classifier.0.weight")).T,
+                    (net.feature.in_features, net.feature.out_features)),
+        "b": _check("feature.b", _np(sd.take("classifier.0.bias")), (net.feature.out_features,)),
+    }
+    params["classifier"] = {
+        "w": _check("classifier.w", _np(sd.take("classifier.3.weight")).T,
+                    (net.classifier.in_features, net.classifier.out_features)),
+        "b": _check("classifier.b", _np(sd.take("classifier.3.bias")), (net.classifier.out_features,)),
+    }
+
+    left = sd.leftovers()
+    if left:
+        raise CheckpointImportError(f"unconsumed checkpoint tensors: {left[:8]}{'...' if len(left) > 8 else ''}")
+
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(jnp.asarray, params), jax.tree.map(jnp.asarray, state)
+
+
 def load_torch_checkpoint(path: str, net: Network) -> tuple[dict, dict]:
     """Loads a .pth/.pt file (a raw state_dict or a dict holding one under
-    'state_dict'/'model') and imports it into ``net``'s tree layout."""
+    'state_dict'/'model') and imports it into ``net``'s tree layout. The
+    torchvision layout (V2 `.conv.` vs V3 `.block.`) is auto-detected."""
     import torch
 
     obj = torch.load(path, map_location="cpu", weights_only=True)
@@ -163,4 +254,6 @@ def load_torch_checkpoint(path: str, net: Network) -> tuple[dict, dict]:
                 break
     # strip DistributedDataParallel's 'module.' prefix if present
     obj = {k.removeprefix("module."): v for k, v in obj.items()}
+    if any(".block." in k for k in obj):
+        return from_torchvision_mobilenet_v3(obj, net)
     return from_torchvision_mobilenet_v2(obj, net)
