@@ -166,6 +166,59 @@ def _check_resume(tmp_path, over, capsys):
     assert result2["epoch"] >= 2.0
 
 
+def test_warm_start_finetune_from_checkpoint(tmp_path, capsys):
+    """train.pretrained on a fresh (non-resumed) training run warm-starts the
+    weights with a fresh optimizer/step — after a few finetune steps accuracy
+    stays near the source's, which a fresh init cannot reach that fast."""
+    src_dir, ft_dir = tmp_path / "src", tmp_path / "ft"
+    trained = cli_train.run(_base_cfg(src_dir, **{"train.epochs": 3}))
+    assert trained["eval_top1"] > 0.5
+    cfg_ft = _base_cfg(ft_dir, **{
+        "train.epochs": 0.25,  # 5 steps
+        "train.pretrained": str(src_dir / "ckpt"),
+        "schedule.base_lr": 0.005,
+    })
+    result = cli_train.run(cfg_ft)
+    out = capsys.readouterr().out
+    assert "warm start from checkpoint" in out
+    assert result["eval_top1"] > 0.5, result  # fresh init gets ~0.125 in 5 steps
+
+
+def test_warm_start_finetune_from_torch_checkpoint(tmp_path, capsys):
+    import torch
+
+    from tests.test_torch_import import _randomized_torch_model, _tiny_net
+
+    net = _tiny_net(num_classes=8)
+    tm = _randomized_torch_model(net, 8)
+    torch.save(tm.state_dict(), str(tmp_path / "w.pth"))
+    cfg = _base_cfg(tmp_path, **{
+        "model.block_specs": [
+            {"t": 1, "c": 16, "n": 1, "s": 1, "k": 3},
+            {"t": 6, "c": 24, "n": 2, "s": 2, "k": 5},
+        ],
+        "train.epochs": 0.25,
+        "train.torch_pretrained": str(tmp_path / "w.pth"),
+    })
+    result = cli_train.run(cfg)
+    out = capsys.readouterr().out
+    assert "warm start from torch checkpoint" in out
+    assert result["epoch"] == pytest.approx(0.25)
+
+
+def test_best_checkpoint_kept_and_evaluable(tmp_path):
+    """train.keep_best maintains a single-slot best-top1 checkpoint (the
+    reference's best.pth); evaluating it reproduces the recorded best."""
+    cfg = _base_cfg(tmp_path, **{"train.epochs": 3})
+    result = cli_train.run(cfg)
+    assert glob.glob(str(tmp_path) + "/ckpt_best/*/meta*")
+    cfg_eval = _base_cfg(
+        tmp_path, **{"train.test_only": True, "train.pretrained": str(tmp_path) + "/ckpt_best"}
+    )
+    best_eval = cli_train.run(cfg_eval)
+    np.testing.assert_allclose(best_eval["top1"], result["eval_best_top1"], atol=1e-6)
+
+
 def test_resume_from_legacy_checkpoint_without_rho_mult(tmp_path, monkeypatch, capsys):
     """Checkpoints written before TrainState grew rho_mult must still resume
     (restore retries without the field and injects the neutral multiplier)."""

@@ -196,6 +196,52 @@ def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
     return new_trainer, new_trainer.place_state(new_ts)
 
 
+def _init_or_warm_start(cfg: Config, net: Network, mesh, log: Logger, rng):
+    """Fresh TrainState — or, when train.pretrained / train.torch_pretrained
+    is set on a non-resumed training run, a warm start: weights (+ BN stats,
+    + masks for a pruned source) from the source checkpoint, with a FRESH
+    optimizer/step/EMA-shadow (finetune semantics — the reference's
+    pretrained-init path, SURVEY.md §3.3)."""
+    if cfg.train.torch_pretrained:
+        from ..ckpt.torch_import import load_torch_checkpoint
+
+        import jax.numpy as jnp
+
+        params, state = load_torch_checkpoint(cfg.train.torch_pretrained, net)
+        trainer = Trainer(cfg, net, mesh, log)
+        ts = trainer.init_state(rng)
+        rep = lambda t: mesh_lib.replicate(t, mesh)  # noqa: E731
+        # EMA shadow must be a real copy, never an alias of the live buffers
+        # (aliasing breaks donation of the TrainState)
+        ts = ts.replace(
+            params=rep(params), state=rep(state),
+            ema_params=rep(jax.tree.map(jnp.copy, params)) if cfg.ema.enable else None,
+            ema_state=rep(jax.tree.map(jnp.copy, state)) if cfg.ema.enable else None,
+        )
+        log.log(f"warm start from torch checkpoint {cfg.train.torch_pretrained}")
+        return trainer, ts
+    if cfg.train.pretrained:
+        import jax.numpy as jnp
+
+        mgr = CheckpointManager(cfg.train.pretrained)
+        src = _restore(mgr, cfg, mesh, log)
+        mgr.close()
+        if src is None:
+            raise FileNotFoundError(f"train.pretrained={cfg.train.pretrained!r} holds no checkpoint")
+        trainer, src_ts, _ = src  # trainer is built on the source's (possibly pruned) net
+        ts = trainer.init_state(rng)
+        copy = lambda t: jax.tree.map(jnp.copy, t)  # noqa: E731
+        ts = ts.replace(
+            params=src_ts.params, state=src_ts.state, masks=src_ts.masks,
+            ema_params=copy(src_ts.params) if cfg.ema.enable else None,
+            ema_state=copy(src_ts.state) if cfg.ema.enable else None,
+        )
+        log.log(f"warm start from checkpoint {cfg.train.pretrained} (step {int(src_ts.step)} weights, fresh optimizer)")
+        return trainer, ts
+    trainer = Trainer(cfg, net, mesh, log)
+    return trainer, trainer.init_state(rng)
+
+
 def run(cfg: Config) -> dict:
     import dataclasses as dc
 
@@ -260,8 +306,7 @@ def run(cfg: Config) -> dict:
         log.log(f"resumed at step {int(ts.step)} (epoch {start_epoch:.2f})")
     else:
         log.mark_fresh_run()  # truncate metrics.jsonl: steps restart at 0
-        trainer = Trainer(cfg, net, mesh, log)
-        ts = trainer.init_state(rng)
+        trainer, ts = _init_or_warm_start(cfg, net, mesh, log, rng)
 
     local_batch = mesh_lib.local_batch_slice(cfg.train.batch_size, mesh)
     train_iter = mesh_lib.prefetch_to_mesh(
@@ -287,6 +332,7 @@ def run(cfg: Config) -> dict:
     eval_cad = StepCadence(cfg.train.eval_every_epochs, spe, host_step)
     ckpt_cad = StepCadence(cfg.train.checkpoint_every_epochs, spe, host_step)
     remat_cad = StepCadence(cfg.prune.remat_epochs, spe, host_step)
+    best_ckpt: CheckpointManager | None = None  # created on first new-best eval
 
     try:
         while epoch < total_epochs:
@@ -376,6 +422,16 @@ def run(cfg: Config) -> dict:
                 eval_result = evaluate(trainer, ts, cfg)
                 if eval_result["top1"] > best_top1:  # reference: best-acc tracking
                     best_top1 = eval_result["top1"]
+                    if cfg.train.keep_best:
+                        # single-slot best checkpoint (reference: best.pth) —
+                        # separate dir so resume always uses the latest while
+                        # the best stays evaluable via train.pretrained
+                        if best_ckpt is None:
+                            best_ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt_best", max_to_keep=1)
+                        best_ckpt.save(
+                            int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)),
+                            extra={"epoch": epoch, "best_top1": best_top1},
+                        )
                 eval_result["best_top1"] = best_top1
                 log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
                 log.scalars(int(ts.step), eval_result, "eval/")
@@ -424,6 +480,9 @@ def run(cfg: Config) -> dict:
 
     ckpt.wait()
     ckpt.close()
+    if best_ckpt is not None:
+        best_ckpt.wait()
+        best_ckpt.close()
     final = {"epoch": epoch, **{f"eval_{k}": v for k, v in eval_result.items()}}
     log.log(format_metrics("done:", final))
     log.close()

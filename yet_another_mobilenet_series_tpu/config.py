@@ -221,6 +221,9 @@ class TrainConfig:
     eval_every_epochs: float = 1.0
     checkpoint_every_epochs: float = 1.0
     max_checkpoints: int = 3
+    # keep a single best-eval-top1 checkpoint in log_dir/ckpt_best (the
+    # reference lineage's best.pth); resumable/evaluable like any checkpoint
+    keep_best: bool = True
     log_dir: str = "/tmp/yamt_logs"
     resume: bool = True
     test_only: bool = False
