@@ -52,7 +52,10 @@ class Trainer:
             cfg.schedule, cfg.train.batch_size, self.steps_per_epoch, cfg.train.epochs
         )
         self.params_example, _ = jax.eval_shape(lambda: net.init(jax.random.PRNGKey(0)))
-        self.optimizer = optim.make_optimizer(cfg.optim, self.lr_fn, self.params_example)
+        self.optimizer = optim.make_optimizer(
+            cfg.optim, self.lr_fn, self.params_example,
+            shard_axis=mesh_lib.DATA_AXIS if cfg.dist.shard_optimizer else None,
+        )
         self.penalty_fn = (
             penalty.make_penalty_fn(net, cfg.prune, self.steps_per_epoch) if cfg.prune.enable else None
         )

@@ -48,8 +48,9 @@ def make_dp_train_step(
     sharded_update = None
     opt_spec = P()
     if shard_opt:
-        if cfg.optim.grad_clip_norm > 0:
-            raise NotImplementedError("grad_clip_norm with shard_optimizer: per-shard clip would use the wrong norm")
+        # grad_clip_norm works here: the optimizer must be built with
+        # make_optimizer(..., shard_axis=DATA_AXIS) so its clip stage psums
+        # the true global norm across shards (train/optim.py)
         from . import zero
 
         sharded_update = zero.make_zero_update(optimizer, mesh.size)

@@ -20,9 +20,34 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 import optax
+from jax import lax
 
 from ..config import OptimConfig
+
+
+def clip_by_global_norm(max_norm: float, psum_axis: str | None = None) -> optax.GradientTransformation:
+    """optax.clip_by_global_norm, but norm-aware of cross-replica sharding:
+    with ``psum_axis`` the squared norm is psum'd so that clipping a ZeRO
+    gradient SHARD uses the true global norm (each replica computes the same
+    scale, so shards stay consistent). Same (empty) state as optax's — the
+    optimizer state tree is checkpoint-compatible either way."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        sq = optax.global_norm(updates) ** 2
+        if psum_axis is not None:
+            sq = lax.psum(sq, psum_axis)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-16))
+        return jax.tree.map(lambda u: u * scale, updates), state
+
+    return optax.GradientTransformation(init, update)
 
 
 def wd_mask(params, cfg: OptimConfig):
@@ -47,10 +72,15 @@ def wd_mask(params, cfg: OptimConfig):
     return mask_tree(params)
 
 
-def make_optimizer(cfg: OptimConfig, lr_fn: Callable, params_example) -> optax.GradientTransformation:
+def make_optimizer(
+    cfg: OptimConfig, lr_fn: Callable, params_example, *, shard_axis: str | None = None
+) -> optax.GradientTransformation:
+    """``shard_axis``: set to the mesh axis name when the optimizer will run
+    on ZeRO gradient shards (dist.shard_optimizer) so grad clipping psums the
+    true global norm instead of clipping per-shard."""
     txs = []
     if cfg.grad_clip_norm > 0:
-        txs.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+        txs.append(clip_by_global_norm(cfg.grad_clip_norm, psum_axis=shard_axis))
     if cfg.weight_decay > 0:
         mask = wd_mask(params_example, cfg)
         txs.append(optax.add_decayed_weights(cfg.weight_decay, mask=lambda p: mask))
