@@ -248,6 +248,25 @@ def test_v3_import_matches_torch_forward():
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-4, atol=1e-5)
 
 
+def test_v3_import_warns_on_bn_eps_mismatch():
+    """torchvision V3 BNs use eps=1e-3; importing into a 1e-5 net must warn
+    (the drift is silent otherwise), and a 1e-3 net imports quietly."""
+    import warnings
+
+    specs = ({"t": 2, "c": 16, "n": 1, "s": 2, "k": 3, "act": "hswish"},)
+    net_default = get_model(ModelConfig(arch="mobilenet_v3_large", num_classes=3, dropout=0.0, block_specs=specs), 32)
+    torch.manual_seed(4)
+    tm = TorchTinyMBV3(net_default, 3).eval()
+    with pytest.warns(UserWarning, match="bn_eps"):
+        torch_import.from_torchvision_mobilenet_v3(tm.state_dict(), net_default)
+    net_match = get_model(
+        ModelConfig(arch="mobilenet_v3_large", num_classes=3, dropout=0.0, block_specs=specs, bn_eps=1e-3), 32
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        torch_import.from_torchvision_mobilenet_v3(tm.state_dict(), net_match)
+
+
 def test_load_torch_checkpoint_auto_detects_v3(tmp_path):
     cfg = ModelConfig(
         arch="mobilenet_v3_large",

@@ -158,7 +158,14 @@ def test_zero_grad_clip_matches_replicated(setup):
     ts_rep = mesh_lib.replicate(steps.init_train_state(net, cfg_rep, opt_rep, jax.random.PRNGKey(0)), mesh)
     ts_rep, met_rep = dp.make_dp_train_step(net, cfg_rep, opt_rep, lr_fn, mesh)(ts_rep, b, jax.random.PRNGKey(7))
     ts_z = _zero_state(net, cfg_z, opt_z, mesh)
-    ts_z, met_z = dp.make_dp_train_step(net, cfg_z, opt_z, lr_fn, mesh)(ts_z, b, jax.random.PRNGKey(7))
+    ts_z, met_z = dp.make_dp_train_step(net, cfg_z, opt_z, lr_fn, mesh, clip_shard_aware=True)(
+        ts_z, b, jax.random.PRNGKey(7)
+    )
+
+    # an optimizer NOT attested as shard-aware must be rejected loudly — a
+    # plain clip would silently clip each shard by its local norm
+    with pytest.raises(ValueError, match="shard_axis"):
+        dp.make_dp_train_step(net, cfg_z, opt_rep, lr_fn, mesh)
 
     # the clip must have engaged (reported grad_norm is pre-clip)
     assert float(met_rep["grad_norm"]) > 0.05

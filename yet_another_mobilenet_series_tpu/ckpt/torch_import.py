@@ -158,7 +158,16 @@ def from_torchvision_mobilenet_v3(state_dict: Mapping[str, Any], net: Network) -
     ``classifier.0`` (the 1280-wide "feature" Linear) + ``classifier.3``.
 
     Parity note: torchvision V3 BatchNorms use eps=1e-3 (momentum 0.01) —
-    build the target net with ``model.bn_eps=1e-3`` or evals will drift."""
+    build the target net with ``model.bn_eps=1e-3`` or evals will drift
+    (warned below, since the CLI user never sees this docstring)."""
+    if abs(net.stem.bn_eps - 1e-3) > 1e-12:
+        import warnings
+
+        warnings.warn(
+            f"importing a torchvision-V3-layout checkpoint into a net with bn_eps={net.stem.bn_eps} "
+            "— torchvision MobileNetV3 uses bn_eps=1e-3; set model.bn_eps=1e-3 or top-1 will drift",
+            stacklevel=2,
+        )
     sd = _SD(state_dict)
     params: dict = {}
     state: dict = {}

@@ -62,6 +62,7 @@ class Trainer:
         self.train_step = dp.make_dp_train_step(
             net, cfg, self.optimizer, self.lr_fn, mesh,
             penalty_fn=self.penalty_fn, params_example=self.params_example,
+            clip_shard_aware=cfg.dist.shard_optimizer,  # optimizer built with shard_axis above
         )
         self.eval_step = dp.make_dp_eval_step(net, cfg, mesh)
         self.mask_update = jax.jit(masking.make_mask_update(net, cfg.prune)) if cfg.prune.enable else None
@@ -269,21 +270,10 @@ def run(cfg: Config) -> dict:
     # ---- eval-only path (acceptance config #1) ----
     if cfg.train.test_only:
         if cfg.train.torch_pretrained:
-            # real pretrained torch weights (torchvision MBV2 layout) — the
-            # "proves the model grammar against real weights" milestone
-            # (SURVEY.md §7 stage 2)
-            from ..ckpt.torch_import import load_torch_checkpoint
-
-            params, state = load_torch_checkpoint(cfg.train.torch_pretrained, net)
-            trainer = Trainer(cfg, net, mesh, log)
-            ts = trainer.init_state(jax.random.PRNGKey(cfg.train.seed))
-            rep = lambda t: mesh_lib.replicate(t, mesh)  # noqa: E731
-            ts = ts.replace(
-                params=rep(params), state=rep(state),
-                ema_params=rep(params) if cfg.ema.enable else None,
-                ema_state=rep(state) if cfg.ema.enable else None,
-            )
-            log.log(f"imported torch checkpoint {cfg.train.torch_pretrained}")
+            # real pretrained torch weights — the "proves the model grammar
+            # against real weights" milestone (SURVEY.md §7 stage 2); shares
+            # the warm-start import path (EMA shadow = imported weights)
+            trainer, ts = _init_or_warm_start(cfg, net, mesh, log, jax.random.PRNGKey(cfg.train.seed))
         else:
             src = cfg.train.pretrained or cfg.train.log_dir + "/ckpt"
             mgr = CheckpointManager(src) if cfg.train.pretrained else ckpt

@@ -35,6 +35,7 @@ def make_dp_train_step(
     *,
     penalty_fn=None,
     params_example=None,
+    clip_shard_aware: bool = False,
 ):
     """jitted (ts, batch, rng) -> (ts, metrics) over the mesh.
 
@@ -48,9 +49,15 @@ def make_dp_train_step(
     sharded_update = None
     opt_spec = P()
     if shard_opt:
-        # grad_clip_norm works here: the optimizer must be built with
-        # make_optimizer(..., shard_axis=DATA_AXIS) so its clip stage psums
-        # the true global norm across shards (train/optim.py)
+        if cfg.optim.grad_clip_norm > 0 and not clip_shard_aware:
+            # a plain optax clip inside the ZeRO update would clip each
+            # gradient SHARD by its own local norm (~global/sqrt(N)); the
+            # caller must build the optimizer with
+            # make_optimizer(..., shard_axis=DATA_AXIS) and attest it here
+            raise ValueError(
+                "grad_clip_norm with shard_optimizer requires an optimizer built with "
+                "make_optimizer(..., shard_axis=DATA_AXIS); pass clip_shard_aware=True to attest"
+            )
         from . import zero
 
         sharded_update = zero.make_zero_update(optimizer, mesh.size)
