@@ -147,6 +147,15 @@ def _worker_body(force_cpu: bool):
         b = mesh_lib.shard_batch(host_batch, mesh)
         return step_fn, ts, b
 
+    def sync(arr):
+        """Hard sync: device_get of a dependent scalar. block_until_ready is
+        NOT a reliable barrier through the axon tunnel — it often returns at
+        dispatch-acknowledge time, which made round-2's first 'measurement'
+        report a physically impossible 3.6x inflated rate (and >100% 'MFU'
+        on eval microbenches). Only an actual device->host transfer of a
+        value that depends on the work is trustworthy here."""
+        return float(np.asarray(jax.device_get(arr)).ravel()[0])
+
     key = jax.random.PRNGKey(0)
     attempts = [(batch, False), (batch // 2, False), (batch // 2, True), (batch // 4, True)]
     step_fn = ts = b = None
@@ -155,7 +164,7 @@ def _worker_body(force_cpu: bool):
             step_fn, ts, b = build(try_batch, remat)
             t0 = time.perf_counter()
             ts, metrics = step_fn(ts, b, key)
-            jax.block_until_ready(metrics["loss"])
+            sync(metrics["loss"])
             batch = try_batch
             log(f"batch {batch} remat={remat}: compile+first step {time.perf_counter()-t0:.1f}s")
             break
@@ -172,13 +181,13 @@ def _worker_body(force_cpu: bool):
     # warmup
     for _ in range(3):
         ts, metrics = step_fn(ts, b, key)
-    jax.block_until_ready(metrics["loss"])
+    sync(metrics["loss"])
 
     iters = 20 if platform == "tpu" else 5
     t0 = time.perf_counter()
     for _ in range(iters):
         ts, metrics = step_fn(ts, b, key)
-    jax.block_until_ready(metrics["loss"])
+    sync(metrics["loss"])
     dt = time.perf_counter() - t0
     img_s = batch * iters / dt
     img_s_chip = img_s / n_chips

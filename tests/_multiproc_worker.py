@@ -21,7 +21,13 @@ def main():
 
     # env var is not enough: sitecustomize force-registers the TPU platform
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc, process_id=pid)
+    # generous shutdown barrier: on a loaded single-core sandbox the
+    # coordinator's final checkpoint flush can lag the other process by
+    # minutes, and the default 300 s barrier then kills the whole test
+    jax.distributed.initialize(
+        f"localhost:{port}", num_processes=nproc, process_id=pid,
+        shutdown_timeout_seconds=1200,
+    )
     assert jax.process_count() == nproc, jax.process_count()
     assert jax.local_device_count() == 4
     assert jax.device_count() == 4 * nproc

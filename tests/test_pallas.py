@@ -70,39 +70,6 @@ def test_fused_equals_layer_pipeline():
     np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_layers), rtol=1e-4, atol=1e-5)
 
 
-def test_fused_eval_network_path_matches_standard(monkeypatch):
-    """End-to-end: Network.apply(fused_eval=True) == standard eval path on an
-    AtomNAS supernet with masks, SE, multi-kernel branches, stride 2.
-    (YAMT_PALLAS_INTERPRET opts into the interpreter off-TPU; without it the
-    blocks fall back to XLA and this test would compare XLA with XLA.)"""
-    import jax.numpy as jnp
-
-    monkeypatch.setenv("YAMT_PALLAS_INTERPRET", "1")
-
-    from yet_another_mobilenet_series_tpu.config import ModelConfig
-    from yet_another_mobilenet_series_tpu.models import get_model
-
-    cfg = ModelConfig(
-        arch="atomnas_supernet",
-        num_classes=6,
-        dropout=0.0,
-        block_specs=(
-            {"t": 4, "c": 12, "n": 1, "s": 2, "k": [3, 5]},
-            {"t": 4, "c": 16, "n": 1, "s": 1, "k": [3, 5, 7], "se": 0.25},
-        ),
-    )
-    net = get_model(cfg, image_size=24)
-    params, state = net.init(jax.random.PRNGKey(0))
-    x = jax.random.normal(jax.random.PRNGKey(1), (2, 24, 24, 3))
-    # non-trivial BN stats
-    _, state = net.apply(params, state, x, train=True)
-    masks = {1: jnp.ones(net.blocks[1].expanded_channels).at[5:20].set(0.0)}
-
-    y_std, _ = net.apply(params, state, x, train=False, masks=masks)
-    y_fused, _ = net.apply(params, state, x, train=False, masks=masks, fused_eval=True)
-    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_std), rtol=1e-4, atol=1e-5)
-
-
 def test_custom_vjp_gradients_match_reference():
     rng = np.random.RandomState(0)
     c = 8

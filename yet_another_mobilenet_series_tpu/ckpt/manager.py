@@ -24,13 +24,26 @@ from ..models.specs import Network
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True):
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True, barrier_prefix: str | None = None):
+        """barrier_prefix namespaces Orbax's cross-host sync barriers.
+
+        Orbax barrier keys are global per process (e.g.
+        ``_async_write_complete.<step>``): when two managers save the SAME
+        step concurrently — exactly what happens when the periodic manager
+        and the best-checkpoint manager both fire on the final eval — the
+        second multi-host barrier dies with FAILED_PRECONDITION "already
+        ongoing" and takes the whole distributed job down. Single-host runs
+        never hit this (no distributed barrier), so every extra manager
+        MUST pass a distinct prefix (caught by tests/test_multiproc.py)."""
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
                 create=True,
+                multiprocessing_options=ocp.checkpoint_manager.MultiprocessingOptions(
+                    barrier_sync_key_prefix=barrier_prefix
+                ),
             ),
         )
 

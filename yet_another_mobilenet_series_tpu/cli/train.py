@@ -420,7 +420,9 @@ def run(cfg: Config) -> dict:
                         # separate dir so resume always uses the latest while
                         # the best stays evaluable via train.pretrained
                         if best_ckpt is None:
-                            best_ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt_best", max_to_keep=1)
+                            best_ckpt = CheckpointManager(
+                                cfg.train.log_dir + "/ckpt_best", max_to_keep=1, barrier_prefix="best"
+                            )
                         best_ckpt.save(
                             int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)),
                             extra={"epoch": epoch, "best_top1": best_top1},

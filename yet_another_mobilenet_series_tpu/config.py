@@ -89,9 +89,6 @@ class ModelConfig:
     # Overrides the arch's default activation when set (e.g. swish for the
     # AtomNAS "+" variants); None = keep the arch's own default.
     active_fn: str | None = None
-    # Pallas fused depthwise+BN+act kernel on the eval path (opt-in pending
-    # real-hardware profiling; ops/pallas_kernels.py)
-    fused_eval_kernels: bool = False
     # If true, classifier bias is zero-initialized (standard).
     dtype: str = "float32"  # param dtype; compute may be bf16 (train.compute_dtype)
 

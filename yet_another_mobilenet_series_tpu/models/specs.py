@@ -97,7 +97,6 @@ class Network:
         compute_dtype=None,
         masks: Mapping[int, Any] | None = None,
         rng=None,
-        fused_eval: bool = False,
     ):
         import jax.numpy as jnp
 
@@ -122,7 +121,6 @@ class Network:
                 axis_name=axis_name,
                 compute_dtype=compute_dtype,
                 mask=mask,
-                fused_eval=fused_eval,
             )
         new_state["blocks"] = nbs
         if self.head is not None:

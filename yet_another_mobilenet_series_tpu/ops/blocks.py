@@ -194,12 +194,13 @@ class InvertedResidual:
         axis_name: str | None = None,
         compute_dtype=jnp.float32,
         mask: Array | None = None,
-        fused_eval: bool = False,
     ):
         """mask: optional (expanded_channels,) multiplier zeroing dead atoms.
-        fused_eval: use the Pallas fused depthwise+BN+act+mask kernel on the
-        inference path (ops/pallas_kernels.py; opt-in pending hardware
-        profiling)."""
+
+        The depthwise chain is deliberately the plain XLA lowering: a Pallas
+        fused dw+BN+act+mask eval kernel was built and A/B-measured on a real
+        v5e in round 2 and lost 10x end-to-end (ops/pallas_kernels.py keeps
+        the kernel + the numbers; PROFILE.md has the full verdict)."""
         act = get_activation(self.active_fn)
         new_state = {}
         h = x
@@ -211,49 +212,19 @@ class InvertedResidual:
                 params["expand_bn"], state["expand_bn"], h, train=train, axis_name=axis_name
             )
             h = act(h)
-        import os
-
-        on_tpu = jax.default_backend() == "tpu"
-        # Off-TPU the kernel would run in the (very slow) Pallas interpreter,
-        # so production falls back to the XLA path; tests opt into the
-        # interpreter explicitly via YAMT_PALLAS_INTERPRET=1.
-        interpret_for_tests = os.environ.get("YAMT_PALLAS_INTERPRET") == "1"
-        if fused_eval and not train and (on_tpu or interpret_for_tests):
-            # one VMEM pass per branch replaces dw conv + BN + act + mask
-            from . import pallas_kernels as pk
-
-            scale, shift = pk.fold_bn(
-                params["dw_bn"]["gamma"], params["dw_bn"]["beta"],
-                state["dw_bn"]["mean"], state["dw_bn"]["var"], self.bn_eps,
+        branches = []
+        for i, k, g, offset in self._branches():
+            sl = h[..., offset : offset + g]
+            branches.append(
+                Conv2D(g, g, k, self.stride, groups=g).apply(params[f"dw{i}_k{k}"], sl, compute_dtype=compute_dtype)
             )
-            interpret = not on_tpu
-            branches = []
-            for i, k, g, offset in self._branches():
-                sl = h[..., offset : offset + g].astype(compute_dtype)
-                m = jnp.ones((g,), h.dtype) if mask is None else mask[offset : offset + g]
-                branches.append(
-                    pk.fused_depthwise_inference(
-                        sl, params[f"dw{i}_k{k}"]["w"][:, :, 0, :].astype(compute_dtype),
-                        scale[offset : offset + g], shift[offset : offset + g],
-                        m, self.stride, self.active_fn, interpret,
-                    )
-                )
-            h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
-            new_state["dw_bn"] = state["dw_bn"]
-        else:
-            branches = []
-            for i, k, g, offset in self._branches():
-                sl = h[..., offset : offset + g]
-                branches.append(
-                    Conv2D(g, g, k, self.stride, groups=g).apply(params[f"dw{i}_k{k}"], sl, compute_dtype=compute_dtype)
-                )
-            h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
-            h, new_state["dw_bn"] = self._bn(self.expanded_channels).apply(
-                params["dw_bn"], state["dw_bn"], h, train=train, axis_name=axis_name
-            )
-            h = act(h)
-            if mask is not None:
-                h = h * mask.astype(h.dtype)
+        h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
+        h, new_state["dw_bn"] = self._bn(self.expanded_channels).apply(
+            params["dw_bn"], state["dw_bn"], h, train=train, axis_name=axis_name
+        )
+        h = act(h)
+        if mask is not None:
+            h = h * mask.astype(h.dtype)
         if self.se_channels:
             h = SqueezeExcite(self.expanded_channels, self.se_channels, self.se_inner_act, self.se_gate_fn).apply(
                 params["se"], h, compute_dtype=compute_dtype

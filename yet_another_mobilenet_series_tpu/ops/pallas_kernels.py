@@ -15,19 +15,25 @@ train path keeps the XLA lowering, which the compiler already fuses well).
 
 A ``jax.custom_vjp`` wrapper makes the fused forward safe to drop into
 differentiated code: the backward pass recomputes with the reference XLA
-ops (correctness over speed — profiling on real hardware decides whether a
-hand-written backward is worth it; SURVEY.md §2 native table says "Pallas
-kernel only if profiling shows a gap", and the gap could not be measured
-this round — the sandbox TPU died mid-session).
+ops. Everything is validated against the ``ops.layers`` reference in Pallas
+interpret mode (tests/test_pallas.py) and compiles + runs on real TPU
+(scripts/bench_pallas.py).
 
-Everything is validated against the ``ops.layers`` reference in Pallas
-interpret mode (tests/test_pallas.py), so the kernels are exercised on CPU
-and compile-ready for TPU.
-
-Status: OPT-IN — wired into InvertedResidual.apply(fused_eval=True) and
-reachable via cfg.model.fused_eval_kernels on the eval step, default OFF;
-flip the default once real-hardware profiling confirms the win. Off-TPU the
-blocks fall back to the XLA path unless YAMT_PALLAS_INTERPRET=1 (tests).
+Status: NOT WIRED INTO THE MODEL — measured and rejected (VERDICT r1 #4
+resolved "remove"). On a real v5e (round 2, 2026-07-29), after fixing three
+compile-blocking issues the interpreter can't see (scoped-VMEM stack OOM
+from whole-image tap unrolls; >2D gathers from strided slices; a Mosaic
+crash on rank-5 blocked operands), the honest dependency-chained A/B showed
+the fused MBV3-L eval step at 307 ms/step vs 31 ms/step for the plain XLA
+lowering at batch 1024 — the kernel LOSES ~10x end-to-end. Root causes:
+per-(image, channel-block) grid steps do microseconds of VPU work against
+fixed Mosaic dispatch overhead, narrow early blocks (c=16..72) waste up to
+8x of every lane-padded VMEM transfer, and the stride-2 phase split costs an
+extra HBM round trip that XLA's native conv does not pay. SURVEY.md §2's
+rule was "Pallas kernel only if profiling shows a gap" — profiling showed
+the opposite, so the model path keeps the XLA lowering (ops/blocks.py) and
+this module stays as the measured negative result + harness for future
+chips. PROFILE.md records the numbers.
 """
 
 from __future__ import annotations
@@ -41,21 +47,40 @@ from jax.experimental import pallas as pl
 from .activations import get_activation
 
 
-def _dw_kernel(x_ref, w_ref, scale_ref, shift_ref, mask_ref, o_ref, *, k: int, stride: int, act: str, out_h: int, out_w: int):
-    """One image per grid step: x_ref is the pre-padded (H+2p, W+2p, C)
-    input; the k*k taps are static Python loops (fully unrolled VPU
-    multiply-accumulates over strided slices)."""
-    x = x_ref[0]  # (H+2p, W+2p, C): drop the size-1 N-block axis
-    acc = None
-    for i in range(k):
-        for j in range(k):
-            # strided window of the padded input aligned to output (h, w)
-            sl = x[i : i + out_h * stride : stride, j : j + out_w * stride : stride, :]
-            term = sl * w_ref[i, j, :]
-            acc = term if acc is None else acc + term
-    y = acc * scale_ref[...] + shift_ref[...]
-    y = get_activation(act)(y)
-    o_ref[0] = (y * mask_ref[...]).astype(o_ref.dtype)
+def _dw_kernel(*refs, k: int, stride: int, act: str, out_h: int, out_w: int, row_block: int):
+    """One (image, channel-block) per grid step, computed in row slabs.
+
+    Three real-hardware constraints shape this kernel (all invisible to the
+    interpret-mode tests; all hit on a real v5e):
+
+    - Mosaic stack-allocates every live unrolled temporary, and at 112x112
+      spatial with the channel axis lane-padded to 128 a whole-image tap
+      unroll needs ~32 MB of scoped VMEM (>16 MB limit). So accumulation
+      happens per ``row_block`` output rows: slab temporaries are
+      (row_block, out_w, C-block) regardless of image size.
+    - Strided (stride>1) vector slices lower to an unsupported >2D gather.
+      So the caller phase-splits the padded input into stride^2 planes and
+      every tap read here is a *contiguous* slice: output row r needs input
+      row r*s + i, which lives in plane i%s at row r + i//s (and likewise
+      for columns).
+    - A rank-5 blocked operand (phases stacked on one axis) crashes the
+      Mosaic compiler outright, so the phase planes arrive as stride^2
+      separate rank-4 refs instead.
+    """
+    s = stride
+    x_refs, (w_ref, scale_ref, shift_ref, mask_ref, o_ref) = refs[: s * s], refs[s * s :]
+    for r0 in range(0, out_h, row_block):
+        rows = min(row_block, out_h - r0)
+        acc = None
+        for i in range(k):
+            for j in range(k):
+                ph = (i % s) * s + (j % s)
+                sl = x_refs[ph][0, r0 + i // s : r0 + i // s + rows, j // s : j // s + out_w, :]
+                term = sl * w_ref[i, j, :]
+                acc = term if acc is None else acc + term
+        y = acc * scale_ref[0, :] + shift_ref[0, :]
+        y = get_activation(act)(y)
+        o_ref[0, r0 : r0 + rows, :, :] = (y * mask_ref[0, :]).astype(o_ref.dtype)
 
 
 # Channel tile: depthwise is channel-independent, so the channel axis blocks
@@ -71,26 +96,50 @@ def _fused_dw_fwd(x, w, scale, shift, mask, *, stride: int, act: str, interpret:
     n, h, wd, c = x.shape
     k = w.shape[0]
     pad = k // 2
-    out_h = (h - 1) // stride + 1
-    out_w = (wd - 1) // stride + 1
-    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+    s = stride
+    # per-channel operands ride as rank-2 (1, C) f32: rank-1 vectors hit
+    # two separate Mosaic/XLA layout walls on real v5e (bf16 rank-1 blocks
+    # need 256-multiples; f32[240] gets an XLA T(256) layout Mosaic rejects),
+    # while (1, C) blocks tile as (sublane=1, lane=C-block) cleanly
+    scale = scale.astype(jnp.float32).reshape(1, c)
+    shift = shift.astype(jnp.float32).reshape(1, c)
+    mask = mask.astype(jnp.float32).reshape(1, c)
+    out_h = (h - 1) // s + 1
+    out_w = (wd - 1) // s + 1
+    # pad to a multiple of s so the s^2 phase planes all have equal shape
+    # (the extra zero rows/cols are beyond every tap's reach)
+    eh = (-(h + 2 * pad)) % s
+    ew = (-(wd + 2 * pad)) % s
+    xp = jnp.pad(x, ((0, 0), (pad, pad + eh), (pad, pad + ew), (0, 0)))
+    hs = (h + 2 * pad + eh) // s
+    ws = (wd + 2 * pad + ew) // s
+    # XLA-side phase split: strided slicing is free here but lowers to an
+    # unsupported gather inside the kernel (see _dw_kernel docstring); s=1
+    # is the identity (one plane, no data movement beyond the pad)
+    phases = [xp[:, p::s, q::s, :] for p in range(s) for q in range(s)]
 
     cb = min(c, _C_BLOCK)
-    kernel = functools.partial(_dw_kernel, k=k, stride=stride, act=act, out_h=out_h, out_w=out_w)
+    # slab height: keep each unrolled temporary (row_block x out_w x cb,
+    # lanes padded to 128) around ~0.5 MB so ~6 live temps stay well inside
+    # the ~16 MB scoped-VMEM stack budget at every spatial size
+    row_block = min(out_h, max(8, 2048 // max(out_w, 1)))
+    kernel = functools.partial(
+        _dw_kernel, k=k, stride=s, act=act, out_h=out_h, out_w=out_w, row_block=row_block
+    )
     return pl.pallas_call(
         kernel,
         grid=(n, pl.cdiv(c, cb)),
-        in_specs=[
-            pl.BlockSpec((1, h + 2 * pad, wd + 2 * pad, cb), lambda i, j: (i, 0, 0, j)),
+        in_specs=[pl.BlockSpec((1, hs, ws, cb), lambda i, j: (i, 0, 0, j))] * (s * s)
+        + [
             pl.BlockSpec((k, k, cb), lambda i, j: (0, 0, j)),
-            pl.BlockSpec((cb,), lambda i, j: (j,)),
-            pl.BlockSpec((cb,), lambda i, j: (j,)),
-            pl.BlockSpec((cb,), lambda i, j: (j,)),
+            pl.BlockSpec((1, cb), lambda i, j: (0, j)),
+            pl.BlockSpec((1, cb), lambda i, j: (0, j)),
+            pl.BlockSpec((1, cb), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((1, out_h, out_w, cb), lambda i, j: (i, 0, 0, j)),
         out_shape=jax.ShapeDtypeStruct((n, out_h, out_w, c), x.dtype),
         interpret=interpret,
-    )(xp, w, scale, shift, mask)
+    )(*phases, w, scale, shift, mask)
 
 
 def _reference_fwd(x, w, scale, shift, mask, *, stride: int, act: str):

@@ -197,7 +197,6 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
             train=False,
             compute_dtype=compute_dtype,
             masks=imasks,
-            fused_eval=cfg.model.fused_eval_kernels,
         )
         labels = batch["label"]
         # padded examples carry label -1: mask them out of every count
