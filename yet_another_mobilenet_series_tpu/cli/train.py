@@ -345,7 +345,11 @@ def run(cfg: Config) -> dict:
                         jax.profiler.start_trace(cfg.train.log_dir + "/trace")
                         trace_active = True
                     elif trace_active and step_i >= cfg.train.profile_start_step + cfg.train.profile_num_steps:
-                        jax.block_until_ready(metrics["loss"])
+                        # true barrier before closing the trace: through the
+                        # axon tunnel block_until_ready can return at
+                        # dispatch-acknowledge and truncate the trace window
+                        # (PROFILE.md "measurement methodology")
+                        jax.device_get(metrics["loss"])
                         jax.profiler.stop_trace()
                         trace_active = False
                         log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
