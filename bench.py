@@ -20,10 +20,21 @@ exists. The TPU backend here lives behind a fragile single-chip tunnel:
 workers get a generous timeout and are never run concurrently.
 
 vs_baseline: BASELINE.json ships "published": {} (no reference numbers were
-recoverable — see SURVEY.md provenance warning), so the divisor is an explicit
-assumption recorded here: ~1000 images/sec/chip for the reference's apex+DALI
-MobileNet training on its contemporary GPU (V100 class). Replace when a real
-reference measurement exists.
+recoverable — see SURVEY.md provenance warning), so vs_baseline is null until
+a real reference measurement exists; the earlier ~1000 img/s/chip V100-class
+guess was noise in the headline artifact and now lives only in
+"vs_baseline_note".
+
+Liveness probe: the axon tunnel initializes in ~34 s when alive but takes
+~25 min to FAIL when dead (observed both rounds; PROFILE.md). Rounds 1-2 the
+driver's capture timed out (rc=1 / rc=124) while the bench was still inside
+its retry ladder against a dead tunnel. So the supervisor now first runs a
+--probe subprocess (import jax + list devices), hard-killed at PROBE_TIMEOUT_S.
+Dead tunnel -> no TPU attempt at all -> CPU fallback; worst-case total
+wall-clock ~(150 + 600) s, inside any sane driver window. Killing the probe
+is safe where killing a *running job* is not (the round-2 wedge): against a
+dead tunnel there is nothing to wedge, and an alive tunnel finishes init
+well inside the kill window.
 """
 
 from __future__ import annotations
@@ -34,7 +45,11 @@ import subprocess
 import sys
 import time
 
-ASSUMED_BASELINE_IMG_S_PER_CHIP = 1000.0
+VS_BASELINE_NOTE = (
+    "null: BASELINE.json publishes no reference throughput and the reference "
+    "mount is empty; no real divisor exists (an assumed ~1000 img/s/chip "
+    "V100-class figure was dropped as noise)"
+)
 
 # Dense peak bf16 FLOPs/s per chip, by device_kind substring (public specs).
 PEAK_FLOPS_BY_KIND = [
@@ -48,11 +63,17 @@ PEAK_FLOPS_BY_KIND = [
     ("v2", 45e12),
 ]
 
-WORKER_TIMEOUT_S = 1800  # generous: killing a mid-compile TPU job can wedge the tunnel
+# TPU worker stays generous: killing a mid-compile TPU job can wedge the
+# tunnel, and the probe has already established the tunnel is alive.
+WORKER_TIMEOUT_S = int(os.environ.get("BENCH_WORKER_TIMEOUT_S", 1800))
+CPU_WORKER_TIMEOUT_S = int(os.environ.get("BENCH_CPU_WORKER_TIMEOUT_S", 600))
+# Liveness probe: alive tunnel initializes in ~34 s; dead takes ~25 min to
+# fail. 150 s separates the two with ~4x margin on the alive side.
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 150))
 RETRIES = 3
 BACKOFF_S = (5, 20)  # sleeps between the RETRIES attempts (len == RETRIES - 1)
 # stop launching TPU attempts past this point so the CPU fallback always gets
-# to run (observed: a dead tunnel burns ~25 min per failed backend init)
+# to run (only reachable when the probe said alive but workers still fail)
 TPU_DEADLINE_S = 2400
 
 
@@ -74,6 +95,54 @@ def peak_flops_for(device_kind: str) -> float | None:
 
 
 RETRYABLE_MARKERS = ("UNAVAILABLE", "Unable to initialize backend", "DEADLINE_EXCEEDED")
+
+
+def probe():
+    """Liveness probe body (runs as a --probe subprocess): touch the backend
+    and report. Prints one JSON line on success; a dead tunnel simply hangs
+    inside backend init until the supervisor kills us."""
+    t0 = time.perf_counter()
+    import jax
+
+    devs = jax.devices()
+    print(json.dumps({
+        "alive": True,
+        "platform": jax.default_backend(),
+        "n_devices": len(devs),
+        "device_kind": devs[0].device_kind,
+        "init_s": round(time.perf_counter() - t0, 1),
+    }))
+
+
+def run_probe() -> tuple[str, dict | None]:
+    """Returns (status, info): ("alive", probe_json) when the backend came up
+    inside PROBE_TIMEOUT_S; ("timeout", None) when it hung that long — the
+    dead-tunnel signature (~25 min to fail vs ~34 s to init); ("failed",
+    None) when the probe exited quickly without a backend — a FAST init
+    failure, which the round-2 tunnel produced transiently and which the
+    worker retry ladder can recover, so it must NOT be treated as dead."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--probe"]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        log(f"probe: no backend after {PROBE_TIMEOUT_S}s -> tunnel dead")
+        return "timeout", None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            if isinstance(out, dict) and out.get("alive"):
+                log(f"probe: {out.get('platform')} x{out.get('n_devices')} "
+                    f"({out.get('device_kind')}) in {out.get('init_s')}s")
+                return "alive", out
+        except json.JSONDecodeError:
+            continue
+    log(f"probe: rc={proc.returncode} in {time.perf_counter()-t0:.0f}s, "
+        f"no alive JSON; stderr tail: {proc.stderr[-300:]}")
+    return "failed", None
 
 
 def worker(force_cpu: bool):
@@ -100,12 +169,9 @@ def _worker_body(force_cpu: bool):
         # regardless of JAX_PLATFORMS, so override the live config (same
         # trick as tests/conftest.py) before any backend is touched.
         jax.config.update("jax_platforms", "cpu")
-    import numpy as np
-
-    from yet_another_mobilenet_series_tpu.config import ModelConfig, config_from_dict
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
     from yet_another_mobilenet_series_tpu.models import get_model
-    from yet_another_mobilenet_series_tpu.parallel import dp, mesh as mesh_lib
-    from yet_another_mobilenet_series_tpu.train import optim, schedules, steps
+    from yet_another_mobilenet_series_tpu.utils.benchkit import build_train_fixture, sync
     from yet_another_mobilenet_series_tpu.utils.profiling import profile_network
 
     platform = jax.default_backend()
@@ -120,48 +186,14 @@ def _worker_body(force_cpu: bool):
     batch = per_chip_batch * n_chips
     log(f"bench: {platform} ({device_kind}) x{n_chips}, global batch {batch}, image {image_size}")
 
-    mesh = mesh_lib.make_mesh(n_chips)
-    net = get_model(ModelConfig(arch="mobilenet_v3_large", dropout=0.2), image_size)
-    total_macs = profile_network(net, image_size).total_macs
-
-    def build(batch, remat):
-        cfg = config_from_dict({
-            "model": {"arch": "mobilenet_v3_large", "dropout": 0.2},
-            "optim": {"optimizer": "rmsprop", "weight_decay": 1e-5},
-            "schedule": {"schedule": "exp_decay", "base_lr": 0.064, "warmup_epochs": 5.0},
-            "ema": {"enable": True},
-            "train": {"batch_size": batch, "compute_dtype": "bfloat16", "remat": remat},
-        })
-        steps_per_epoch = 1281167 // batch
-        lr_fn = schedules.make_lr_schedule(cfg.schedule, batch, steps_per_epoch, 350)
-        params, _ = net.init(jax.random.PRNGKey(0))
-        optimizer = optim.make_optimizer(cfg.optim, lr_fn, params)
-        ts = steps.init_train_state(net, cfg, optimizer, jax.random.PRNGKey(0))
-        ts = mesh_lib.replicate(ts, mesh)
-        step_fn = dp.make_dp_train_step(net, cfg, optimizer, lr_fn, mesh)
-        rng = np.random.RandomState(0)
-        host_batch = {
-            "image": rng.normal(0, 1, (batch, image_size, image_size, 3)).astype(np.float32),
-            "label": (np.arange(batch) % 1000).astype(np.int32),
-        }
-        b = mesh_lib.shard_batch(host_batch, mesh)
-        return step_fn, ts, b
-
-    def sync(arr):
-        """Hard sync: device_get of a dependent scalar. block_until_ready is
-        NOT a reliable barrier through the axon tunnel — it often returns at
-        dispatch-acknowledge time, which made round-2's first 'measurement'
-        report a physically impossible 3.6x inflated rate (and >100% 'MFU'
-        on eval microbenches). Only an actual device->host transfer of a
-        value that depends on the work is trustworthy here."""
-        return float(np.asarray(jax.device_get(arr)).ravel()[0])
+    total_macs = profile_network(get_model(ModelConfig(arch="mobilenet_v3_large", dropout=0.2), image_size), image_size).total_macs
 
     key = jax.random.PRNGKey(0)
     attempts = [(batch, False), (batch // 2, False), (batch // 2, True), (batch // 4, True)]
     step_fn = ts = b = None
     for try_batch, remat in attempts:
         try:
-            step_fn, ts, b = build(try_batch, remat)
+            step_fn, ts, b, _ = build_train_fixture(try_batch, image_size, remat=remat)
             t0 = time.perf_counter()
             ts, metrics = step_fn(ts, b, key)
             sync(metrics["loss"])
@@ -200,14 +232,12 @@ def _worker_body(force_cpu: bool):
     mfu = round(6 * total_macs * img_s_chip / peak, 4) if peak else None
     mfu_fwd = round(2 * total_macs * img_s_chip / peak, 4) if peak else None
 
-    # vs_baseline compares against the assumed 224px reference rate; a CPU
-    # fallback measurement at 64px is not comparable — null it there.
-    headline_config = platform == "tpu" and image_size == 224
     print(json.dumps({
         "metric": "mobilenet_v3_large_train_images_per_sec_per_chip",
         "value": round(img_s_chip, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_s_chip / ASSUMED_BASELINE_IMG_S_PER_CHIP, 3) if headline_config else None,
+        "vs_baseline": None,
+        "vs_baseline_note": VS_BASELINE_NOTE,
         "platform": platform,
         "device_kind": device_kind,
         "n_chips": n_chips,
@@ -236,13 +266,14 @@ def run_worker(force_cpu: bool) -> dict | None:
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     if force_cpu:
         cmd.append("--cpu")
+    timeout_s = CPU_WORKER_TIMEOUT_S if force_cpu else WORKER_TIMEOUT_S
     try:
         proc = subprocess.run(
-            cmd, capture_output=True, text=True, timeout=WORKER_TIMEOUT_S,
+            cmd, capture_output=True, text=True, timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
     except subprocess.TimeoutExpired as e:
-        log(f"worker timed out after {WORKER_TIMEOUT_S}s")
+        log(f"worker timed out after {timeout_s}s")
         for stream in (e.stderr, e.stdout):
             if stream:
                 text = stream.decode() if isinstance(stream, bytes) else stream
@@ -264,12 +295,31 @@ def main():
     if "--worker" in sys.argv:
         worker(force_cpu="--cpu" in sys.argv)
         return
+    if "--probe" in sys.argv:
+        probe()
+        return
     if "--cpu" in sys.argv:  # direct CPU smoke mode, no supervisor
         worker(force_cpu=True)
         return
 
     last_err = "unknown"
     t_start = time.monotonic()
+    probe_status, probe_result = run_probe()
+    if probe_status == "timeout":
+        # the dead-tunnel hang: skip every TPU attempt (each would burn
+        # ~25 min) and record the binding metric via the CPU fallback
+        emit_cpu_fallback(f"liveness probe found no TPU inside {PROBE_TIMEOUT_S}s")
+        return
+    if probe_status == "alive" and probe_result.get("platform") != "tpu":
+        emit_cpu_fallback(
+            f"liveness probe found platform={probe_result.get('platform')!r}, not tpu"
+        )
+        return
+    # "alive" on TPU, or a FAST probe failure (transient init error): the
+    # worker retry ladder below handles both — fast failures were retryable
+    # in round 2 and WORKER_TIMEOUT_S still bounds a mid-ladder hang.
+    if probe_status == "failed":
+        log("probe failed fast (not the dead-tunnel hang); trying the worker ladder")
     for attempt in range(RETRIES):
         if attempt > 0 and time.monotonic() - t_start > TPU_DEADLINE_S:
             last_err += f"; TPU deadline {TPU_DEADLINE_S}s exceeded, skipping remaining retries"
@@ -297,14 +347,18 @@ def main():
             log(f"{last_err}; retrying in {delay}s")
             time.sleep(delay)
 
-    log(f"TPU measurement failed ({last_err}); falling back to CPU smoke measurement")
+    emit_cpu_fallback(last_err)
+
+
+def emit_cpu_fallback(tpu_err: str):
+    log(f"TPU measurement unavailable ({tpu_err}); falling back to CPU smoke measurement")
     try:
         result = run_worker(force_cpu=True)
     except WorkerTimeout:
         result = None
     if result is not None and result.get("value") is not None:
         result["fallback_from"] = "tpu"
-        result["tpu_error"] = last_err[:500]
+        result["tpu_error"] = tpu_err[:500]
         print(json.dumps(result))
         return
 
@@ -313,8 +367,9 @@ def main():
         "value": None,
         "unit": "images/sec/chip",
         "vs_baseline": None,
+        "vs_baseline_note": VS_BASELINE_NOTE,
         "platform": None,
-        "error": f"{last_err}; cpu fallback also failed",
+        "error": f"{tpu_err}; cpu fallback also failed",
     }))
 
 

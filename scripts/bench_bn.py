@@ -1,0 +1,114 @@
+"""A/B the BatchNorm normalize variants (train.bn_mode) on the full
+MobileNetV3-L train step — the round-3 attack on the 52% BN-stat-reduction
+share of the round-2 TPU trace (PROFILE.md "Where the time goes").
+
+Variants (ops/layers.py BatchNorm.apply):
+  exact   — f32 (x - mean)*scale + beta, the reference-parity baseline
+  folded  — precomputed f32 per-channel scale/bias, single FMA
+  compute — scale/bias cast to the compute dtype, FMA fully in bf16
+each optionally under train.remat (activation rematerialization), which
+changes what XLA materializes between the forward stat-reduces and the
+backward companions.
+
+Measurement methodology (mandatory on the axon tunnel; PROFILE.md):
+iterations are naturally chained (TrainState threads through), and every
+timed region ends with a device_get of a scalar that depends on the work.
+block_until_ready is NOT a barrier here.
+
+Usage: python scripts/bench_bn.py [--batch 256] [--iters 20] [--out FILE]
+Prints one JSON line to stdout; table to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the sandbox's sitecustomize "
+                         "force-selects the axon TPU platform otherwise, and a "
+                         "dead tunnel burns ~25 min in backend init)")
+    ap.add_argument("--variants", default="exact:0,folded:0,compute:0,exact:1,compute:1",
+                    help="comma list of bn_mode:remat")
+    args = ap.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from yet_another_mobilenet_series_tpu.utils.benchkit import build_train_fixture, sync
+
+    platform = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    if platform == "cpu":
+        # smoke scale so the script is testable without the tunnel
+        args.batch = min(args.batch, 8)
+        args.image_size = min(args.image_size, 64)
+        args.iters = min(args.iters, 3)
+    log(f"bench_bn: {platform} ({kind}), batch {args.batch}, image {args.image_size}, {args.iters} iters")
+
+    key = jax.random.PRNGKey(0)
+    rows = []
+    for spec_str in args.variants.split(","):
+        mode, remat_s = spec_str.strip().split(":")
+        remat = bool(int(remat_s))
+        step_fn, ts, b, _ = build_train_fixture(args.batch, args.image_size, remat=remat, bn_mode=mode)
+        t0 = time.perf_counter()
+        ts, metrics = step_fn(ts, b, key)
+        sync(metrics["loss"])
+        compile_s = time.perf_counter() - t0
+        for _ in range(3):
+            ts, metrics = step_fn(ts, b, key)
+        sync(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            ts, metrics = step_fn(ts, b, key)
+        loss = sync(metrics["loss"])
+        dt = (time.perf_counter() - t0) / args.iters
+        img_s = args.batch / dt
+        rows.append({
+            "bn_mode": mode, "remat": remat, "ms_per_step": round(dt * 1e3, 2),
+            "img_s_per_chip": round(img_s / len(jax.devices()), 1),
+            "compile_s": round(compile_s, 1), "loss": round(loss, 4),
+        })
+        log(f"  bn_mode={mode:<8} remat={int(remat)}: {dt*1e3:8.2f} ms/step, "
+            f"{img_s:8.0f} img/s, loss {loss:.4f} (compile {compile_s:.0f}s)")
+        # free the variant's buffers before building the next one
+        step_fn = ts = b = None
+
+    base = next((r for r in rows if r["bn_mode"] == "exact" and not r["remat"]), None)
+    for r in rows:
+        if base:
+            r["vs_exact"] = round(base["ms_per_step"] / r["ms_per_step"], 3)
+    out = {
+        "bench": "bn_mode_train_step_ab", "platform": platform, "device_kind": kind,
+        "batch": args.batch, "image_size": args.image_size, "iters": args.iters,
+        "dtype": "bfloat16",
+        "method": "chained train steps, device_get(loss) barrier (PROFILE.md methodology)",
+        "rows": rows,
+    }
+    print(json.dumps(out), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

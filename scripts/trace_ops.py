@@ -57,7 +57,7 @@ def main():
                 meta = events_meta.get(ev.metadata_id)
                 name = meta.name if meta else "?"
                 # collapse fusion numbering: fusion.123 -> leading op kind
-                kind = re.split(r"[.\d]", name, 1)[0].lstrip("%")
+                kind = re.split(r"[.\d]", name, maxsplit=1)[0].lstrip("%")
                 dur = ev.duration_ps
                 n_events += 1
                 if kind.endswith("-start"):
@@ -71,6 +71,8 @@ def main():
                 per_cat[kind] += dur
         if not per_op:
             continue
+        # all-zero-duration sync events would divide by zero below
+        total_ps = max(total_ps, 1)
         print(f"\n== {plane.name}: {n_events} op events, {total_ps/1e12*1000:.2f} ms synchronous device op time")
         print("\n-- by op kind (sync only) --")
         for k, v in per_cat.most_common(20):

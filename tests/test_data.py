@@ -49,6 +49,7 @@ def _cfg(tfrecord_dir, **over):
     return DataConfig(**kw)
 
 
+@pytest.mark.slow
 def test_eval_tfrecords_every_example_once(tfrecord_dir):
     cfg = _cfg(tfrecord_dir)
     ds = data_lib.make_eval_dataset(cfg, local_batch=10)

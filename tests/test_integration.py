@@ -65,6 +65,7 @@ def test_train_run_learns_and_checkpoints(tmp_path):
     assert glob.glob(str(tmp_path) + "/ckpt/*/meta*")
 
 
+@pytest.mark.slow
 def test_resume_continues_from_checkpoint(tmp_path, capsys):
     cfg = _base_cfg(tmp_path, **{"train.epochs": 1})
     cli_train.run(cfg)
@@ -74,6 +75,7 @@ def test_resume_continues_from_checkpoint(tmp_path, capsys):
     assert "resumed at step 20" in out  # 1280/64 = 20 steps/epoch
 
 
+@pytest.mark.slow
 def test_eval_only_with_pretrained(tmp_path):
     cfg = _base_cfg(tmp_path)
     trained = cli_train.run(cfg)
@@ -83,6 +85,7 @@ def test_eval_only_with_pretrained(tmp_path):
 
 
 @pytest.mark.parametrize("zero", [False, True], ids=["replicated", "zero"])
+@pytest.mark.slow
 def test_atomnas_search_shrinks_and_resumes(tmp_path, capsys, zero):
     over = {
         # zero=True exercises the shipped atomnas_c_se combination: remat must
@@ -110,6 +113,7 @@ def test_atomnas_search_shrinks_and_resumes(tmp_path, capsys, zero):
     _check_resume(tmp_path, over, capsys)
 
 
+@pytest.mark.slow
 def test_adaptive_rho_reaches_target_where_constant_does_not(tmp_path):
     """SURVEY.md §2 #11 rho schedule: with a deliberately too-small base rho
     the constant schedule never pushes any gamma below threshold, while the
@@ -166,6 +170,7 @@ def _check_resume(tmp_path, over, capsys):
     assert result2["epoch"] >= 2.0
 
 
+@pytest.mark.slow
 def test_warm_start_finetune_from_checkpoint(tmp_path, capsys):
     """train.pretrained on a fresh (non-resumed) training run warm-starts the
     weights with a fresh optimizer/step — after a few finetune steps accuracy
@@ -184,6 +189,7 @@ def test_warm_start_finetune_from_checkpoint(tmp_path, capsys):
     assert result["eval_top1"] > 0.5, result  # fresh init gets ~0.125 in 5 steps
 
 
+@pytest.mark.slow
 def test_warm_start_finetune_from_torch_checkpoint(tmp_path, capsys):
     import torch
 
@@ -206,6 +212,7 @@ def test_warm_start_finetune_from_torch_checkpoint(tmp_path, capsys):
     assert result["epoch"] == pytest.approx(0.25)
 
 
+@pytest.mark.slow
 def test_best_checkpoint_kept_and_evaluable(tmp_path):
     """train.keep_best maintains a single-slot best-top1 checkpoint (the
     reference's best.pth); evaluating it reproduces the recorded best."""
@@ -219,6 +226,7 @@ def test_best_checkpoint_kept_and_evaluable(tmp_path):
     np.testing.assert_allclose(best_eval["top1"], result["eval_best_top1"], atol=1e-6)
 
 
+@pytest.mark.slow
 def test_resume_from_legacy_checkpoint_without_rho_mult(tmp_path, monkeypatch, capsys):
     """Checkpoints written before TrainState grew rho_mult must still resume
     (restore retries without the field and injects the neutral multiplier)."""

@@ -20,6 +20,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_training_run(tmp_path):
     port = _free_port()
     nproc = 2

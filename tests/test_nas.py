@@ -155,6 +155,7 @@ def test_remat_exact_equivalence_with_branch_and_block_drop():
     )
 
 
+@pytest.mark.slow
 def test_remat_slices_optimizer_and_ema_state():
     from yet_another_mobilenet_series_tpu.config import config_from_dict
     from yet_another_mobilenet_series_tpu.train import optim, schedules, steps

@@ -38,6 +38,7 @@ def block_and_mask(draw):
 
 @settings(max_examples=25, deadline=None)
 @given(data=block_and_mask(), seed=st.integers(0, 2**20))
+@pytest.mark.slow
 def test_masked_equals_rematerialized_fuzz(data, seed):
     block, mask = data
     params, state = block.init(jax.random.PRNGKey(seed))

@@ -92,9 +92,60 @@ def test_batchnorm_matches_torch_train_and_eval():
     assert same_state is new_state
 
 
-def test_syncbn_equals_full_batch_bn():
+def test_batchnorm_modes_equivalent():
+    """The bn_mode perf variants (ops/layers.py; the round-2 trace's 52%
+    BN-reduction attack) must be semantics-preserving: statistics bit-exact
+    in every mode; "folded" normalize within f32 re-association rounding of
+    "exact"; "compute" within bf16 tolerance on bf16 inputs."""
+    c = 12
+    spec = ops.BatchNorm(c)
+    params, state = spec.init()
+    rs = np.random.RandomState(0)
+    params["gamma"] = jnp.asarray(rs.uniform(0.5, 1.5, c).astype(np.float32))
+    params["beta"] = jnp.asarray(rs.uniform(-0.5, 0.5, c).astype(np.float32))
+    x = jnp.asarray(rs.normal(2.0, 3.0, (8, 7, 7, c)).astype(np.float32))
+
+    for train in (True, False):
+        y_exact, st_exact = spec.apply(params, state, x, train=train, mode="exact")
+        y_folded, st_folded = spec.apply(params, state, x, train=train, mode="folded")
+        y_compute, st_compute = spec.apply(params, state, x, train=train, mode="compute")
+        for st in (st_folded, st_compute):
+            for k in ("mean", "var"):
+                np.testing.assert_array_equal(np.asarray(st[k]), np.asarray(st_exact[k]))
+        np.testing.assert_allclose(np.asarray(y_folded), np.asarray(y_exact), rtol=2e-6, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(y_compute), np.asarray(y_exact), rtol=2e-2, atol=2e-2)
+
+    # bf16 activations (the real training dtype): folded stays within one
+    # bf16 ulp of exact after the output cast; gradients agree too.
+    xb = x.astype(jnp.bfloat16)
+    yb_exact, _ = spec.apply(params, state, xb, train=True, mode="exact")
+    yb_folded, _ = spec.apply(params, state, xb, train=True, mode="folded")
+    yb_compute, _ = spec.apply(params, state, xb, train=True, mode="compute")
+    np.testing.assert_allclose(
+        np.asarray(yb_folded, np.float32), np.asarray(yb_exact, np.float32), rtol=1e-2, atol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(yb_compute, np.float32), np.asarray(yb_exact, np.float32), rtol=4e-2, atol=4e-2
+    )
+
+    def loss(p, mode):
+        y, _ = spec.apply(p, state, x, train=True, mode=mode)
+        return jnp.sum(jnp.square(y) * jnp.cos(jnp.arange(y.size).reshape(y.shape)))
+
+    g_exact = jax.grad(loss)(params, "exact")
+    g_folded = jax.grad(loss)(params, "folded")
+    for k in ("gamma", "beta"):
+        np.testing.assert_allclose(np.asarray(g_folded[k]), np.asarray(g_exact[k]), rtol=1e-4, atol=1e-4)
+
+    with pytest.raises(ValueError):
+        spec.apply(params, state, x, train=True, mode="nope")
+
+
+@pytest.mark.parametrize("mode", ["exact", "folded", "compute"])
+def test_syncbn_equals_full_batch_bn(mode):
     """psum-of-moments SyncBN over 8 shards == BN over the unsharded batch
-    (SURVEY.md §4.2). This is the apex-SyncBatchNorm parity contract."""
+    (SURVEY.md §4.2) — the apex-SyncBatchNorm parity contract, in every
+    bn_mode normalize variant."""
     from jax.sharding import Mesh, PartitionSpec as P
     from jax import shard_map
 
@@ -103,12 +154,12 @@ def test_syncbn_equals_full_batch_bn():
     params, state = spec.init()
     x = jax.random.normal(jax.random.PRNGKey(0), (16, 3, 3, c))
 
-    y_ref, st_ref = spec.apply(params, state, x, train=True)
+    y_ref, st_ref = spec.apply(params, state, x, train=True, mode=mode)
 
     mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
 
     def shard_fn(p, s, xx):
-        return spec.apply(p, s, xx, train=True, axis_name="data")
+        return spec.apply(p, s, xx, train=True, axis_name="data", mode=mode)
 
     y, st = jax.jit(
         shard_map(

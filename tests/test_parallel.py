@@ -113,6 +113,7 @@ def test_dp_eval_counts_match_single(setup):
         np.testing.assert_allclose(float(ms[k]), float(md[k]), rtol=1e-5, err_msg=k)
 
 
+@pytest.mark.slow
 def test_sync_bn_off_gives_per_replica_stats(setup):
     """dist.sync_bn=false must actually disable the BN psum: running stats
     then differ from the full-batch (SyncBN) result while grads stay

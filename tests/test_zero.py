@@ -54,6 +54,7 @@ def _zero_state(net, cfg, opt, mesh):
     return ts.replace(opt_state=zero.init_opt_state(opt, ts.params, mesh))
 
 
+@pytest.mark.slow
 def test_zero_step_matches_replicated_update(setup):
     net, lr_fn, opt, mesh, batch = setup
     b = mesh_lib.shard_batch(batch, mesh)
@@ -85,6 +86,7 @@ def test_zero_opt_state_is_sharded(setup):
     assert leaves[0].addressable_shards[0].data.shape == (per_dev,)
 
 
+@pytest.mark.slow
 def test_zero_multi_step_stays_in_sync_and_finite(setup):
     net, lr_fn, opt, mesh, batch = setup
     cfg = _cfg(True)
@@ -99,6 +101,7 @@ def test_zero_multi_step_stays_in_sync_and_finite(setup):
     assert int(ts.step) == 4
 
 
+@pytest.mark.slow
 def test_zero_gather_scatter_roundtrip_and_portability(setup):
     """gather -> scatter is lossless, and the gathered (checkpoint) form can
     be scattered onto a DIFFERENT chip count (8-chip save -> 4-chip resume)."""
@@ -139,6 +142,7 @@ def test_zero_gather_scatter_roundtrip_and_portability(setup):
     assert float(met4["finite"]) == 1.0
 
 
+@pytest.mark.slow
 def test_zero_grad_clip_matches_replicated(setup):
     """Grad clipping under the sharded update: the psum-aware clip stage
     (optim.clip_by_global_norm(psum_axis=...)) must reproduce the replicated

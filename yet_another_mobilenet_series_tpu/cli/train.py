@@ -227,7 +227,7 @@ def _init_or_warm_start(cfg: Config, net: Network, mesh, log: Logger, rng):
     if cfg.train.pretrained:
         import jax.numpy as jnp
 
-        mgr = CheckpointManager(cfg.train.pretrained)
+        mgr = CheckpointManager(cfg.train.pretrained, barrier_prefix="warmstart")
         src = _restore(mgr, cfg, mesh, log)
         mgr.close()
         if src is None:
@@ -265,7 +265,10 @@ def run(cfg: Config) -> dict:
     arch_name = cfg.model.network_spec or f"{cfg.model.arch} x{cfg.model.width_mult}"
     log.log(f"model {arch_name}: {prof.total_params/1e6:.2f}M params, {prof.total_macs/1e6:.1f}M MACs")
 
-    ckpt = CheckpointManager(cfg.train.log_dir + "/ckpt", max_to_keep=cfg.train.max_checkpoints)
+    ckpt = CheckpointManager(
+        cfg.train.log_dir + "/ckpt", max_to_keep=cfg.train.max_checkpoints,
+        barrier_prefix="periodic",
+    )
 
     # ---- eval-only path (acceptance config #1) ----
     if cfg.train.test_only:
@@ -276,8 +279,10 @@ def run(cfg: Config) -> dict:
             trainer, ts = _init_or_warm_start(cfg, net, mesh, log, jax.random.PRNGKey(cfg.train.seed))
         else:
             src = cfg.train.pretrained or cfg.train.log_dir + "/ckpt"
-            mgr = CheckpointManager(src) if cfg.train.pretrained else ckpt
+            mgr = CheckpointManager(src, barrier_prefix="restore") if cfg.train.pretrained else ckpt
             restored = _restore(mgr, cfg, mesh, log)
+            if mgr is not ckpt:
+                mgr.close()
             if restored is None:
                 log.log("no checkpoint found; evaluating fresh init (smoke mode)")
                 trainer = Trainer(cfg, net, mesh, log)

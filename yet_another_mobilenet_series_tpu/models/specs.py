@@ -97,6 +97,7 @@ class Network:
         compute_dtype=None,
         masks: Mapping[int, Any] | None = None,
         rng=None,
+        bn_mode: str = "exact",
     ):
         import jax.numpy as jnp
 
@@ -108,7 +109,8 @@ class Network:
         new_state: dict = {}
         h = x
         h, new_state["stem"] = self.stem.apply(
-            params["stem"], state["stem"], h, train=train, axis_name=axis_name, compute_dtype=compute_dtype
+            params["stem"], state["stem"], h, train=train, axis_name=axis_name, compute_dtype=compute_dtype,
+            bn_mode=bn_mode,
         )
         nbs: dict = {}
         for i, blk in enumerate(self.blocks):
@@ -121,11 +123,13 @@ class Network:
                 axis_name=axis_name,
                 compute_dtype=compute_dtype,
                 mask=mask,
+                bn_mode=bn_mode,
             )
         new_state["blocks"] = nbs
         if self.head is not None:
             h, new_state["head"] = self.head.apply(
-                params["head"], state["head"], h, train=train, axis_name=axis_name, compute_dtype=compute_dtype
+                params["head"], state["head"], h, train=train, axis_name=axis_name, compute_dtype=compute_dtype,
+                bn_mode=bn_mode,
             )
         h = global_avg_pool(h)  # (N, C)
         if self.feature is not None:

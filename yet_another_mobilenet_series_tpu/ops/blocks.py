@@ -59,9 +59,9 @@ class ConvBNAct:
         params["bn"] = bn_p
         return params, {"bn": bn_s}
 
-    def apply(self, params, state, x, *, train, axis_name=None, compute_dtype=jnp.float32):
+    def apply(self, params, state, x, *, train, axis_name=None, compute_dtype=jnp.float32, bn_mode="exact"):
         y = self.conv.apply(params["conv"], x, compute_dtype=compute_dtype)
-        y, bn_s = self.bn.apply(params["bn"], state["bn"], y, train=train, axis_name=axis_name)
+        y, bn_s = self.bn.apply(params["bn"], state["bn"], y, train=train, axis_name=axis_name, mode=bn_mode)
         y = get_activation(self.active_fn)(y)
         return y, {"bn": bn_s}
 
@@ -194,6 +194,7 @@ class InvertedResidual:
         axis_name: str | None = None,
         compute_dtype=jnp.float32,
         mask: Array | None = None,
+        bn_mode: str = "exact",
     ):
         """mask: optional (expanded_channels,) multiplier zeroing dead atoms.
 
@@ -209,7 +210,7 @@ class InvertedResidual:
                 params["expand"], h, compute_dtype=compute_dtype
             )
             h, new_state["expand_bn"] = self._bn(self.expanded_channels).apply(
-                params["expand_bn"], state["expand_bn"], h, train=train, axis_name=axis_name
+                params["expand_bn"], state["expand_bn"], h, train=train, axis_name=axis_name, mode=bn_mode
             )
             h = act(h)
         branches = []
@@ -220,7 +221,7 @@ class InvertedResidual:
             )
         h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
         h, new_state["dw_bn"] = self._bn(self.expanded_channels).apply(
-            params["dw_bn"], state["dw_bn"], h, train=train, axis_name=axis_name
+            params["dw_bn"], state["dw_bn"], h, train=train, axis_name=axis_name, mode=bn_mode
         )
         h = act(h)
         if mask is not None:
@@ -231,7 +232,7 @@ class InvertedResidual:
             )
         h = Conv2D(self.expanded_channels, self.out_channels, 1).apply(params["project"], h, compute_dtype=compute_dtype)
         h, new_state["project_bn"] = self._bn(self.out_channels).apply(
-            params["project_bn"], state["project_bn"], h, train=train, axis_name=axis_name
+            params["project_bn"], state["project_bn"], h, train=train, axis_name=axis_name, mode=bn_mode
         )
         h = get_activation(self.project_act)(h)
         if self.has_residual:

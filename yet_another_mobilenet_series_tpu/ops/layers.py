@@ -144,14 +144,38 @@ class BatchNorm:
         *,
         train: bool,
         axis_name: str | None = None,
+        mode: str = "exact",
     ) -> tuple[Array, dict]:
+        """mode selects the NORMALIZE expression only — batch statistics are
+        bit-identical f32 accumulations in every mode (reducing the input
+        dtype with an f32 accumulator equals casting first, element-for-
+        element, and never materializes an f32 copy of the activation):
+
+        - "exact"  — (f32(x) - mean) * (gamma*rsqrt(var+eps)) + beta. The
+          round-2 TPU trace shows this step's 51.8% convert_reduce_fusion
+          cost concentrated around BN (PROFILE.md "Where the time goes");
+          the f32-upcast expression shared between the stat-reduce and the
+          normalize is the suspected extra-HBM-traffic source.
+        - "folded" — per-channel scale = gamma*rsqrt(var+eps) and
+          bias = beta - mean*scale are precomputed (f32, C-sized, cheap);
+          the tensor-wide work is a single FMA x*scale+bias with the f32
+          convert inline in its own fusion. Differs from "exact" only by
+          f32 rounding of the re-association (~1e-7 relative) — invisible
+          under a bf16 output cast.
+        - "compute" — like "folded" but scale/bias are cast to x.dtype and
+          the FMA runs entirely in the compute dtype (bf16): halves the
+          elementwise VPU width and drops both converts. Costs ~2-3 ulps of
+          bf16 precision on y; opt-in for perf A/B.
+        """
         out_dtype = x.dtype
-        xf = x.astype(jnp.float32)
         if train:
             # Per-device sums; psum across replicas makes them global (SyncBN).
-            n_local = xf.shape[0] * xf.shape[1] * xf.shape[2]
-            s1 = jnp.sum(xf, axis=(0, 1, 2))
-            s2 = jnp.sum(jnp.square(xf), axis=(0, 1, 2))
+            n_local = x.shape[0] * x.shape[1] * x.shape[2]
+            # f32 accumulators; the square must also be f32 (a bf16 square
+            # would round every element before accumulation — not equivalent
+            # to the reference's f32 moments). The convert fuses inline.
+            s1 = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
+            s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
             n = jnp.asarray(n_local, jnp.float32)
             if axis_name is not None:
                 s1 = lax.psum(s1, axis_name)
@@ -168,8 +192,17 @@ class BatchNorm:
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = lax.rsqrt(var + self.eps) * params["gamma"]
-        y = (xf - mean) * inv + params["beta"]
+        scale = lax.rsqrt(var + self.eps) * params["gamma"]
+        if mode == "exact":
+            y = (x.astype(jnp.float32) - mean) * scale + params["beta"]
+        elif mode == "folded":
+            bias = params["beta"] - mean * scale
+            y = x.astype(jnp.float32) * scale + bias
+        elif mode == "compute":
+            bias = params["beta"] - mean * scale
+            y = x * scale.astype(out_dtype) + bias.astype(out_dtype)
+        else:
+            raise ValueError(f"unknown bn mode {mode!r}")
         return y.astype(out_dtype), new_state
 
 

@@ -114,6 +114,7 @@ def make_train_step(
             compute_dtype=compute_dtype,
             masks=imasks,
             rng=rng,
+            bn_mode=cfg.train.bn_mode,
         )
 
     if cfg.train.remat:
@@ -197,6 +198,7 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
             train=False,
             compute_dtype=compute_dtype,
             masks=imasks,
+            bn_mode=cfg.train.bn_mode,
         )
         labels = batch["label"]
         # padded examples carry label -1: mask them out of every count
