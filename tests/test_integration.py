@@ -160,6 +160,61 @@ def test_adaptive_rho_reaches_target_where_constant_does_not(tmp_path):
     assert macs_adapt < 0.5 * macs_const, (macs_adapt, macs_const)
 
 
+@pytest.mark.slow
+def test_search_emit_retrain_seam(tmp_path):
+    """The acceptance #4 -> #5 handoff (VERDICT r2 next-round #5): an AtomNAS
+    search run emits searched_arch.json; the emitted spec then trains and
+    evals STANDALONE through model.network_spec (the retrain_searched.yml
+    path) with pruning off, and its MACs equal the emitted spec's."""
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.utils.profiling import profile_network
+
+    search_over = {
+        "model.arch": "atomnas_supernet",
+        "model.block_specs": [
+            {"t": 6, "c": 16, "n": 2, "s": 2, "k": [3, 5, 7]},
+            {"t": 6, "c": 24, "n": 1, "s": 2, "k": [3, 5, 7], "se": 0.25},
+        ],
+        "prune.enable": True,
+        # the adaptive-controller recipe the rho-schedule test proves shrinks
+        # hard (constant rho at this base never prunes); remat at epoch
+        # boundaries so the EMITTED spec is physically pruned
+        "prune.rho": 3e-7,
+        "prune.normalize_cost": False,
+        "prune.rho_schedule": "adaptive",
+        "prune.rho_adapt_rate": 0.35,
+        "prune.rho_adapt_max": 1000.0,
+        "prune.target_flops": 1.0,
+        "prune.gamma_threshold": 0.6,
+        "prune.mask_interval": 2,
+        "prune.remat_epochs": 1.0,
+        "prune.stop_epoch_frac": 1.0,
+        "train.epochs": 2,
+        "schedule.base_lr": 0.12,
+    }
+    cli_train.run(_base_cfg(tmp_path / "search", **search_over))
+    spec_path = str(tmp_path / "search" / "searched_arch.json")
+    with open(spec_path) as f:
+        emitted = json.load(f)
+    # the search must actually have pruned below the full supernet
+    full = profile_network(
+        get_model(_base_cfg(tmp_path / "search", **search_over).model, 32), 32
+    ).total_macs
+    assert emitted["macs"] < full, (emitted["macs"], full)
+
+    # standalone retrain from the emitted spec (pruning off, fresh log dir)
+    retrain_cfg = _base_cfg(
+        tmp_path / "retrain",
+        **{"model.network_spec": spec_path, "train.epochs": 3},
+    )
+    rebuilt = get_model(retrain_cfg.model, 32)
+    assert profile_network(rebuilt, 32).total_macs == emitted["macs"]
+    result = cli_train.run(retrain_cfg)
+    assert result["epoch"] == pytest.approx(3.0)
+    # learnable synthetic task, 8 classes: clearly above chance (0.125)
+    assert result["eval_top1"] > 0.3, result
+
+
 def _check_resume(tmp_path, over, capsys):
     # the saved spec sidecar must encode the (possibly pruned) live network
     metas = sorted(glob.glob(str(tmp_path) + "/ckpt/*/meta/*"))

