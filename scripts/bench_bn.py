@@ -47,8 +47,13 @@ def main():
                     help="force the CPU backend (the sandbox's sitecustomize "
                          "force-selects the axon TPU platform otherwise, and a "
                          "dead tunnel burns ~25 min in backend init)")
-    ap.add_argument("--variants", default="exact:0,folded:0,compute:0,exact:1,compute:1",
-                    help="comma list of bn_mode:remat")
+    ap.add_argument(
+        "--variants",
+        default="exact:0,folded:0,compute:0,exact:full,exact:save_conv,compute:save_conv",
+        help="comma list of bn_mode:remat where remat is 0 (off), "
+             "1/full (jax.checkpoint), or save_conv (keep MXU outputs, "
+             "recompute BN/act chains)",
+    )
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -68,8 +73,13 @@ def main():
     rows = []
     for spec_str in args.variants.split(","):
         mode, remat_s = spec_str.strip().split(":")
-        remat = bool(int(remat_s))
-        step_fn, ts, b, _ = build_train_fixture(args.batch, args.image_size, remat=remat, bn_mode=mode)
+        if remat_s not in ("0", "1", "full", "save_conv"):
+            raise SystemExit(f"unknown remat token {remat_s!r} in --variants (use 0, 1, full, or save_conv)")
+        remat = remat_s != "0"
+        policy = remat_s if remat_s == "save_conv" else "full"
+        step_fn, ts, b, _ = build_train_fixture(
+            args.batch, args.image_size, remat=remat, remat_policy=policy, bn_mode=mode
+        )
         t0 = time.perf_counter()
         ts, metrics = step_fn(ts, b, key)
         sync(metrics["loss"])
@@ -83,17 +93,18 @@ def main():
         loss = sync(metrics["loss"])
         dt = (time.perf_counter() - t0) / args.iters
         img_s = args.batch / dt
+        remat_label = "off" if not remat else policy
         rows.append({
-            "bn_mode": mode, "remat": remat, "ms_per_step": round(dt * 1e3, 2),
+            "bn_mode": mode, "remat": remat_label, "ms_per_step": round(dt * 1e3, 2),
             "img_s_per_chip": round(img_s / len(jax.devices()), 1),
             "compile_s": round(compile_s, 1), "loss": round(loss, 4),
         })
-        log(f"  bn_mode={mode:<8} remat={int(remat)}: {dt*1e3:8.2f} ms/step, "
+        log(f"  bn_mode={mode:<8} remat={remat_label:<9}: {dt*1e3:8.2f} ms/step, "
             f"{img_s:8.0f} img/s, loss {loss:.4f} (compile {compile_s:.0f}s)")
         # free the variant's buffers before building the next one
         step_fn = ts = b = None
 
-    base = next((r for r in rows if r["bn_mode"] == "exact" and not r["remat"]), None)
+    base = next((r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off"), None)
     for r in rows:
         if base:
             r["vs_exact"] = round(base["ms_per_step"] / r["ms_per_step"], 3)

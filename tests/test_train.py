@@ -231,6 +231,47 @@ def _tiny_cfg(**over):
     return config_from_dict(d)
 
 
+@pytest.mark.parametrize("policy", ["full", "save_conv"])
+def test_remat_step_equals_plain_step(policy):
+    """train.remat (both policies) must be a pure memory/recompute trade:
+    the updated params after one step are BIT-IDENTICAL to the non-remat
+    step's on CPU f32 (jax.checkpoint changes scheduling, not math).
+    save_conv keeps the MXU outputs and recomputes the BN/act chains (the
+    round-3 attack on the BN activation round-trips, ops/layers.py conv_out
+    landmark)."""
+    batch = {
+        "image": jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3)),
+        "label": jnp.arange(8) % 4,
+    }
+    rng = jax.random.PRNGKey(42)
+    results = []
+    for remat_over in ({}, {"remat": True, "remat_policy": policy}):
+        cfg = _tiny_cfg(train={"compute_dtype": "float32", **remat_over})
+        net = get_model(cfg.model, image_size=16)
+        lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
+        params, _ = net.init(jax.random.PRNGKey(0))
+        opt = optim.make_optimizer(cfg.optim, lr_fn, params)
+        ts = steps.init_train_state(net, cfg, opt, jax.random.PRNGKey(0))
+        step_fn = jax.jit(steps.make_train_step(net, cfg, opt, lr_fn))
+        ts, metrics = step_fn(ts, batch, rng)
+        results.append((ts, metrics))
+    (ts_plain, met_plain), (ts_remat, met_remat) = results
+    assert float(met_plain["loss"]) == float(met_remat["loss"])
+    assert float(met_plain["grad_norm"]) == float(met_remat["grad_norm"])
+    for a, b in zip(jax.tree.leaves(ts_plain.params), jax.tree.leaves(ts_remat.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_remat_policy_validated():
+    cfg = _tiny_cfg(train={"compute_dtype": "float32", "remat": True, "remat_policy": "nope"})
+    net = get_model(cfg.model, image_size=16)
+    lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
+    params, _ = net.init(jax.random.PRNGKey(0))
+    opt = optim.make_optimizer(cfg.optim, lr_fn, params)
+    with pytest.raises(ValueError, match="remat_policy"):
+        steps.make_train_step(net, cfg, opt, lr_fn)
+
+
 def test_train_step_overfits_tiny_batch():
     cfg = _tiny_cfg()
     net = get_model(cfg.model, image_size=16)

@@ -214,6 +214,10 @@ class TrainConfig:
     # jax.checkpoint the forward pass: recompute activations in backward to
     # trade FLOPs for HBM (enables larger per-chip batches)
     remat: bool = False
+    # remat flavor: "full" recomputes everything from the inputs; "save_conv"
+    # saves the conv (MXU) outputs and recomputes only the BN/act elementwise
+    # chains — targets the BN activation round-trips without re-running convs
+    remat_policy: str = "full"
     # BatchNorm normalize expression: "exact" (f32, reference semantics),
     # "folded" (precomputed f32 scale/bias FMA), "compute" (FMA in the
     # compute dtype). Statistics are identical f32 in every mode; this knob

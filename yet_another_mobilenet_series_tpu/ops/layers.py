@@ -26,6 +26,7 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 Array = jax.Array
 
@@ -98,7 +99,11 @@ class Conv2D:
         )
         if self.use_bias:
             y = y + params["b"].astype(compute_dtype)
-        return y
+        # remat landmark: train.remat_policy="save_conv" saves exactly these
+        # (the MXU results) and recomputes the cheap BN/act elementwise chain
+        # in backward, so normalized activations are never materialized
+        # (train/steps.py; identity when no jax.checkpoint wraps the forward)
+        return checkpoint_name(y, "conv_out")
 
 
 # ---------------------------------------------------------------------------

@@ -117,10 +117,21 @@ def make_train_step(
             bn_mode=cfg.train.bn_mode,
         )
 
+    if cfg.train.remat_policy not in ("full", "save_conv"):
+        # validated even with remat off, so a config typo can't lie dormant
+        # until someone flips remat on
+        raise ValueError(f"unknown train.remat_policy {cfg.train.remat_policy!r}")
     if cfg.train.remat:
         # recompute activations during backward: HBM for FLOPs
         # (jax.checkpoint; SURVEY.md §0 HBM-bandwidth note)
-        forward = jax.checkpoint(forward)
+        if cfg.train.remat_policy == "full":
+            forward = jax.checkpoint(forward)
+        else:
+            # save_conv: keep the MXU results, recompute the BN/act chains
+            # (the conv_out landmark in ops/layers.py Conv2D.apply)
+            forward = jax.checkpoint(
+                forward, policy=jax.checkpoint_policies.save_only_these_names("conv_out")
+            )
 
     def loss_fn(params, state, batch, masks, rho_mult, step, rng):
         logits, new_state = forward(params, state, batch["image"].astype(compute_dtype), masks, rng)

@@ -26,6 +26,7 @@ def build_train_fixture(
     image_size: int,
     *,
     remat: bool = False,
+    remat_policy: str = "full",
     bn_mode: str = "exact",
     arch: str = "mobilenet_v3_large",
 ):
@@ -43,7 +44,7 @@ def build_train_fixture(
         "schedule": {"schedule": "exp_decay", "base_lr": 0.064, "warmup_epochs": 5.0},
         "ema": {"enable": True},
         "train": {"batch_size": batch, "compute_dtype": "bfloat16",
-                  "remat": remat, "bn_mode": bn_mode},
+                  "remat": remat, "remat_policy": remat_policy, "bn_mode": bn_mode},
     })
     net = get_model(cfg.model, image_size)
     mesh = mesh_lib.make_mesh(len(jax.devices()))
