@@ -49,7 +49,7 @@ def main():
                          "dead tunnel burns ~25 min in backend init)")
     ap.add_argument(
         "--variants",
-        default="exact:0,folded:0,compute:0,exact:full,exact:save_conv,compute:save_conv",
+        default="exact:0,folded:0,compute:0,fused_vjp:0,exact:full,exact:save_conv,compute:save_conv",
         help="comma list of bn_mode:remat where remat is 0 (off), "
              "1/full (jax.checkpoint), or save_conv (keep MXU outputs, "
              "recompute BN/act chains)",
@@ -71,12 +71,18 @@ def main():
 
     key = jax.random.PRNGKey(0)
     rows = []
+    # all tokens validated before ANY variant runs — a typo must fail in
+    # milliseconds, not mid-sweep in a scarce hardware window
+    variants = []
     for spec_str in args.variants.split(","):
         mode, remat_s = spec_str.strip().split(":")
+        if mode not in ("exact", "folded", "compute", "fused_vjp"):
+            raise SystemExit(f"unknown bn_mode token {mode!r} in --variants")
         if remat_s not in ("0", "1", "full", "save_conv"):
             raise SystemExit(f"unknown remat token {remat_s!r} in --variants (use 0, 1, full, or save_conv)")
-        remat = remat_s != "0"
-        policy = remat_s if remat_s == "save_conv" else "full"
+        variants.append((mode, remat_s != "0", remat_s if remat_s == "save_conv" else "full"))
+
+    for mode, remat, policy in variants:
         step_fn, ts, b, _ = build_train_fixture(
             args.batch, args.image_size, remat=remat, remat_policy=policy, bn_mode=mode
         )

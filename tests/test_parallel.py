@@ -50,6 +50,35 @@ def setup():
     return cfg, net, lr_fn, opt, ts, batch
 
 
+def test_dp_step_bn_modes_agree(setup):
+    """bn_mode must not change the training math: one 8-device DP step under
+    each normalize variant produces the same updated params (within fp
+    re-association) and the same grad_norm — the steps.py pmean seam that a
+    psum'd custom backward would break with device_count× BN affine grads."""
+    import dataclasses as dc
+
+    cfg, net, lr_fn, opt, _, batch = setup
+    m = mesh_lib.make_mesh(8)
+    b = mesh_lib.shard_batch(batch, m)
+    results = {}
+    for mode in ("exact", "folded", "fused_vjp"):
+        cfg_m = dc.replace(cfg, train=dc.replace(cfg.train, bn_mode=mode))
+        ts = mesh_lib.replicate(steps.init_train_state(net, cfg_m, opt, jax.random.PRNGKey(0)), m)
+        step = dp.make_dp_train_step(net, cfg_m, opt, lr_fn, m)
+        ts, met = step(ts, b, jax.random.PRNGKey(7))
+        results[mode] = (jax.device_get(ts.params), float(met["grad_norm"]), float(met["loss"]))
+    p_ref, gn_ref, loss_ref = results["exact"]
+    for mode in ("folded", "fused_vjp"):
+        p, gn, loss = results[mode]
+        np.testing.assert_allclose(loss, loss_ref, rtol=1e-5)
+        np.testing.assert_allclose(gn, gn_ref, rtol=1e-4)
+        # post-RMSProp params: rsqrt(nu) amplifies reduction-order rounding
+        # where grads are tiny, so the param bound is looser than the
+        # grad-level contract test's (test_ops.py, rtol=1e-4 per device)
+        for a, c in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-3, atol=1e-5)
+
+
 def test_dp_step_equals_single_device_large_batch(setup):
     """psum grad allreduce + SyncBN == single-device full-batch step
     (SURVEY.md §4.2) — THE data-parallel correctness contract."""

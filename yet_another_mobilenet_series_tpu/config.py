@@ -220,9 +220,10 @@ class TrainConfig:
     remat_policy: str = "full"
     # BatchNorm normalize expression: "exact" (f32, reference semantics),
     # "folded" (precomputed f32 scale/bias FMA), "compute" (FMA in the
-    # compute dtype). Statistics are identical f32 in every mode; this knob
-    # targets the 52% BN-reduction share of the round-2 TPU trace
-    # (PROFILE.md). See ops/layers.py BatchNorm.apply.
+    # compute dtype), "fused_vjp" (folded forward + closed-form custom
+    # backward with pinned bf16 residuals). Statistics are identical f32 in
+    # every mode; this knob targets the 52% BN-reduction share of the
+    # round-2 TPU trace (PROFILE.md). See ops/layers.py BatchNorm.apply.
     bn_mode: str = "exact"
     log_every: int = 100
     eval_every_epochs: float = 1.0

@@ -111,6 +111,88 @@ class Conv2D:
 # ---------------------------------------------------------------------------
 
 
+def _bn_moments(x, axis_name):
+    """Global (psum'd) f32 moments of x over N,H,W: (mean, var_biased, n).
+    f32 accumulators reduce the input dtype directly — bit-equal to casting
+    first, with no materialized f32 copy of the activation."""
+    n_local = x.shape[0] * x.shape[1] * x.shape[2]
+    s1 = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
+    s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+    n = jnp.asarray(n_local, jnp.float32)
+    if axis_name is not None:
+        s1 = lax.psum(s1, axis_name)
+        s2 = lax.psum(s2, axis_name)
+        n = lax.psum(n, axis_name)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)  # biased
+    return mean, var, n
+
+
+def _bn_train_fused(x, gamma, beta, eps, axis_name):
+    y, mean, var, _ = _bn_train_fused_fwd_impl(x, gamma, beta, eps, axis_name)
+    return y, mean, var
+
+
+def _bn_train_fused_fwd_impl(x, gamma, beta, eps, axis_name):
+    mean, var, n = _bn_moments(x, axis_name)
+    inv = lax.rsqrt(var + eps)
+    scale = gamma * inv
+    bias = beta - mean * scale
+    y = (x.astype(jnp.float32) * scale + bias).astype(x.dtype)
+    return y, mean, var, (inv, n)
+
+
+def _bn_train_fused_fwd(x, gamma, beta, eps, axis_name):
+    y, mean, var, (inv, n) = _bn_train_fused_fwd_impl(x, gamma, beta, eps, axis_name)
+    # residuals are the bf16 input + per-channel f32 stats — x_hat and any
+    # f32 copy of the activation are recomputed, never stored
+    return (y, mean, var), (x, gamma, mean, inv, n)
+
+
+def _bn_train_fused_bwd(eps, axis_name, res, cts):
+    """Closed-form BN backward through the batch statistics:
+
+        dβ = Σ_local dy;  dγ = Σ_local dy·x̂;
+        dx = γ·inv · (dy − psum(dβ)/n − x̂·psum(dγ)/n)    with n GLOBAL
+
+    The asymmetry is the per-device gradient contract autodiff of the other
+    bn_modes produces under the production shard_maps (parallel/dp.py,
+    check_vma=False), pinned by tests/test_ops.py's sharded-contract test:
+
+    - γ/β are REPLICATED params: each device returns its local partial sum
+      and the training step's grad pmean (train/steps.py) — or the ZeRO
+      psum_scatter — combines them. A psum here would double-count
+      (device_count× BN affine grads; caught by review in round 3).
+    - x is SHARDED: each shard's cotangent must be complete immediately,
+      and x_e affects every device's outputs through the psum'd moments, so
+      the correction terms need the GLOBAL sums (the transpose of the
+      forward psum).
+
+    The two local reductions fuse into ONE pass over (x, dy); dx is one
+    more elementwise pass. Cotangents of the mean/var outputs are ignored:
+    they feed only the running-stat state, which the training loss never
+    differentiates (train/steps.py returns new_state as aux). The var
+    zero-clamp in _bn_moments is treated as inactive (it only engages when
+    catastrophic cancellation makes var numerically negative)."""
+    del eps  # static; backward needs only the saved residuals
+    x, gamma, mean, inv, n = res
+    dy, _dmean_ct, _dvar_ct = cts
+    dyf = dy.astype(jnp.float32)
+    x_hat = (x.astype(jnp.float32) - mean) * inv
+    dbeta = jnp.sum(dyf, axis=(0, 1, 2))
+    dgamma = jnp.sum(dyf * x_hat, axis=(0, 1, 2))
+    s1, s2 = dbeta, dgamma
+    if axis_name is not None:
+        s1 = lax.psum(s1, axis_name)
+        s2 = lax.psum(s2, axis_name)
+    dx = (gamma * inv) * (dyf - s1 / n - x_hat * (s2 / n))
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+_bn_train_fused = jax.custom_vjp(_bn_train_fused, nondiff_argnums=(3, 4))
+_bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_train_fused_bwd)
+
+
 @dataclass(frozen=True)
 class BatchNorm:
     """BatchNorm over N,H,W with torch semantics:
@@ -171,43 +253,47 @@ class BatchNorm:
           the FMA runs entirely in the compute dtype (bf16): halves the
           elementwise VPU width and drops both converts. Costs ~2-3 ulps of
           bf16 precision on y; opt-in for perf A/B.
+        - "fused_vjp" — the "folded" forward under a custom VJP whose
+          backward is the closed-form BN gradient: residuals are pinned to
+          the bf16 input + per-channel f32 stats (x̂ and f32 activation
+          copies are recomputed, never stored), and the dγ/dβ reductions
+          fuse into one pass over (x, dy). Values equal "folded" exactly;
+          gradients equal autodiff within reduction-order rounding.
         """
+        if mode not in ("exact", "folded", "compute", "fused_vjp"):
+            raise ValueError(f"unknown bn mode {mode!r}")
         out_dtype = x.dtype
-        if train:
-            # Per-device sums; psum across replicas makes them global (SyncBN).
-            n_local = x.shape[0] * x.shape[1] * x.shape[2]
-            # f32 accumulators; the square must also be f32 (a bf16 square
-            # would round every element before accumulation — not equivalent
-            # to the reference's f32 moments). The convert fuses inline.
-            s1 = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
-            s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
-            n = jnp.asarray(n_local, jnp.float32)
-            if axis_name is not None:
-                s1 = lax.psum(s1, axis_name)
-                s2 = lax.psum(s2, axis_name)
-                n = lax.psum(n, axis_name)
-            mean = s1 / n
-            var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)  # biased
+
+        def running(mean, var, n):
             m = self.momentum
             unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
-            new_state = {
+            return {
                 "mean": (1.0 - m) * state["mean"] + m * mean,
                 "var": (1.0 - m) * state["var"] + m * unbiased,
             }
+
+        if train and mode == "fused_vjp":
+            y, mean, var = _bn_train_fused(x, params["gamma"], params["beta"], self.eps, axis_name)
+            # lax.psum of the literal 1 is constant-folded to the axis size
+            n = jnp.asarray(x.shape[0] * x.shape[1] * x.shape[2], jnp.float32)
+            if axis_name is not None:
+                n = n * lax.psum(1, axis_name)
+            return y, running(mean, var, n)
+        if train:
+            mean, var, n = _bn_moments(x, axis_name)
+            new_state = running(mean, var, n)
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
         scale = lax.rsqrt(var + self.eps) * params["gamma"]
         if mode == "exact":
             y = (x.astype(jnp.float32) - mean) * scale + params["beta"]
-        elif mode == "folded":
-            bias = params["beta"] - mean * scale
-            y = x.astype(jnp.float32) * scale + bias
         elif mode == "compute":
             bias = params["beta"] - mean * scale
             y = x * scale.astype(out_dtype) + bias.astype(out_dtype)
-        else:
-            raise ValueError(f"unknown bn mode {mode!r}")
+        else:  # "folded", and eval-mode "fused_vjp" (same expression)
+            bias = params["beta"] - mean * scale
+            y = x.astype(jnp.float32) * scale + bias
         return y.astype(out_dtype), new_state
 
 
