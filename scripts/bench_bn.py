@@ -49,10 +49,11 @@ def main():
                          "dead tunnel burns ~25 min in backend init)")
     ap.add_argument(
         "--variants",
-        default="exact:0,folded:0,compute:0,fused_vjp:0,exact:full,exact:save_conv,compute:save_conv",
-        help="comma list of bn_mode:remat where remat is 0 (off), "
+        default="exact:0,folded:0,compute:0,fused_vjp:0,exact:full,exact:save_conv,compute:save_conv,exact:0:dot",
+        help="comma list of bn_mode:remat[:dot] where remat is 0 (off), "
              "1/full (jax.checkpoint), or save_conv (keep MXU outputs, "
-             "recompute BN/act chains)",
+             "recompute BN/act chains); a trailing ':dot' lowers 1x1 convs "
+             "as explicit matmuls (train.conv1x1_dot)",
     )
     args = ap.parse_args()
     if args.cpu:
@@ -75,16 +76,23 @@ def main():
     # milliseconds, not mid-sweep in a scarce hardware window
     variants = []
     for spec_str in args.variants.split(","):
-        mode, remat_s = spec_str.strip().split(":")
+        parts = spec_str.strip().split(":")
+        if len(parts) < 2:
+            raise SystemExit(f"malformed variant {spec_str.strip()!r} (expected bn_mode:remat[:dot])")
+        mode, remat_s = parts[0], parts[1]
+        extra = parts[2:]
         if mode not in ("exact", "folded", "compute", "fused_vjp"):
             raise SystemExit(f"unknown bn_mode token {mode!r} in --variants")
         if remat_s not in ("0", "1", "full", "save_conv"):
             raise SystemExit(f"unknown remat token {remat_s!r} in --variants (use 0, 1, full, or save_conv)")
-        variants.append((mode, remat_s != "0", remat_s if remat_s == "save_conv" else "full"))
+        if extra not in ([], ["dot"]):
+            raise SystemExit(f"unknown trailing token(s) {extra!r} in --variants (only ':dot' is valid)")
+        variants.append((mode, remat_s != "0", remat_s if remat_s == "save_conv" else "full", bool(extra)))
 
-    for mode, remat, policy in variants:
+    for mode, remat, policy, dot in variants:
         step_fn, ts, b, _ = build_train_fixture(
-            args.batch, args.image_size, remat=remat, remat_policy=policy, bn_mode=mode
+            args.batch, args.image_size, remat=remat, remat_policy=policy, bn_mode=mode,
+            conv1x1_dot=dot,
         )
         t0 = time.perf_counter()
         ts, metrics = step_fn(ts, b, key)
@@ -101,16 +109,20 @@ def main():
         img_s = args.batch / dt
         remat_label = "off" if not remat else policy
         rows.append({
-            "bn_mode": mode, "remat": remat_label, "ms_per_step": round(dt * 1e3, 2),
+            "bn_mode": mode, "remat": remat_label, "conv1x1_dot": dot,
+            "ms_per_step": round(dt * 1e3, 2),
             "img_s_per_chip": round(img_s / len(jax.devices()), 1),
             "compile_s": round(compile_s, 1), "loss": round(loss, 4),
         })
-        log(f"  bn_mode={mode:<8} remat={remat_label:<9}: {dt*1e3:8.2f} ms/step, "
+        log(f"  bn_mode={mode:<8} remat={remat_label:<9} dot={int(dot)}: {dt*1e3:8.2f} ms/step, "
             f"{img_s:8.0f} img/s, loss {loss:.4f} (compile {compile_s:.0f}s)")
         # free the variant's buffers before building the next one
         step_fn = ts = b = None
 
-    base = next((r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off"), None)
+    base = next(
+        (r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off" and not r["conv1x1_dot"]),
+        None,
+    )
     for r in rows:
         if base:
             r["vs_exact"] = round(base["ms_per_step"] / r["ms_per_step"], 3)

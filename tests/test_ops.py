@@ -60,6 +60,36 @@ def test_conv2d_matches_torch(cin, cout, k, stride, groups):
     np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-4, atol=1e-5)
 
 
+def test_conv1x1_as_dot_matches_conv_lowering():
+    """as_dot (the round-3 weight-grad MXU experiment, train.conv1x1_dot)
+    must be a pure lowering change: forward values and weight gradients
+    match the conv_general_dilated path, including the stride>1 subsample
+    case; k>1 and grouped convs ignore the flag entirely."""
+    for cin, cout, stride in [(8, 16, 1), (8, 16, 2), (16, 5, 1)]:
+        spec = ops.Conv2D(cin, cout, 1, stride)
+        params = spec.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 9, cin))
+
+        y_conv = spec.apply(params, x)
+        y_dot = spec.apply(params, x, as_dot=True)
+        np.testing.assert_allclose(np.asarray(y_dot), np.asarray(y_conv), rtol=1e-5, atol=1e-6)
+
+        def loss(p, as_dot):
+            return jnp.sum(jnp.square(spec.apply(p, x, as_dot=as_dot)))
+
+        g_conv = jax.grad(loss)(params, False)["w"]
+        g_dot = jax.grad(loss)(params, True)["w"]
+        np.testing.assert_allclose(np.asarray(g_dot), np.asarray(g_conv), rtol=1e-4, atol=1e-5)
+
+    # non-1x1 / grouped: flag is a no-op (same lowering, identical values)
+    dw = ops.Conv2D(8, 8, 3, 1, groups=8)
+    pdw = dw.init(jax.random.PRNGKey(2))
+    xdw = jax.random.normal(jax.random.PRNGKey(3), (2, 7, 7, 8))
+    np.testing.assert_array_equal(
+        np.asarray(dw.apply(pdw, xdw, as_dot=True)), np.asarray(dw.apply(pdw, xdw))
+    )
+
+
 def test_batchnorm_matches_torch_train_and_eval():
     import torch
 

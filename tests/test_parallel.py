@@ -51,24 +51,31 @@ def setup():
 
 
 def test_dp_step_bn_modes_agree(setup):
-    """bn_mode must not change the training math: one 8-device DP step under
-    each normalize variant produces the same updated params (within fp
-    re-association) and the same grad_norm — the steps.py pmean seam that a
-    psum'd custom backward would break with device_count× BN affine grads."""
+    """Execution variants (bn_mode, conv1x1_dot) must not change the training
+    math: one 8-device DP step under each produces the same updated params
+    (within fp re-association) and the same grad_norm — the steps.py pmean
+    seam that a psum'd custom backward would break with device_count× BN
+    affine grads."""
     import dataclasses as dc
 
     cfg, net, lr_fn, opt, _, batch = setup
     m = mesh_lib.make_mesh(8)
     b = mesh_lib.shard_batch(batch, m)
+    variants = {
+        "exact": {"bn_mode": "exact"},
+        "folded": {"bn_mode": "folded"},
+        "fused_vjp": {"bn_mode": "fused_vjp"},
+        "exact+dot": {"bn_mode": "exact", "conv1x1_dot": True},
+    }
     results = {}
-    for mode in ("exact", "folded", "fused_vjp"):
-        cfg_m = dc.replace(cfg, train=dc.replace(cfg.train, bn_mode=mode))
+    for name, over in variants.items():
+        cfg_m = dc.replace(cfg, train=dc.replace(cfg.train, **over))
         ts = mesh_lib.replicate(steps.init_train_state(net, cfg_m, opt, jax.random.PRNGKey(0)), m)
         step = dp.make_dp_train_step(net, cfg_m, opt, lr_fn, m)
         ts, met = step(ts, b, jax.random.PRNGKey(7))
-        results[mode] = (jax.device_get(ts.params), float(met["grad_norm"]), float(met["loss"]))
+        results[name] = (jax.device_get(ts.params), float(met["grad_norm"]), float(met["loss"]))
     p_ref, gn_ref, loss_ref = results["exact"]
-    for mode in ("folded", "fused_vjp"):
+    for mode in ("folded", "fused_vjp", "exact+dot"):
         p, gn, loss = results[mode]
         np.testing.assert_allclose(loss, loss_ref, rtol=1e-5)
         np.testing.assert_allclose(gn, gn_ref, rtol=1e-4)

@@ -225,6 +225,10 @@ class TrainConfig:
     # every mode; this knob targets the 52% BN-reduction share of the
     # round-2 TPU trace (PROFILE.md). See ops/layers.py BatchNorm.apply.
     bn_mode: str = "exact"
+    # lower 1x1 ungrouped convs as explicit matmuls so their weight grads
+    # are guaranteed MXU dots — targets the 25.3% multiply_add_fusion
+    # weight-grad share of the round-2 trace (ops/layers.py Conv2D.apply)
+    conv1x1_dot: bool = False
     log_every: int = 100
     eval_every_epochs: float = 1.0
     checkpoint_every_epochs: float = 1.0

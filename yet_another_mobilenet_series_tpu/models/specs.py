@@ -98,6 +98,7 @@ class Network:
         masks: Mapping[int, Any] | None = None,
         rng=None,
         bn_mode: str = "exact",
+        conv1x1_dot: bool = False,
     ):
         import jax.numpy as jnp
 
@@ -124,12 +125,13 @@ class Network:
                 compute_dtype=compute_dtype,
                 mask=mask,
                 bn_mode=bn_mode,
+                conv1x1_dot=conv1x1_dot,
             )
         new_state["blocks"] = nbs
         if self.head is not None:
             h, new_state["head"] = self.head.apply(
                 params["head"], state["head"], h, train=train, axis_name=axis_name, compute_dtype=compute_dtype,
-                bn_mode=bn_mode,
+                bn_mode=bn_mode, conv1x1_dot=conv1x1_dot,
             )
         h = global_avg_pool(h)  # (N, C)
         if self.feature is not None:

@@ -59,8 +59,9 @@ class ConvBNAct:
         params["bn"] = bn_p
         return params, {"bn": bn_s}
 
-    def apply(self, params, state, x, *, train, axis_name=None, compute_dtype=jnp.float32, bn_mode="exact"):
-        y = self.conv.apply(params["conv"], x, compute_dtype=compute_dtype)
+    def apply(self, params, state, x, *, train, axis_name=None, compute_dtype=jnp.float32, bn_mode="exact",
+              conv1x1_dot=False):
+        y = self.conv.apply(params["conv"], x, compute_dtype=compute_dtype, as_dot=conv1x1_dot)
         y, bn_s = self.bn.apply(params["bn"], state["bn"], y, train=train, axis_name=axis_name, mode=bn_mode)
         y = get_activation(self.active_fn)(y)
         return y, {"bn": bn_s}
@@ -195,6 +196,7 @@ class InvertedResidual:
         compute_dtype=jnp.float32,
         mask: Array | None = None,
         bn_mode: str = "exact",
+        conv1x1_dot: bool = False,
     ):
         """mask: optional (expanded_channels,) multiplier zeroing dead atoms.
 
@@ -207,7 +209,7 @@ class InvertedResidual:
         h = x
         if self.has_expand:
             h = Conv2D(self.in_channels, self.expanded_channels, 1).apply(
-                params["expand"], h, compute_dtype=compute_dtype
+                params["expand"], h, compute_dtype=compute_dtype, as_dot=conv1x1_dot
             )
             h, new_state["expand_bn"] = self._bn(self.expanded_channels).apply(
                 params["expand_bn"], state["expand_bn"], h, train=train, axis_name=axis_name, mode=bn_mode
@@ -230,7 +232,9 @@ class InvertedResidual:
             h = SqueezeExcite(self.expanded_channels, self.se_channels, self.se_inner_act, self.se_gate_fn).apply(
                 params["se"], h, compute_dtype=compute_dtype
             )
-        h = Conv2D(self.expanded_channels, self.out_channels, 1).apply(params["project"], h, compute_dtype=compute_dtype)
+        h = Conv2D(self.expanded_channels, self.out_channels, 1).apply(
+            params["project"], h, compute_dtype=compute_dtype, as_dot=conv1x1_dot
+        )
         h, new_state["project_bn"] = self._bn(self.out_channels).apply(
             params["project_bn"], state["project_bn"], h, train=train, axis_name=axis_name, mode=bn_mode
         )

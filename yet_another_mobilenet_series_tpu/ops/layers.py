@@ -85,18 +85,32 @@ class Conv2D:
             params["b"] = jnp.zeros((self.out_channels,), jnp.float32)
         return params
 
-    def apply(self, params: dict, x: Array, *, compute_dtype=jnp.float32) -> Array:
+    def apply(self, params: dict, x: Array, *, compute_dtype=jnp.float32, as_dot: bool = False) -> Array:
+        """as_dot lowers a 1x1 ungrouped conv as an explicit matmul
+        (`(N,H,W,Cin) @ (Cin,Cout)`): forward is the same contraction XLA
+        canonicalizes 1x1 convs to, but the WEIGHT GRADIENT of a dot is
+        guaranteed to lower as another dot (MXU) — the round-2 trace showed
+        25.3% of step time in `multiply_add_fusion` weight-grad reductions
+        (PROFILE.md), and this removes XLA's freedom to pick that lowering
+        for the 1x1s. No-op for k>1 or grouped convs. Param layout is
+        unchanged (HWIO, reshaped at apply), so checkpoints are identical."""
         w = params["w"].astype(compute_dtype)
         x = x.astype(compute_dtype)
-        pad = self.kernel_size // 2
-        y = lax.conv_general_dilated(
-            x,
-            w,
-            window_strides=(self.stride, self.stride),
-            padding=((pad, pad), (pad, pad)),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=self.groups,
-        )
+        if as_dot and self.kernel_size == 1 and self.groups == 1:
+            if self.stride > 1:
+                # 1x1 stride-s conv == subsample then matmul (pad is 0)
+                x = x[:, :: self.stride, :: self.stride, :]
+            y = x @ w.reshape(self.in_channels, self.out_channels)
+        else:
+            pad = self.kernel_size // 2
+            y = lax.conv_general_dilated(
+                x,
+                w,
+                window_strides=(self.stride, self.stride),
+                padding=((pad, pad), (pad, pad)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=self.groups,
+            )
         if self.use_bias:
             y = y + params["b"].astype(compute_dtype)
         # remat landmark: train.remat_policy="save_conv" saves exactly these

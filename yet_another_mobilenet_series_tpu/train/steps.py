@@ -115,6 +115,7 @@ def make_train_step(
             masks=imasks,
             rng=rng,
             bn_mode=cfg.train.bn_mode,
+            conv1x1_dot=cfg.train.conv1x1_dot,
         )
 
     if cfg.train.remat_policy not in ("full", "save_conv"):
@@ -210,6 +211,7 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
             compute_dtype=compute_dtype,
             masks=imasks,
             bn_mode=cfg.train.bn_mode,
+            conv1x1_dot=cfg.train.conv1x1_dot,
         )
         labels = batch["label"]
         # padded examples carry label -1: mask them out of every count

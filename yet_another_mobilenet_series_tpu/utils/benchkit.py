@@ -28,6 +28,7 @@ def build_train_fixture(
     remat: bool = False,
     remat_policy: str = "full",
     bn_mode: str = "exact",
+    conv1x1_dot: bool = False,
     arch: str = "mobilenet_v3_large",
 ):
     """Returns (step_fn, replicated_train_state, sharded_batch, net) for the
@@ -44,7 +45,8 @@ def build_train_fixture(
         "schedule": {"schedule": "exp_decay", "base_lr": 0.064, "warmup_epochs": 5.0},
         "ema": {"enable": True},
         "train": {"batch_size": batch, "compute_dtype": "bfloat16",
-                  "remat": remat, "remat_policy": remat_policy, "bn_mode": bn_mode},
+                  "remat": remat, "remat_policy": remat_policy, "bn_mode": bn_mode,
+                  "conv1x1_dot": conv1x1_dot},
     })
     net = get_model(cfg.model, image_size)
     mesh = mesh_lib.make_mesh(len(jax.devices()))
