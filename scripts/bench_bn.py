@@ -56,6 +56,27 @@ def main():
              "as explicit matmuls (train.conv1x1_dot)",
     )
     args = ap.parse_args()
+
+    # all tokens validated before ANY backend touch or variant run — a typo
+    # must fail in milliseconds, not after a 25-min dead-tunnel init or
+    # mid-sweep in a scarce hardware window
+    from yet_another_mobilenet_series_tpu.ops.layers import BN_MODES
+
+    variants = []
+    for spec_str in args.variants.split(","):
+        parts = spec_str.strip().split(":")
+        if len(parts) < 2:
+            raise SystemExit(f"malformed variant {spec_str.strip()!r} (expected bn_mode:remat[:dot])")
+        mode, remat_s = parts[0], parts[1]
+        extra = parts[2:]
+        if mode not in BN_MODES:
+            raise SystemExit(f"unknown bn_mode token {mode!r} in --variants (valid: {BN_MODES})")
+        if remat_s not in ("0", "1", "full", "save_conv"):
+            raise SystemExit(f"unknown remat token {remat_s!r} in --variants (use 0, 1, full, or save_conv)")
+        if extra not in ([], ["dot"]):
+            raise SystemExit(f"unknown trailing token(s) {extra!r} in --variants (only ':dot' is valid)")
+        variants.append((mode, remat_s != "0", remat_s if remat_s == "save_conv" else "full", bool(extra)))
+
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
@@ -72,23 +93,6 @@ def main():
 
     key = jax.random.PRNGKey(0)
     rows = []
-    # all tokens validated before ANY variant runs — a typo must fail in
-    # milliseconds, not mid-sweep in a scarce hardware window
-    variants = []
-    for spec_str in args.variants.split(","):
-        parts = spec_str.strip().split(":")
-        if len(parts) < 2:
-            raise SystemExit(f"malformed variant {spec_str.strip()!r} (expected bn_mode:remat[:dot])")
-        mode, remat_s = parts[0], parts[1]
-        extra = parts[2:]
-        if mode not in ("exact", "folded", "compute", "fused_vjp"):
-            raise SystemExit(f"unknown bn_mode token {mode!r} in --variants")
-        if remat_s not in ("0", "1", "full", "save_conv"):
-            raise SystemExit(f"unknown remat token {remat_s!r} in --variants (use 0, 1, full, or save_conv)")
-        if extra not in ([], ["dot"]):
-            raise SystemExit(f"unknown trailing token(s) {extra!r} in --variants (only ':dot' is valid)")
-        variants.append((mode, remat_s != "0", remat_s if remat_s == "save_conv" else "full", bool(extra)))
-
     for mode, remat, policy, dot in variants:
         step_fn, ts, b, _ = build_train_fixture(
             args.batch, args.image_size, remat=remat, remat_policy=policy, bn_mode=mode,

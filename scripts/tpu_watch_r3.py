@@ -1,0 +1,157 @@
+"""Round-3 TPU session watcher: poll the tunnel; on the first alive window,
+run the queued hardware measurements unattended.
+
+The axon tunnel has been dead for every probe this round (~25 min
+UNAVAILABLE per attempt; PROFILE.md), but alive windows appear without
+warning (round 2 got one). This watcher makes an alive window impossible to
+miss: it probes via ``bench.py --probe`` (150 s kill separates alive from
+dead), and when the backend comes up it runs, sequentially, ONE job at a
+time (never killing a started TPU process — a killed job can wedge the
+tunnel for the rest of the session):
+
+  1. scripts/bench_bn.py --out BENCH_BN_r3.json   (the round's A/B)
+  2. python bench.py > BENCH_TPU_r3.json          (headline metric)
+
+Before starting a session it waits for any running pytest to finish (this
+sandbox has ONE visible core; concurrent CPU load corrupts TPU timings).
+A deadline stops NEW probe/session attempts so nothing is mid-flight when
+the round's driver wants the chip.
+
+Usage: python scripts/tpu_watch_r3.py [--deadline-min 240] [--interval 60]
+Log: stderr (redirect to a file; tail it for status).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SENTINEL = "/tmp/TPU_SESSION_ACTIVE"
+# realistic TPU occupancy of one alive-tunnel session (A/B ~20 min +
+# headline ~10 min + margin; the quiet-CPU wait is usually zero). No session
+# starts unless it fits entirely before the deadline.
+SESSION_BUDGET_S = 3600
+
+sys.path.insert(0, REPO)
+from bench import run_probe  # noqa: E402  (the canonical probe: 150s kill, alive/failed/timeout trichotomy)
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_alive() -> bool:
+    status, info = run_probe()
+    if status == "alive" and info.get("platform") == "tpu":
+        log(f"ALIVE: {info}")
+        return True
+    log(f"probe status: {status}")
+    return False
+
+
+def wait_for_quiet_cpu(max_wait_s=2400):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < max_wait_s:
+        r = subprocess.run(["pgrep", "-f", "pytest"], capture_output=True)
+        if r.returncode != 0:
+            return
+        log("pytest running; delaying TPU session for quiet CPU")
+        time.sleep(60)
+    log("quiet-CPU wait expired; proceeding anyway")
+
+
+def run_session() -> bool:
+    """Returns True only if the round's A/B artifact was actually produced —
+    a False lets the caller keep watching for the next alive window."""
+    ab_path = os.path.join(REPO, "BENCH_BN_r3.json")
+    open(SENTINEL, "w").write(str(time.time()))
+    try:
+        # timeouts sized far above any real alive-tunnel run (8 variants x
+        # ~1 min compile + 20 iters each ~= 15 min): hitting one means the
+        # window closed and the process is stuck in dead-tunnel init — the
+        # safe-to-kill probe case, NOT a running TPU job.
+        log("session: bench_bn A/B starting")
+        try:
+            r1 = subprocess.run(
+                [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
+                cwd=REPO, capture_output=True, text=True, timeout=3600,
+            )
+        except subprocess.TimeoutExpired:
+            log("bench_bn exceeded 1h (window closed mid-session); will keep watching")
+            return False
+        log(f"bench_bn rc={r1.returncode}; stderr tail: {r1.stderr[-2000:]}")
+        if r1.returncode != 0 or not os.path.exists(ab_path):
+            log("A/B failed (window closed?); will keep watching")
+            return False
+        log("session: headline bench.py starting")
+        try:
+            r2 = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                cwd=REPO, capture_output=True, text=True, timeout=2700,
+            )
+        except subprocess.TimeoutExpired:
+            log("bench.py exceeded its window; A/B secured, will rewatch for the headline")
+            return False
+        log(f"bench rc={r2.returncode}; stdout: {r2.stdout[-1000:]}")
+        # only a REAL TPU measurement counts as the headline artifact —
+        # bench.py prints structured error/fallback JSON on failure too,
+        # and recording that would end the watch with a corrupt headline
+        headline = None
+        for line in reversed(r2.stdout.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+                if isinstance(cand, dict) and "metric" in cand:
+                    headline = cand
+                    break
+            except json.JSONDecodeError:
+                continue
+        ok = (
+            r2.returncode == 0 and headline is not None
+            and headline.get("value") is not None and headline.get("platform") == "tpu"
+        )
+        if ok:
+            with open(os.path.join(REPO, "BENCH_TPU_r3.json"), "w") as f:
+                json.dump(headline, f)
+                f.write("\n")
+            log("session complete")
+        else:
+            log("headline run produced no TPU measurement; will rewatch")
+        return ok
+    finally:
+        if os.path.exists(SENTINEL):
+            os.unlink(SENTINEL)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=240.0,
+                    help="stop starting new probes/sessions after this many minutes")
+    ap.add_argument("--interval", type=float, default=60.0, help="sleep between dead probes")
+    args = ap.parse_args()
+    t_end = time.monotonic() + args.deadline_min * 60
+    n = 0
+    # a session found at the deadline's edge would occupy the chip long past
+    # it — stop probing once a full session can no longer fit
+    while time.monotonic() + SESSION_BUDGET_S < t_end:
+        n += 1
+        log(f"probe #{n}")
+        if probe_alive():
+            wait_for_quiet_cpu()
+            # the quiet-CPU wait can outlive an alive window: re-confirm
+            # before burning a ~25-min dead-tunnel init inside the session
+            if probe_alive() and run_session():
+                return
+            log("window closed or session failed; resuming watch")
+            continue
+        log("dead; sleeping")
+        time.sleep(args.interval)
+    log("deadline reached without an alive window (or remaining time < one session)")
+
+
+if __name__ == "__main__":
+    main()

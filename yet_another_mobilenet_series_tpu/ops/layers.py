@@ -30,6 +30,10 @@ from jax.ad_checkpoint import checkpoint_name
 
 Array = jax.Array
 
+# the BatchNorm.apply normalize variants (single source of truth — the step
+# builders and the A/B bench validate against this same tuple)
+BN_MODES = ("exact", "folded", "compute", "fused_vjp")
+
 
 # ---------------------------------------------------------------------------
 # Initializers (torch-default-compatible: kaiming fan_out for convs, SURVEY.md §7)
@@ -274,7 +278,7 @@ class BatchNorm:
           fuse into one pass over (x, dy). Values equal "folded" exactly;
           gradients equal autodiff within reduction-order rounding.
         """
-        if mode not in ("exact", "folded", "compute", "fused_vjp"):
+        if mode not in BN_MODES:
             raise ValueError(f"unknown bn mode {mode!r}")
         out_dtype = x.dtype
 

@@ -74,6 +74,14 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
+def _check_bn_mode(cfg: Config):
+    """Fail at step-build time, not first-trace time deep inside jit."""
+    from ..ops.layers import BN_MODES
+
+    if cfg.train.bn_mode not in BN_MODES:
+        raise ValueError(f"unknown train.bn_mode {cfg.train.bn_mode!r} (valid: {BN_MODES})")
+
+
 def make_train_step(
     net: Network,
     cfg: Config,
@@ -122,6 +130,7 @@ def make_train_step(
         # validated even with remat off, so a config typo can't lie dormant
         # until someone flips remat on
         raise ValueError(f"unknown train.remat_policy {cfg.train.remat_policy!r}")
+    _check_bn_mode(cfg)
     if cfg.train.remat:
         # recompute activations during backward: HBM for FLOPs
         # (jax.checkpoint; SURVEY.md §0 HBM-bandwidth note)
@@ -200,6 +209,7 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
     (SURVEY.md §2 #13). Runs on EMA shadow weights when the caller passes
     them (reference: eval-on-shadow, SURVEY.md §2 #8)."""
     compute_dtype = _dtype(cfg.train.compute_dtype)
+    _check_bn_mode(cfg)
 
     def eval_fn(params, state, batch, masks):
         imasks = {int(k): v for k, v in masks.items()} or None
