@@ -32,10 +32,16 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SENTINEL = "/tmp/TPU_SESSION_ACTIVE"
-# realistic TPU occupancy of one alive-tunnel session (A/B ~20 min +
-# headline ~10 min + margin; the quiet-CPU wait is usually zero). No session
-# starts unless it fits entirely before the deadline.
-SESSION_BUDGET_S = 3600
+# Worst-case wall clock of one session attempt: quiet-CPU wait (capped
+# below) + re-probe + A/B timeout + headline timeout. No session starts
+# unless this budget fits entirely before the deadline, so nothing is
+# mid-flight when the round's driver wants the chip.
+QUIET_WAIT_S = 1200
+AB_TIMEOUT_S = 3000       # alive-tunnel A/B is ~20 min; 50 min => window died
+HEADLINE_TIMEOUT_S = 6000  # above bench.py's own worst case (~4950 s): it
+                           # self-bounds via probe/deadline/fallback, so this
+                           # backstop should never fire on a live supervisor
+SESSION_BUDGET_S = QUIET_WAIT_S + 150 + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
 
 sys.path.insert(0, REPO)
 from bench import run_probe  # noqa: E402  (the canonical probe: 150s kill, alive/failed/timeout trichotomy)
@@ -54,7 +60,7 @@ def probe_alive() -> bool:
     return False
 
 
-def wait_for_quiet_cpu(max_wait_s=2400):
+def wait_for_quiet_cpu(max_wait_s=QUIET_WAIT_S):
     t0 = time.monotonic()
     while time.monotonic() - t0 < max_wait_s:
         r = subprocess.run(["pgrep", "-f", "pytest"], capture_output=True)
@@ -71,31 +77,39 @@ def run_session() -> bool:
     ab_path = os.path.join(REPO, "BENCH_BN_r3.json")
     open(SENTINEL, "w").write(str(time.time()))
     try:
-        # timeouts sized far above any real alive-tunnel run (8 variants x
-        # ~1 min compile + 20 iters each ~= 15 min): hitting one means the
-        # window closed and the process is stuck in dead-tunnel init — the
-        # safe-to-kill probe case, NOT a running TPU job.
-        log("session: bench_bn A/B starting")
-        try:
-            r1 = subprocess.run(
-                [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
-                cwd=REPO, capture_output=True, text=True, timeout=3600,
-            )
-        except subprocess.TimeoutExpired:
-            log("bench_bn exceeded 1h (window closed mid-session); will keep watching")
-            return False
-        log(f"bench_bn rc={r1.returncode}; stderr tail: {r1.stderr[-2000:]}")
-        if r1.returncode != 0 or not os.path.exists(ab_path):
-            log("A/B failed (window closed?); will keep watching")
-            return False
+        # a previous partial session may have secured the A/B already —
+        # don't spend a fresh (possibly short) alive window redoing it
+        if os.path.exists(ab_path):
+            log("A/B artifact already present; skipping straight to headline")
+        else:
+            # hitting the A/B timeout means the window closed and the
+            # process is stuck in dead-tunnel init — the safe-to-kill probe
+            # case, NOT a running TPU job.
+            log("session: bench_bn A/B starting")
+            try:
+                r1 = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
+                    cwd=REPO, capture_output=True, text=True, timeout=AB_TIMEOUT_S,
+                )
+            except subprocess.TimeoutExpired:
+                log("bench_bn exceeded its window (closed mid-session?); will keep watching")
+                return False
+            log(f"bench_bn rc={r1.returncode}; stderr tail: {r1.stderr[-2000:]}")
+            if r1.returncode != 0 or not os.path.exists(ab_path):
+                log("A/B failed (window closed?); will keep watching")
+                return False
         log("session: headline bench.py starting")
         try:
+            # HEADLINE_TIMEOUT_S sits above bench.py's own worst case, so
+            # bench.py always exits on its own terms (its internal probe/
+            # deadline/fallback logic); this backstop firing would mean a
+            # hung supervisor, not a killed mid-run TPU worker
             r2 = subprocess.run(
                 [sys.executable, os.path.join(REPO, "bench.py")],
-                cwd=REPO, capture_output=True, text=True, timeout=2700,
+                cwd=REPO, capture_output=True, text=True, timeout=HEADLINE_TIMEOUT_S,
             )
         except subprocess.TimeoutExpired:
-            log("bench.py exceeded its window; A/B secured, will rewatch for the headline")
+            log("bench.py supervisor hung past its own worst case; will rewatch")
             return False
         log(f"bench rc={r2.returncode}; stdout: {r2.stdout[-1000:]}")
         # only a REAL TPU measurement counts as the headline artifact —
