@@ -45,6 +45,17 @@ def test_width_mult_rounding():
     # head width must not shrink below 1280 at width<1 (MBV2 convention)
     net = get_model(ModelConfig(arch="mobilenet_v2", width_mult=0.5))
     assert net.head.out_channels == 1280
+    # width>1 scales the head by default (1280*1.1 -> 1408)...
+    net = get_model(ModelConfig(arch="mobilenet_v2", width_mult=1.1))
+    assert net.head.out_channels == 1408
+    # ...but explicit channel overrides are EXACT final widths, exempt from
+    # scaling — the AtomNAS-C 1.1x-seed contract (apps/atomnas_c_se.yml)
+    net = get_model(ModelConfig(arch="mobilenet_v2", width_mult=1.1, stem_channels=32, head_channels=1280))
+    assert net.stem.out_channels == 32
+    assert net.head.out_channels == 1280
+    # an explicit 0 still means "no head layer" (classifier on block output)
+    net = get_model(ModelConfig(arch="mobilenet_v2", width_mult=1.1, head_channels=0))
+    assert net.head is None
 
 
 @pytest.mark.parametrize("arch", [

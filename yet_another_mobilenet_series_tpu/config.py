@@ -81,6 +81,8 @@ class ModelConfig:
     # result is trained/evaluated as a standalone model.
     network_spec: str = ""
     # Stem / head channel overrides (None = arch default).
+    # EXACT final widths when set — exempt from width_mult scaling
+    # (models/specs.py build_network); None = the arch default, scaled
     stem_channels: int | None = None
     head_channels: int | None = None
     feature_channels: int | None = None

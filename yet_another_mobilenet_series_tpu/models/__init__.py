@@ -30,19 +30,19 @@ def get_model(cfg: ModelConfig, image_size: int = 224) -> Network:
             )
         return _dc.replace(net, dropout=cfg.dropout, image_size=image_size)
     arch = get_arch(cfg.arch)
-    overrides = {}
-    if cfg.stem_channels is not None:
-        overrides["stem_channels"] = cfg.stem_channels
-    if cfg.head_channels is not None:
-        overrides["head_channels"] = cfg.head_channels
-    if cfg.feature_channels is not None:
-        overrides["feature_channels"] = cfg.feature_channels
     if cfg.active_fn is not None:
-        overrides.update(
-            stem_act=cfg.active_fn, head_act=cfg.active_fn, default_act=cfg.active_fn
+        arch = dataclasses.replace(
+            arch, stem_act=cfg.active_fn, head_act=cfg.active_fn, default_act=cfg.active_fn
         )
-    if overrides:
-        arch = dataclasses.replace(arch, **overrides)
+    # explicit channel overrides are EXACT final widths, exempt from
+    # width_mult scaling (build_network docstring)
+    exact = {}
+    if cfg.stem_channels is not None:
+        exact["stem"] = cfg.stem_channels
+    if cfg.head_channels is not None:
+        exact["head"] = cfg.head_channels
+    if cfg.feature_channels is not None:
+        exact["feature"] = cfg.feature_channels
     return build_network(
         arch,
         width_mult=cfg.width_mult,
@@ -52,4 +52,5 @@ def get_model(cfg: ModelConfig, image_size: int = 224) -> Network:
         bn_eps=cfg.bn_eps,
         image_size=image_size,
         block_specs_override=cfg.block_specs,
+        exact_channels=exact or None,
     )

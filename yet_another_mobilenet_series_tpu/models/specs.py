@@ -168,10 +168,16 @@ def build_network(
     bn_eps: float = 1e-5,
     image_size: int = 224,
     block_specs_override: Sequence[Mapping[str, Any]] | None = None,
+    exact_channels: Mapping[str, int] | None = None,
 ) -> Network:
+    """exact_channels pins {'stem','head','feature'} widths to FINAL values,
+    exempt from width_mult scaling — an explicit ``model.head_channels: 1280``
+    means 1280, not make_divisible(1280*width_mult) (the AtomNAS-C 1.1x seed
+    needs a widened prunable trunk under an unscaled, unprunable head)."""
     specs = tuple(block_specs_override) if block_specs_override is not None else arch.block_specs
+    exact = dict(exact_channels or {})
 
-    stem_ch = make_divisible(arch.stem_channels * width_mult)
+    stem_ch = exact["stem"] if "stem" in exact else make_divisible(arch.stem_channels * width_mult)
     stem = ConvBNAct(3, stem_ch, 3, 2, active_fn=arch.stem_act, bn_momentum=bn_momentum, bn_eps=bn_eps)
 
     blocks: list[InvertedResidual] = []
@@ -230,20 +236,32 @@ def build_network(
             )
             c_in = c
 
-    head = None
-    head_out = c_in
-    if arch.head_channels:
+    # membership (not truthiness) so an explicit override of 0 keeps the
+    # documented "0 = no head/feature layer" semantics
+    if "head" in exact:
+        head_ch = exact["head"]
+    elif arch.head_channels:
         hc = arch.head_channels
         scaled = make_divisible(hc * width_mult)
         head_ch = scaled if (arch.head_scales_down or width_mult > 1.0) else max(hc, scaled)
+    else:
+        head_ch = 0
+    head = None
+    head_out = c_in
+    if head_ch:
         head = ConvBNAct(c_in, head_ch, 1, 1, active_fn=arch.head_act, bn_momentum=bn_momentum, bn_eps=bn_eps)
         head_out = head_ch
 
-    feature = None
-    feat_out = head_out
-    if arch.feature_channels:
+    if "feature" in exact:
+        feat_ch = exact["feature"]
+    elif arch.feature_channels:
         fc = arch.feature_channels
         feat_ch = make_divisible(fc * width_mult) if width_mult > 1.0 else fc
+    else:
+        feat_ch = 0
+    feature = None
+    feat_out = head_out
+    if feat_ch:
         feature = Dense(head_out, feat_ch, use_bias=True)
         feat_out = feat_ch
 
