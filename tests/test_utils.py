@@ -1,0 +1,88 @@
+"""Direct unit tests for the small host-side utilities: meters (the
+AverageMeter/accuracy surface of the reference's utils/common.py,
+SURVEY.md §2 #13) and the pytree structure mapper shared by ZeRO and NAS
+rematerialization. Both were previously covered only through integration."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.utils import treeutil
+from yet_another_mobilenet_series_tpu.utils.meters import AverageMeter, MetricLogger, format_metrics
+
+
+def test_average_meter_weighted_and_reset():
+    m = AverageMeter()
+    assert m.avg == 0.0  # empty meter must not divide by zero
+    m.update(1.0, n=3)
+    m.update(5.0, n=1)
+    assert m.avg == pytest.approx((1.0 * 3 + 5.0) / 4)
+    m.reset()
+    assert m.count == 0 and m.sum == 0.0
+
+
+def test_metric_logger_averages_and_throughput():
+    log = MetricLogger()
+    # device arrays go in; floats come out only at snapshot (async-dispatch
+    # safety is the module's whole point — update() must not call float())
+    log.update({"loss": jnp.asarray(2.0), "top1": jnp.asarray(0.25)}, batch_images=64)
+    log.update({"loss": jnp.asarray(4.0), "top1": jnp.asarray(0.75)}, batch_images=64)
+    time.sleep(0.01)
+    out = log.snapshot_and_reset(num_chips=8)
+    assert out["loss"] == pytest.approx(3.0)
+    assert out["top1"] == pytest.approx(0.5)
+    assert out["images_per_sec"] > 0
+    assert out["images_per_sec_per_chip"] == pytest.approx(out["images_per_sec"] / 8)
+    # reset: a second snapshot has no carried-over state
+    out2 = log.snapshot_and_reset()
+    assert "loss" not in out2 and "images_per_sec" not in out2
+
+
+def test_metric_logger_no_images_no_throughput_keys():
+    log = MetricLogger()
+    log.update({"loss": jnp.asarray(1.0)})
+    out = log.snapshot_and_reset()
+    assert "images_per_sec" not in out
+
+
+def test_format_metrics_sorted_and_compact():
+    s = format_metrics("eval:", {"b": 2.0, "a": 0.123456})
+    assert s == "eval: a=0.1235 b=2"
+
+
+def test_map_params_shaped_finds_nested_trees():
+    """The ZeRO/remat contract: fn applies to every subtree structurally
+    equal to the params tree, wherever the optimizer composition nests it —
+    and to nothing else."""
+    import collections
+
+    params = {"a": jnp.zeros((3,)), "b": {"w": jnp.ones((2, 2))}}
+    pstruct = jax.tree.structure(params)
+    State = collections.namedtuple("State", ["mu", "nu", "count"])
+    opt_state = (
+        State(mu=params, nu=jax.tree.map(lambda x: x + 1, params), count=jnp.zeros(())),
+        {"inner": params, "scalar": 7},
+    )
+
+    tagged = treeutil.map_params_shaped(
+        opt_state, pstruct, lambda sub: jax.tree.map(lambda x: x + 100, sub)
+    )
+    # all three params-shaped subtrees transformed...
+    np.testing.assert_array_equal(np.asarray(tagged[0].mu["a"]), 100 * np.ones(3))
+    np.testing.assert_array_equal(np.asarray(tagged[0].nu["b"]["w"]), 102 * np.ones((2, 2)))
+    np.testing.assert_array_equal(np.asarray(tagged[1]["inner"]["a"]), 100 * np.ones(3))
+    # ...NamedTuple type and non-matching leaves preserved
+    assert type(tagged[0]).__name__ == "State"
+    assert float(tagged[0].count) == 0.0
+    assert tagged[1]["scalar"] == 7
+
+
+def test_map_params_shaped_identity_on_no_match():
+    params = {"a": jnp.zeros((3,))}
+    other = {"x": 1, "y": (2, 3)}
+    out = treeutil.map_params_shaped(other, jax.tree.structure(params), lambda s: "BOOM")
+    assert out == other

@@ -176,6 +176,8 @@ def build_network(
     needs a widened prunable trunk under an unscaled, unprunable head)."""
     specs = tuple(block_specs_override) if block_specs_override is not None else arch.block_specs
     exact = dict(exact_channels or {})
+    if unknown := set(exact) - {"stem", "head", "feature"}:
+        raise ValueError(f"unknown exact_channels key(s) {sorted(unknown)}; valid: stem, head, feature")
 
     stem_ch = exact["stem"] if "stem" in exact else make_divisible(arch.stem_channels * width_mult)
     stem = ConvBNAct(3, stem_ch, 3, 2, active_fn=arch.stem_act, bn_momentum=bn_momentum, bn_eps=bn_eps)
