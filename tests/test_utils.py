@@ -86,3 +86,23 @@ def test_map_params_shaped_identity_on_no_match():
     other = {"x": 1, "y": (2, 3)}
     out = treeutil.map_params_shaped(other, jax.tree.structure(params), lambda s: "BOOM")
     assert out == other
+
+
+def test_profile_cli_prints_totals_and_atom_table(capsys):
+    """The profiler CLI (reference: model_profiling's printed summary,
+    SURVEY.md §2 #10): totals for a plain arch; per-block atom-cost table
+    for a supernet (the AtomNAS penalty's weighting data)."""
+    from yet_another_mobilenet_series_tpu.cli import profile as cli_profile
+
+    cli_profile.main(["model.arch=mobilenet_v2", "data.image_size=64"])
+    out = capsys.readouterr().out
+    assert "mobilenet_v2 x1.0" in out
+    assert "total:" in out and "M MACs" in out and "M params" in out
+    assert "atom cost table" not in out  # single-kernel net: no atoms
+
+    cli_profile.main([
+        "model.arch=atomnas_supernet", "data.image_size=64", "model.num_classes=10",
+    ])
+    out = capsys.readouterr().out
+    assert "atom cost table" in out
+    assert "atoms=" in out
