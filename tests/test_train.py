@@ -272,6 +272,41 @@ def test_remat_policy_validated():
         steps.make_train_step(net, cfg, opt, lr_fn)
 
 
+@pytest.mark.slow
+def test_bn_variants_converge_identically():
+    """20 training steps under each bn_mode track the exact-mode loss
+    trajectory (single device, f32): per-step fp re-association (~1e-7)
+    must not compound into divergent optimization."""
+    batch = {
+        "image": jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3)),
+        "label": jnp.arange(8) % 4,
+    }
+    rng = jax.random.PRNGKey(42)
+    traces = {}
+    for mode in ("exact", "folded", "compute", "fused_vjp"):
+        cfg = _tiny_cfg(train={"compute_dtype": "float32", "bn_mode": mode})
+        net = get_model(cfg.model, image_size=16)
+        lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
+        params, _ = net.init(jax.random.PRNGKey(0))
+        opt = optim.make_optimizer(cfg.optim, lr_fn, params)
+        ts = steps.init_train_state(net, cfg, opt, jax.random.PRNGKey(0))
+        step_fn = jax.jit(steps.make_train_step(net, cfg, opt, lr_fn))
+        losses = []
+        for _ in range(20):
+            ts, metrics = step_fn(ts, batch, rng)
+            losses.append(float(metrics["loss"]))
+        traces[mode] = np.asarray(losses)
+    # early steps are near-identical; benign ~1e-7 re-association differences
+    # then compound chaotically through RMSProp's rsqrt (observed ~0.5% rel
+    # by step 20), so the late-trace bound is coarse — the guarantee is
+    # "same optimization", not bitwise trajectories
+    for mode in ("folded", "fused_vjp", "compute"):
+        np.testing.assert_allclose(traces[mode][:8], traces["exact"][:8], rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(traces[mode], traces["exact"], rtol=5e-2, atol=1e-3)
+    # and training actually progressed in every mode
+    assert all(t[-1] < t[0] * 0.9 for t in traces.values())
+
+
 def test_train_step_overfits_tiny_batch():
     cfg = _tiny_cfg()
     net = get_model(cfg.model, image_size=16)
