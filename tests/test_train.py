@@ -231,8 +231,14 @@ def _tiny_cfg(**over):
     return config_from_dict(d)
 
 
-@pytest.mark.parametrize("policy", ["full", "save_conv"])
-def test_remat_step_equals_plain_step(policy):
+@pytest.mark.parametrize("policy,bn_mode", [
+    ("full", "exact"),
+    ("save_conv", "exact"),
+    # the composed round-3 stack: custom-VJP BN recomputed under the
+    # save-conv checkpoint policy must still be a pure scheduling change
+    ("save_conv", "fused_vjp"),
+])
+def test_remat_step_equals_plain_step(policy, bn_mode):
     """train.remat (both policies) must be a pure memory/recompute trade:
     the updated params after one step are BIT-IDENTICAL to the non-remat
     step's on CPU f32 (jax.checkpoint changes scheduling, not math).
@@ -246,7 +252,7 @@ def test_remat_step_equals_plain_step(policy):
     rng = jax.random.PRNGKey(42)
     results = []
     for remat_over in ({}, {"remat": True, "remat_policy": policy}):
-        cfg = _tiny_cfg(train={"compute_dtype": "float32", **remat_over})
+        cfg = _tiny_cfg(train={"compute_dtype": "float32", "bn_mode": bn_mode, **remat_over})
         net = get_model(cfg.model, image_size=16)
         lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
         params, _ = net.init(jax.random.PRNGKey(0))
