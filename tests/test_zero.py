@@ -55,7 +55,15 @@ def _zero_state(net, cfg, opt, mesh):
 
 
 @pytest.mark.slow
-def test_zero_step_matches_replicated_update(setup):
+@pytest.mark.parametrize("bn_mode", ["exact", "fused_vjp"])
+def test_zero_step_matches_replicated_update(setup, bn_mode):
+    """ZeRO sharded update == replicated exact-mode step. The fused_vjp arm
+    is the acceptance-#5 composition and pins that the custom backward's
+    LOCAL dgamma/dbeta partials feed the psum_scatter correctly (a psum'd
+    custom backward would double-count by the mesh size); its tolerances
+    are looser since it also crosses BN formulations."""
+    import dataclasses as dc
+
     net, lr_fn, opt, mesh, batch = setup
     b = mesh_lib.shard_batch(batch, mesh)
 
@@ -63,14 +71,18 @@ def test_zero_step_matches_replicated_update(setup):
     rep_step = dp.make_dp_train_step(net, _cfg(False), opt, lr_fn, mesh)
     ts_rep, met_rep = rep_step(ts_rep, b, jax.random.PRNGKey(7))
 
-    ts_z = _zero_state(net, _cfg(True), opt, mesh)
-    z_step = dp.make_dp_train_step(net, _cfg(True), opt, lr_fn, mesh)
+    cfg_z = _cfg(True)
+    cfg_z = dc.replace(cfg_z, train=dc.replace(cfg_z.train, bn_mode=bn_mode))
+    ts_z = _zero_state(net, cfg_z, opt, mesh)
+    z_step = dp.make_dp_train_step(net, cfg_z, opt, lr_fn, mesh)
     ts_z, met_z = z_step(ts_z, b, jax.random.PRNGKey(7))
 
-    np.testing.assert_allclose(float(met_rep["loss"]), float(met_z["loss"]), rtol=1e-6)
+    same_bn = bn_mode == "exact"
+    np.testing.assert_allclose(float(met_rep["loss"]), float(met_z["loss"]), rtol=1e-6 if same_bn else 1e-5)
     np.testing.assert_allclose(float(met_rep["grad_norm"]), float(met_z["grad_norm"]), rtol=1e-4)
+    p_rtol, p_atol = (1e-4, 1e-6) if same_bn else (1e-3, 1e-5)
     for a, c in zip(jax.tree.leaves(ts_rep.params), jax.tree.leaves(ts_z.params)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=p_rtol, atol=p_atol)
 
 
 def test_zero_opt_state_is_sharded(setup):
