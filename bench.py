@@ -169,8 +169,6 @@ def _worker_body(force_cpu: bool):
         # regardless of JAX_PLATFORMS, so override the live config (same
         # trick as tests/conftest.py) before any backend is touched.
         jax.config.update("jax_platforms", "cpu")
-    from yet_another_mobilenet_series_tpu.config import ModelConfig
-    from yet_another_mobilenet_series_tpu.models import get_model
     from yet_another_mobilenet_series_tpu.utils.benchkit import build_train_fixture, sync
     from yet_another_mobilenet_series_tpu.utils.profiling import profile_network
 
@@ -186,14 +184,12 @@ def _worker_body(force_cpu: bool):
     batch = per_chip_batch * n_chips
     log(f"bench: {platform} ({device_kind}) x{n_chips}, global batch {batch}, image {image_size}")
 
-    total_macs = profile_network(get_model(ModelConfig(arch="mobilenet_v3_large", dropout=0.2), image_size), image_size).total_macs
-
     key = jax.random.PRNGKey(0)
     attempts = [(batch, False), (batch // 2, False), (batch // 2, True), (batch // 4, True)]
-    step_fn = ts = b = None
+    step_fn = ts = b = net = None
     for try_batch, remat in attempts:
         try:
-            step_fn, ts, b, _ = build_train_fixture(try_batch, image_size, remat=remat)
+            step_fn, ts, b, net = build_train_fixture(try_batch, image_size, remat=remat)
             t0 = time.perf_counter()
             ts, metrics = step_fn(ts, b, key)
             sync(metrics["loss"])
@@ -209,6 +205,8 @@ def _worker_body(force_cpu: bool):
             step_fn = ts = b = None
     if step_fn is None:
         raise RuntimeError("all batch-size fallbacks exhausted")
+    # profile the SAME spec the fixture built (single source for the arch)
+    total_macs = profile_network(net, image_size).total_macs
 
     # warmup
     for _ in range(3):

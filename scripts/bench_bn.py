@@ -77,6 +77,11 @@ def main():
             raise SystemExit(f"unknown trailing token(s) {extra!r} in --variants (only ':dot' is valid)")
         variants.append((mode, remat_s != "0", remat_s if remat_s == "save_conv" else "full", bool(extra)))
 
+    if args.out:
+        # writability must fail in milliseconds too, not after the first
+        # ~25-min variant ("a": never truncates a previous partial artifact)
+        open(args.out, "a").close()
+
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
@@ -93,6 +98,30 @@ def main():
 
     key = jax.random.PRNGKey(0)
     rows = []
+    def emit(partial: bool):
+        """Persist what's measured SO FAR: a mid-sweep tunnel crash must not
+        discard completed rows (the BENCH_PALLAS_r2 12-of-15 lesson)."""
+        base = next(
+            (r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off" and not r["conv1x1_dot"]),
+            None,
+        )
+        for r in rows:
+            if base:
+                r["vs_exact"] = round(base["ms_per_step"] / r["ms_per_step"], 3)
+        out = {
+            "bench": "bn_mode_train_step_ab", "platform": platform, "device_kind": kind,
+            "batch": args.batch, "image_size": args.image_size, "iters": args.iters,
+            "dtype": "bfloat16",
+            "variants_completed": len(rows), "variants_planned": len(variants),
+            "partial": partial,
+            "method": "chained train steps, device_get(loss) barrier (PROFILE.md methodology)",
+            "rows": rows,
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        return out
+
     for mode, remat, policy, dot in variants:
         step_fn, ts, b, _ = build_train_fixture(
             args.batch, args.image_size, remat=remat, remat_policy=policy, bn_mode=mode,
@@ -120,27 +149,12 @@ def main():
         })
         log(f"  bn_mode={mode:<8} remat={remat_label:<9} dot={int(dot)}: {dt*1e3:8.2f} ms/step, "
             f"{img_s:8.0f} img/s, loss {loss:.4f} (compile {compile_s:.0f}s)")
+        if len(rows) < len(variants):
+            emit(partial=True)
         # free the variant's buffers before building the next one
         step_fn = ts = b = None
 
-    base = next(
-        (r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off" and not r["conv1x1_dot"]),
-        None,
-    )
-    for r in rows:
-        if base:
-            r["vs_exact"] = round(base["ms_per_step"] / r["ms_per_step"], 3)
-    out = {
-        "bench": "bn_mode_train_step_ab", "platform": platform, "device_kind": kind,
-        "batch": args.batch, "image_size": args.image_size, "iters": args.iters,
-        "dtype": "bfloat16",
-        "method": "chained train steps, device_get(loss) barrier (PROFILE.md methodology)",
-        "rows": rows,
-    }
-    print(json.dumps(out), flush=True)
-    if args.out:
-        with open(args.out, "w") as f:
-            json.dump(out, f, indent=1)
+    print(json.dumps(emit(partial=False)), flush=True)
 
 
 if __name__ == "__main__":

@@ -14,7 +14,8 @@ tunnel for the rest of the session):
 
 Before starting a session it waits for any running pytest to finish (this
 sandbox has ONE visible core; concurrent CPU load corrupts TPU timings).
-A deadline stops NEW probe/session attempts so nothing is mid-flight when
+Probes continue until the deadline; a SESSION only starts if its full
+worst-case budget fits before the deadline, so nothing is mid-flight when
 the round's driver wants the chip.
 
 Usage: python scripts/tpu_watch_r3.py [--deadline-min 240] [--interval 60]
@@ -31,20 +32,23 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-SENTINEL = "/tmp/TPU_SESSION_ACTIVE"
+
+sys.path.insert(0, REPO)
+from bench import PROBE_TIMEOUT_S, run_probe  # noqa: E402  (the canonical probe: alive/failed/timeout trichotomy)
+
 # Worst-case wall clock of one session attempt: quiet-CPU wait (capped
-# below) + re-probe + A/B timeout + headline timeout. No session starts
-# unless this budget fits entirely before the deadline, so nothing is
-# mid-flight when the round's driver wants the chip.
+# below) + re-probe + A/B timeout + headline timeout. PROBES keep running
+# until the deadline (cheap, kill-safe); only a SESSION start is gated on
+# this budget fitting before the deadline, so nothing is mid-flight when
+# the round's driver wants the chip.
 QUIET_WAIT_S = 1200
 AB_TIMEOUT_S = 3000       # alive-tunnel A/B is ~20 min; 50 min => window died
 HEADLINE_TIMEOUT_S = 6000  # above bench.py's own worst case (~4950 s): it
                            # self-bounds via probe/deadline/fallback, so this
                            # backstop should never fire on a live supervisor
-SESSION_BUDGET_S = QUIET_WAIT_S + 150 + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
+SESSION_BUDGET_S = QUIET_WAIT_S + PROBE_TIMEOUT_S + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
 
-sys.path.insert(0, REPO)
-from bench import run_probe  # noqa: E402  (the canonical probe: 150s kill, alive/failed/timeout trichotomy)
+START_TIME = time.time()
 
 
 def log(msg):
@@ -71,74 +75,84 @@ def wait_for_quiet_cpu(max_wait_s=QUIET_WAIT_S):
     log("quiet-CPU wait expired; proceeding anyway")
 
 
+def _fresh_complete_ab(path: str) -> bool:
+    if not (os.path.exists(path) and os.path.getmtime(path) >= START_TIME):
+        return False
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return d.get("partial") is False and d.get("platform") == "tpu"
+
+
 def run_session() -> bool:
     """Returns True only if the round's A/B artifact was actually produced —
     a False lets the caller keep watching for the next alive window."""
     ab_path = os.path.join(REPO, "BENCH_BN_r3.json")
-    open(SENTINEL, "w").write(str(time.time()))
-    try:
-        # a previous partial session may have secured the A/B already —
-        # don't spend a fresh (possibly short) alive window redoing it
-        if os.path.exists(ab_path):
-            log("A/B artifact already present; skipping straight to headline")
-        else:
-            # hitting the A/B timeout means the window closed and the
-            # process is stuck in dead-tunnel init — the safe-to-kill probe
-            # case, NOT a running TPU job.
-            log("session: bench_bn A/B starting")
-            try:
-                r1 = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
-                    cwd=REPO, capture_output=True, text=True, timeout=AB_TIMEOUT_S,
-                )
-            except subprocess.TimeoutExpired:
-                log("bench_bn exceeded its window (closed mid-session?); will keep watching")
-                return False
-            log(f"bench_bn rc={r1.returncode}; stderr tail: {r1.stderr[-2000:]}")
-            if r1.returncode != 0 or not os.path.exists(ab_path):
-                log("A/B failed (window closed?); will keep watching")
-                return False
-        log("session: headline bench.py starting")
+    # a previous session THIS RUN may have secured the A/B — don't spend a
+    # fresh (possibly short) alive window redoing it. A pre-existing (stale)
+    # artifact from older code must NOT suppress measurement (hence the
+    # created-after-watcher-start check), and neither may a PARTIAL one
+    # from a mid-sweep crash (bench_bn writes incrementally).
+    if _fresh_complete_ab(ab_path):
+        log("fresh complete A/B artifact already present; skipping straight to headline")
+    else:
+        # hitting the A/B timeout means the window closed and the process is
+        # stuck in dead-tunnel init — the safe-to-kill probe case, NOT a
+        # running TPU job.
+        log("session: bench_bn A/B starting")
         try:
-            # HEADLINE_TIMEOUT_S sits above bench.py's own worst case, so
-            # bench.py always exits on its own terms (its internal probe/
-            # deadline/fallback logic); this backstop firing would mean a
-            # hung supervisor, not a killed mid-run TPU worker
-            r2 = subprocess.run(
-                [sys.executable, os.path.join(REPO, "bench.py")],
-                cwd=REPO, capture_output=True, text=True, timeout=HEADLINE_TIMEOUT_S,
+            r1 = subprocess.run(
+                [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
+                cwd=REPO, capture_output=True, text=True, timeout=AB_TIMEOUT_S,
             )
         except subprocess.TimeoutExpired:
-            log("bench.py supervisor hung past its own worst case; will rewatch")
+            log("bench_bn exceeded its window (closed mid-session?); will keep watching")
             return False
-        log(f"bench rc={r2.returncode}; stdout: {r2.stdout[-1000:]}")
-        # only a REAL TPU measurement counts as the headline artifact —
-        # bench.py prints structured error/fallback JSON on failure too,
-        # and recording that would end the watch with a corrupt headline
-        headline = None
-        for line in reversed(r2.stdout.strip().splitlines()):
-            try:
-                cand = json.loads(line)
-                if isinstance(cand, dict) and "metric" in cand:
-                    headline = cand
-                    break
-            except json.JSONDecodeError:
-                continue
-        ok = (
-            r2.returncode == 0 and headline is not None
-            and headline.get("value") is not None and headline.get("platform") == "tpu"
+        log(f"bench_bn rc={r1.returncode}; stderr tail: {r1.stderr[-2000:]}")
+        # same artifact contract as the skip path: fresh + complete + TPU
+        if r1.returncode != 0 or not _fresh_complete_ab(ab_path):
+            log("A/B failed or incomplete (window closed?); will keep watching")
+            return False
+    log("session: headline bench.py starting")
+    try:
+        # HEADLINE_TIMEOUT_S sits above bench.py's own worst case, so
+        # bench.py always exits on its own terms (its internal probe/
+        # deadline/fallback logic); this backstop firing would mean a hung
+        # supervisor, not a killed mid-run TPU worker
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=HEADLINE_TIMEOUT_S,
         )
-        if ok:
-            with open(os.path.join(REPO, "BENCH_TPU_r3.json"), "w") as f:
-                json.dump(headline, f)
-                f.write("\n")
-            log("session complete")
-        else:
-            log("headline run produced no TPU measurement; will rewatch")
-        return ok
-    finally:
-        if os.path.exists(SENTINEL):
-            os.unlink(SENTINEL)
+    except subprocess.TimeoutExpired:
+        log("bench.py supervisor hung past its own worst case; will rewatch")
+        return False
+    log(f"bench rc={r2.returncode}; stdout: {r2.stdout[-1000:]}")
+    # only a REAL TPU measurement counts as the headline artifact —
+    # bench.py prints structured error/fallback JSON on failure too, and
+    # recording that would end the watch with a corrupt headline
+    headline = None
+    for line in reversed(r2.stdout.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "metric" in cand:
+                headline = cand
+                break
+        except json.JSONDecodeError:
+            continue
+    ok = (
+        r2.returncode == 0 and headline is not None
+        and headline.get("value") is not None and headline.get("platform") == "tpu"
+    )
+    if ok:
+        with open(os.path.join(REPO, "BENCH_TPU_r3.json"), "w") as f:
+            json.dump(headline, f)
+            f.write("\n")
+        log("session complete")
+    else:
+        log("headline run produced no TPU measurement; will rewatch")
+    return ok
 
 
 def main():
@@ -149,12 +163,18 @@ def main():
     args = ap.parse_args()
     t_end = time.monotonic() + args.deadline_min * 60
     n = 0
-    # a session found at the deadline's edge would occupy the chip long past
-    # it — stop probing once a full session can no longer fit
-    while time.monotonic() + SESSION_BUDGET_S < t_end:
+    # probes run until the deadline (cheap, kill-safe); only a SESSION start
+    # is gated on the full budget fitting before t_end, so a late-found
+    # window is still logged even when there is no time left to use it
+    # even a PROBE must fully fit before the deadline: a mid-flight probe at
+    # t_end would contend with the round driver's own bench on the tunnel
+    while time.monotonic() + PROBE_TIMEOUT_S < t_end:
         n += 1
         log(f"probe #{n}")
         if probe_alive():
+            if time.monotonic() + SESSION_BUDGET_S >= t_end:
+                log("ALIVE WINDOW FOUND but no time left for a full session before the deadline; exiting")
+                return
             wait_for_quiet_cpu()
             # the quiet-CPU wait can outlive an alive window: re-confirm
             # before burning a ~25-min dead-tunnel init inside the session
@@ -164,7 +184,7 @@ def main():
             continue
         log("dead; sleeping")
         time.sleep(args.interval)
-    log("deadline reached without an alive window (or remaining time < one session)")
+    log("deadline reached without an alive window")
 
 
 if __name__ == "__main__":
