@@ -27,14 +27,16 @@ import torch.nn.functional as F
 from PIL import Image
 
 from yet_another_mobilenet_series_tpu.cli import train as cli_train
-from yet_another_mobilenet_series_tpu.config import ModelConfig, config_from_dict
+from yet_another_mobilenet_series_tpu.config import DataConfig, ModelConfig, config_from_dict
 from yet_another_mobilenet_series_tpu.models import get_model
 
 from test_torch_import import TorchTinyMBV2
 
 N_IMAGES = 200
-MEAN = (0.485, 0.456, 0.406)
-STD = (0.229, 0.224, 0.225)
+# the SAME normalization the eval pipelines read from config — hardcoded
+# copies here would silently diverge if the defaults ever changed
+MEAN = tuple(DataConfig().mean)
+STD = tuple(DataConfig().std)
 
 pytestmark = pytest.mark.slow
 

@@ -20,6 +20,7 @@ from jax import lax
 
 from ..config import Config
 from ..models.specs import Network
+from ..ops.layers import BN_MODES
 from .ema import ema_update
 from .losses import cross_entropy_label_smooth, topk_correct
 
@@ -76,8 +77,6 @@ def _dtype(name: str):
 
 def _check_bn_mode(cfg: Config):
     """Fail at step-build time, not first-trace time deep inside jit."""
-    from ..ops.layers import BN_MODES
-
     if cfg.train.bn_mode not in BN_MODES:
         raise ValueError(f"unknown train.bn_mode {cfg.train.bn_mode!r} (valid: {BN_MODES})")
 
