@@ -39,8 +39,10 @@ well inside the kill window.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -75,6 +77,91 @@ BACKOFF_S = (5, 20)  # sleeps between the RETRIES attempts (len == RETRIES - 1)
 # stop launching TPU attempts past this point so the CPU fallback always gets
 # to run (only reachable when the probe said alive but workers still fail)
 TPU_DEADLINE_S = 2400
+
+REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+# Measured-winner config (written by scripts/tpu_watch.py's decision step
+# after a BENCH_BN A/B applies PROFILE.md's >3% rule). `python bench.py`
+# must pick the tuned variant up with no extra flags so the driver's
+# end-of-round artifact reflects the repo's best-known configuration.
+TUNING_PATH = os.path.join(REPO_DIR, "BENCH_TUNING.json")
+_TUNING_KEYS = {"bn_mode", "remat", "remat_policy", "conv1x1_dot"}
+
+
+def load_tuning() -> dict:
+    """Best-measured step config, or {} (the exact/no-remat parity baseline).
+    A malformed tuning file must never take the headline bench down — it is
+    an aux artifact; fall back to the baseline and say so on stderr. Every
+    value is validated here (not just parsed): an invalid bn_mode would
+    otherwise raise in EVERY ladder rung of both the TPU worker and the CPU
+    fallback, shipping a value=null headline artifact. Worker-side only
+    (imports the package, hence jax)."""
+    from yet_another_mobilenet_series_tpu.ops.layers import BN_MODES
+
+    try:
+        with open(TUNING_PATH) as f:
+            raw = json.load(f)
+        tuning = {k: raw[k] for k in _TUNING_KEYS if k in raw}
+        if not tuning:
+            # a file with no tuning keys is the baseline, not a winner —
+            # returning a truthy dict here would stamp a bogus tuning_source
+            return {}
+        if tuning.get("bn_mode", "exact") not in BN_MODES:
+            raise ValueError(f"bn_mode must be one of {BN_MODES}")
+        if tuning.get("remat_policy", "full") not in ("full", "save_conv"):
+            raise ValueError("remat_policy must be 'full' or 'save_conv'")
+        if not isinstance(tuning.get("remat", False), bool):
+            raise ValueError("remat must be a bool")
+        if not isinstance(tuning.get("conv1x1_dot", False), bool):
+            raise ValueError("conv1x1_dot must be a bool")
+        tuning["source"] = raw.get("source")
+        return tuning
+    except FileNotFoundError:
+        return {}
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        log(f"tuning: ignoring malformed {TUNING_PATH}: {e}")
+        return {}
+
+
+def latest_tpu_artifact() -> dict | None:
+    """Newest BENCH_TPU_r*.json (highest round number) as a provenance block,
+    so a dead-tunnel fallback artifact still carries the repo's best-known
+    real-hardware measurement (VERDICT r3 #3)."""
+    best = None
+    for path in glob.glob(os.path.join(REPO_DIR, "BENCH_TPU_r*.json")):
+        m = re.search(r"BENCH_TPU_r(\d+)\.json$", path)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        if best is not None and rnd <= best[0]:
+            continue
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(d, dict) and d.get("value") and d.get("platform") == "tpu":
+            best = (rnd, path, d)
+    if best is None:
+        return None
+    rnd, path, d = best
+    # measured_utc is stamped into the artifact at write time (see
+    # _worker_body); file mtime is only a last resort — for a git-tracked
+    # artifact it is checkout time, not measurement time, so label it.
+    if d.get("measured_utc"):
+        date, date_source = d["measured_utc"][:10], "artifact"
+    else:
+        date = time.strftime("%Y-%m-%d", time.gmtime(os.path.getmtime(path)))
+        date_source = "file_mtime (checkout-time lower bound, not measurement time)"
+    return {
+        "value": d["value"],
+        "unit": d.get("unit"),
+        "ms_per_step": d.get("ms_per_step"),
+        "mfu": d.get("mfu"),
+        "device_kind": d.get("device_kind"),
+        "source": os.path.basename(path),
+        "measured_date": date,
+        "date_source": date_source,
+    }
 
 
 def log(msg: str):
@@ -184,17 +271,37 @@ def _worker_body(force_cpu: bool):
     batch = per_chip_batch * n_chips
     log(f"bench: {platform} ({device_kind}) x{n_chips}, global batch {batch}, image {image_size}")
 
+    tuning = load_tuning()
+    if tuning:
+        log(f"bench: measured-winner tuning from {TUNING_PATH}: {tuning}")
+    bn_mode = tuning.get("bn_mode", "exact")
+    conv1x1_dot = bool(tuning.get("conv1x1_dot", False))
+    remat_policy = tuning.get("remat_policy", "full")
+    base_remat = bool(tuning.get("remat", False))
+
     key = jax.random.PRNGKey(0)
-    attempts = [(batch, False), (batch // 2, False), (batch // 2, True), (batch // 4, True)]
+    # OOM ladder: first shrink batch under the tuned config, then fall back
+    # to full remat (the most memory-conservative policy — a tuned
+    # save_conv keeps activations the last-resort rung must not), deduped
+    # so a tuned remat=True doesn't recompile an identical rung.
+    attempts = []
+    for cand in [(batch, base_remat, remat_policy), (batch // 2, base_remat, remat_policy),
+                 (batch // 2, True, "full"), (batch // 4, True, "full")]:
+        if cand not in attempts:
+            attempts.append(cand)
     step_fn = ts = b = net = None
-    for try_batch, remat in attempts:
+    used_remat, used_policy = base_remat, remat_policy
+    for try_batch, remat, policy in attempts:
         try:
-            step_fn, ts, b, net = build_train_fixture(try_batch, image_size, remat=remat)
+            step_fn, ts, b, net = build_train_fixture(
+                try_batch, image_size, remat=remat, remat_policy=policy,
+                bn_mode=bn_mode, conv1x1_dot=conv1x1_dot)
             t0 = time.perf_counter()
             ts, metrics = step_fn(ts, b, key)
             sync(metrics["loss"])
             batch = try_batch
-            log(f"batch {batch} remat={remat}: compile+first step {time.perf_counter()-t0:.1f}s")
+            used_remat, used_policy = remat, policy
+            log(f"batch {batch} remat={remat}/{policy}: compile+first step {time.perf_counter()-t0:.1f}s")
             break
         except Exception as e:  # XlaRuntimeError RESOURCE_EXHAUSTED etc.
             if "RESOURCE_EXHAUSTED" not in str(e) and "Out of memory" not in str(e):
@@ -246,6 +353,14 @@ def _worker_body(force_cpu: bool):
         "mfu": mfu,
         "mfu_formula": "6*fwd_macs*img_s_chip/peak_bf16_flops (train fwd+bwd)",
         "mfu_fwd_only": mfu_fwd,
+        "step_config": {
+            # used_*, not the tuned request: the OOM ladder may have turned
+            # remat on / forced policy to full, and the artifact must
+            # describe what actually ran
+            "bn_mode": bn_mode, "remat": used_remat, "remat_policy": used_policy,
+            "conv1x1_dot": conv1x1_dot, "tuning_source": tuning.get("source"),
+        },
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }))
 
 
@@ -350,6 +465,9 @@ def main():
 
 def emit_cpu_fallback(tpu_err: str):
     log(f"TPU measurement unavailable ({tpu_err}); falling back to CPU smoke measurement")
+    # the fallback artifact must never under-report what the repo knows
+    # (VERDICT r3 #3): carry the newest real-TPU measurement with provenance
+    last_tpu = latest_tpu_artifact()
     try:
         result = run_worker(force_cpu=True)
     except WorkerTimeout:
@@ -357,6 +475,7 @@ def emit_cpu_fallback(tpu_err: str):
     if result is not None and result.get("value") is not None:
         result["fallback_from"] = "tpu"
         result["tpu_error"] = tpu_err[:500]
+        result["last_tpu"] = last_tpu
         print(json.dumps(result))
         return
 
@@ -368,6 +487,7 @@ def emit_cpu_fallback(tpu_err: str):
         "vs_baseline_note": VS_BASELINE_NOTE,
         "platform": None,
         "error": f"{tpu_err}; cpu fallback also failed",
+        "last_tpu": last_tpu,
     }))
 
 

@@ -1,0 +1,299 @@
+"""Standing TPU session watcher (round-agnostic): poll the tunnel; on the
+first alive window, run the round's queued hardware measurements unattended
+and APPLY the written decision rule, so one alive window settles everything
+without a human in the loop.
+
+Generalizes the round-3 watcher (VERDICT r3 weak #3: artifact names and
+deadline were hardcoded). The axon tunnel dies for whole rounds (~25 min
+UNAVAILABLE per probe; PROFILE.md) but alive windows appear without warning
+(round 2 got one). The watcher probes via ``bench.py --probe`` (150 s kill
+separates alive from dead) and, when the backend comes up, runs sequentially,
+ONE job at a time (never killing a started TPU process — a killed job can
+wedge the tunnel for the rest of the session):
+
+  1. scripts/bench_bn.py --out BENCH_BN_r{N}.json     (the standing A/B)
+  2. decision step (this process, no JAX): apply PROFILE.md's >3% rule to
+     the A/B rows and write BENCH_TUNING.json so every later `python
+     bench.py` — including the round driver's — measures the winner.
+     Decision recorded in BENCH_DECISION_r{N}.json either way.
+  3. python bench.py > BENCH_TPU_r{N}.json             (headline metric,
+     now under the tuned config)
+  4. (--with-sweep) scripts/bench_bn.py --xla-flags-sweep
+     --out BENCH_XLA_r{N}.json                          (flag sweep over the
+     winning variant, VERDICT r3 #7)
+
+Before starting a session it waits for any running pytest to finish (this
+sandbox has ONE visible core; concurrent CPU load corrupts TPU timings).
+Probes continue until the deadline; a SESSION only starts if its full
+worst-case budget fits before the deadline, so nothing is mid-flight when
+the round's driver wants the chip.
+
+Usage: python scripts/tpu_watch.py --round 4 [--deadline-min 600]
+       [--interval 60] [--allow-compute] [--with-sweep]
+Log: stderr (redirect to a file; tail it for status).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, REPO)
+from bench import PROBE_TIMEOUT_S, TUNING_PATH, run_probe  # noqa: E402  (the canonical probe: alive/failed/timeout trichotomy)
+
+# Worst-case wall clock of one session attempt: quiet-CPU wait (capped
+# below) + re-probe + A/B timeout + headline timeout (+ sweep timeout when
+# enabled). PROBES keep running until the deadline (cheap, kill-safe); only
+# a SESSION start is gated on this budget fitting before the deadline, so
+# nothing is mid-flight when the round's driver wants the chip.
+QUIET_WAIT_S = 1200
+AB_TIMEOUT_S = 3000       # alive-tunnel A/B is ~20 min; 50 min => window died
+HEADLINE_TIMEOUT_S = 6000  # above bench.py's own worst case (~4950 s): it
+                           # self-bounds via probe/deadline/fallback, so this
+                           # backstop should never fire on a live supervisor
+SWEEP_TIMEOUT_S = 3600    # flag sweep re-times one variant per flag set
+
+# PROFILE.md "Round 3" decision rule: a parity-safe variant must beat the
+# exact/no-remat/no-dot baseline by >3% to become the bench default.
+WIN_THRESHOLD = 1.03
+PARITY_SAFE_MODES = ("exact", "folded", "fused_vjp")  # bit-level-equivalent
+# `compute` (bf16 FMA) needs the top-1-parity argument before defaulting —
+# tests/test_acceptance_mbv2.py's bn_mode prediction-agreement test supplies
+# it; pass --allow-compute once that test is green on the round's tree.
+LOSS_SANITY_ABS = 0.02    # same data/key => losses near-identical across variants
+
+START_TIME = time.time()
+
+
+def log(msg):
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def probe_alive() -> bool:
+    status, info = run_probe()
+    if status == "alive" and info.get("platform") == "tpu":
+        log(f"ALIVE: {info}")
+        return True
+    log(f"probe status: {status}")
+    return False
+
+
+def wait_for_quiet_cpu(max_wait_s=QUIET_WAIT_S):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < max_wait_s:
+        r = subprocess.run(["pgrep", "-f", "pytest"], capture_output=True)
+        if r.returncode != 0:
+            return
+        log("pytest running; delaying TPU session for quiet CPU")
+        time.sleep(60)
+    log("quiet-CPU wait expired; proceeding anyway")
+
+
+def _fresh_complete_ab(path: str) -> bool:
+    if not (os.path.exists(path) and os.path.getmtime(path) >= START_TIME):
+        return False
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+    return d.get("partial") is False and d.get("platform") == "tpu"
+
+
+def _drop_stale_tuning(why: str):
+    try:
+        os.remove(TUNING_PATH)
+        log(f"decision: {why}; removed stale {os.path.basename(TUNING_PATH)}")
+    except FileNotFoundError:
+        log(f"decision: {why}; defaults unchanged")
+
+
+def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
+    """Apply the >3% rule to a completed A/B and persist the outcome.
+
+    Writes BENCH_TUNING.json (consumed by bench.py's worker) only on a win;
+    always writes the decision record so a no-move result is a documented
+    negative, not silence. Pure host-side JSON work — safe to re-run."""
+    with open(ab_path) as f:
+        ab = json.load(f)
+    rows = ab.get("rows", [])
+    base = next((r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off"
+                 and not r["conv1x1_dot"]), None)
+    decision = {
+        "rule": f"PROFILE.md round-3: >{(WIN_THRESHOLD-1)*100:.0f}% over exact/no-remat baseline; "
+                f"parity-safe modes {PARITY_SAFE_MODES}"
+                + (" + compute (parity test green)" if allow_compute else ""),
+        "ab_source": os.path.basename(ab_path),
+        "baseline": base,
+        "winner": None,
+        "adopted": False,
+    }
+    if base is not None:
+        eligible_modes = PARITY_SAFE_MODES + (("compute",) if allow_compute else ())
+        best, best_speedup = None, WIN_THRESHOLD
+        for r in rows:
+            if r["bn_mode"] not in eligible_modes:
+                continue
+            if abs(r["loss"] - base["loss"]) > LOSS_SANITY_ABS:
+                log(f"decision: skipping {r['bn_mode']}/{r['remat']}/dot={r['conv1x1_dot']}: "
+                    f"loss {r['loss']} vs baseline {base['loss']} fails sanity")
+                continue
+            speedup = base["ms_per_step"] / r["ms_per_step"]
+            if speedup > best_speedup:
+                best, best_speedup = r, speedup
+        if best is not None:
+            decision["winner"] = dict(best, speedup_vs_exact=round(best_speedup, 4))
+            decision["adopted"] = True
+            tuning = {
+                "bn_mode": best["bn_mode"],
+                "remat": best["remat"] != "off",
+                "remat_policy": best["remat"] if best["remat"] == "save_conv" else "full",
+                "conv1x1_dot": bool(best["conv1x1_dot"]),
+                "source": f"{os.path.basename(ab_path)} ({best_speedup:.3f}x vs exact, "
+                          f"{ab.get('device_kind')})",
+            }
+            with open(TUNING_PATH, "w") as f:
+                json.dump(tuning, f, indent=1)
+                f.write("\n")
+            log(f"decision: ADOPTED {tuning}")
+        else:
+            # a stale winner from an earlier round must not keep steering
+            # bench.py after THIS A/B declined to adopt anything — the
+            # decision record and the measured config would contradict
+            _drop_stale_tuning("no variant beat the threshold (negative result recorded)")
+    else:
+        _drop_stale_tuning("A/B has no baseline row")
+    with open(decision_path, "w") as f:
+        json.dump(decision, f, indent=1)
+        f.write("\n")
+
+
+def _run_job(cmd: list[str], timeout_s: int, label: str):
+    """Run one TPU job to its own completion (timeout only catches a window
+    that died mid-job, leaving the process stuck in dead-tunnel init — the
+    safe-to-kill case, NOT a running TPU computation)."""
+    log(f"session: {label} starting")
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"{label} exceeded its window (closed mid-session?); will keep watching")
+        return None
+    # stdout tail too: when a window's headline emits a fallback/value=null
+    # JSON, that line is the only post-mortem of the burned window
+    log(f"{label} rc={r.returncode}; stdout tail: {r.stdout[-1000:]}; "
+        f"stderr tail: {r.stderr[-2000:]}")
+    return r
+
+
+def run_session(args) -> bool:
+    """Returns True only if the round's A/B + headline artifacts were actually
+    produced — a False lets the caller keep watching for the next window."""
+    ab_path = os.path.join(REPO, f"BENCH_BN_r{args.round}.json")
+    decision_path = os.path.join(REPO, f"BENCH_DECISION_r{args.round}.json")
+    # a previous session THIS RUN may have secured the A/B — don't spend a
+    # fresh (possibly short) alive window redoing it. A pre-existing (stale)
+    # artifact from older code must NOT suppress measurement (hence the
+    # created-after-watcher-start check), and neither may a PARTIAL one
+    # from a mid-sweep crash (bench_bn writes incrementally).
+    if _fresh_complete_ab(ab_path):
+        log("fresh complete A/B artifact already present; skipping straight to decision")
+    else:
+        r1 = _run_job(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
+            AB_TIMEOUT_S, "bench_bn A/B")
+        if r1 is None or r1.returncode != 0 or not _fresh_complete_ab(ab_path):
+            log("A/B failed or incomplete (window closed?); will keep watching")
+            return False
+    try:
+        decide(ab_path, decision_path, args.allow_compute)
+    except Exception as e:  # a decision bug must not cost the alive window
+        log(f"decision step failed ({type(e).__name__}: {e}); headline runs on current defaults")
+
+    r2 = _run_job([sys.executable, os.path.join(REPO, "bench.py")],
+                  HEADLINE_TIMEOUT_S, "headline bench.py")
+    if r2 is None:
+        return False
+    # only a REAL TPU measurement counts as the headline artifact —
+    # bench.py prints structured error/fallback JSON on failure too, and
+    # recording that would end the watch with a corrupt headline
+    headline = None
+    for line in reversed(r2.stdout.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "metric" in cand:
+                headline = cand
+                break
+        except json.JSONDecodeError:
+            continue
+    ok = (
+        r2.returncode == 0 and headline is not None
+        and headline.get("value") is not None and headline.get("platform") == "tpu"
+    )
+    if not ok:
+        log("headline run produced no TPU measurement; will rewatch")
+        return False
+    with open(os.path.join(REPO, f"BENCH_TPU_r{args.round}.json"), "w") as f:
+        json.dump(headline, f)
+        f.write("\n")
+    log(f"headline secured: {headline.get('value')} img/s/chip")
+
+    if args.with_sweep:
+        sweep_path = os.path.join(REPO, f"BENCH_XLA_r{args.round}.json")
+        _run_job(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
+             "--xla-flags-sweep", "--out", sweep_path],
+            SWEEP_TIMEOUT_S, "xla flag sweep")
+        # sweep is best-effort: A/B + headline already make the session a win
+    log("session complete")
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, required=True,
+                    help="round number N for BENCH_*_r{N}.json artifact names")
+    ap.add_argument("--deadline-min", type=float, default=240.0,
+                    help="stop starting new probes/sessions after this many minutes")
+    ap.add_argument("--interval", type=float, default=60.0, help="sleep between dead probes")
+    ap.add_argument("--allow-compute", action="store_true",
+                    help="let the decision rule adopt bn_mode=compute (requires the "
+                         "bn_mode prediction-agreement test to be green on this tree)")
+    ap.add_argument("--with-sweep", action="store_true",
+                    help="after a secured headline, run the XLA flag sweep too")
+    args = ap.parse_args()
+    session_budget = (QUIET_WAIT_S + PROBE_TIMEOUT_S + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
+                      + (SWEEP_TIMEOUT_S if args.with_sweep else 0))
+    t_end = time.monotonic() + args.deadline_min * 60
+    n = 0
+    # probes run until the deadline (cheap, kill-safe); only a SESSION start
+    # is gated on the full budget fitting before t_end, so a late-found
+    # window is still logged even when there is no time left to use it.
+    # even a PROBE must fully fit before the deadline: a mid-flight probe at
+    # t_end would contend with the round driver's own bench on the tunnel
+    while time.monotonic() + PROBE_TIMEOUT_S < t_end:
+        n += 1
+        log(f"probe #{n}")
+        if probe_alive():
+            if time.monotonic() + session_budget >= t_end:
+                log("ALIVE WINDOW FOUND but no time left for a full session before the deadline; exiting")
+                return
+            wait_for_quiet_cpu()
+            # the quiet-CPU wait can outlive an alive window: re-confirm
+            # before burning a ~25-min dead-tunnel init inside the session
+            if probe_alive() and run_session(args):
+                return
+            log("window closed or session failed; resuming watch")
+            continue
+        log("dead; sleeping")
+        time.sleep(args.interval)
+    log("deadline reached without an alive window")
+
+
+if __name__ == "__main__":
+    main()

@@ -52,3 +52,11 @@ def test_bench_dead_tunnel_emits_parsed_cpu_fallback():
     # failure all must surface a non-empty diagnostic
     assert out["tpu_error"]
     assert out["platform"] == "cpu"
+    # the fallback must carry the repo's best-known real-TPU number with
+    # provenance (VERDICT r3 #3) — BENCH_TPU_r2.json ships in-repo, so
+    # last_tpu can never legitimately be absent
+    # contract, not magnitude: a newer (possibly smaller-batch) round
+    # artifact becoming the glob winner must not fail this test
+    last = out["last_tpu"]
+    assert last["value"] > 0 and last["device_kind"]
+    assert last["source"].startswith("BENCH_TPU_r") and last["measured_date"]
