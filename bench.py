@@ -87,6 +87,53 @@ TUNING_PATH = os.path.join(REPO_DIR, "BENCH_TUNING.json")
 _TUNING_KEYS = {"bn_mode", "remat", "remat_policy", "conv1x1_dot"}
 
 
+def partition_flags(flags_str: str) -> tuple[str, str]:
+    """Split a flag string into (XLA_FLAGS part, LIBTPU_INIT_ARGS part).
+
+    In this sandbox the host XLA build does not know the `--xla_tpu_*`
+    options (fatal 'Unknown flag in XLA_FLAGS' at first backend touch,
+    verified 2026-07-30); on PJRT-plugin TPUs those flags are consumed by
+    libtpu via LIBTPU_INIT_ARGS instead. Every token must start with
+    '--xla' (a typo'd token would be silently exported into the env)."""
+    xla, libtpu = [], []
+    for tok in flags_str.split():
+        if not tok.startswith("--xla"):
+            raise ValueError(f"flag token {tok!r} does not start with --xla")
+        (libtpu if tok.startswith("--xla_tpu_") else xla).append(tok)
+    return " ".join(xla), " ".join(libtpu)
+
+
+def apply_flags_env(env: dict, flags_str: str) -> dict:
+    """Merge a validated flag string into env (XLA_FLAGS / LIBTPU_INIT_ARGS,
+    appended — never overwritten). One implementation for both the headline
+    supervisor and the sweep, so the merge semantics cannot drift."""
+    xla, libtpu = partition_flags(flags_str)
+    if xla:
+        env["XLA_FLAGS"] = f"{env.get('XLA_FLAGS', '')} {xla}".strip()
+    if libtpu:
+        env["LIBTPU_INIT_ARGS"] = f"{env.get('LIBTPU_INIT_ARGS', '')} {libtpu}".strip()
+    return env
+
+
+def read_tuning_flags() -> str:
+    """Measured-winner XLA flags from the tuning file, supervisor-side (raw
+    JSON only — the supervisor must never import jax). Returns "" unless a
+    valid non-empty 'flags' string is present."""
+    try:
+        with open(TUNING_PATH) as f:
+            raw = json.load(f)
+        flags = raw.get("flags", "")
+        if not isinstance(flags, str):
+            raise ValueError("flags must be a string")
+        partition_flags(flags)  # validates token shape
+        return flags
+    except FileNotFoundError:
+        return ""
+    except (OSError, ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+        log(f"tuning: ignoring flags from malformed {TUNING_PATH}: {e}")
+        return ""
+
+
 def load_tuning() -> dict:
     """Best-measured step config, or {} (the exact/no-remat parity baseline).
     A malformed tuning file must never take the headline bench down — it is
@@ -359,6 +406,9 @@ def _worker_body(force_cpu: bool):
             # describe what actually ran
             "bn_mode": bn_mode, "remat": used_remat, "remat_policy": used_policy,
             "conv1x1_dot": conv1x1_dot, "tuning_source": tuning.get("source"),
+            # what the process actually ran under (tuned flags arrive via env)
+            "xla_flags_env": os.environ.get("XLA_FLAGS", ""),
+            "libtpu_init_args_env": os.environ.get("LIBTPU_INIT_ARGS", ""),
         },
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }))
@@ -373,17 +423,23 @@ class WorkerTimeout(Exception):
     pass
 
 
-def run_worker(force_cpu: bool) -> dict | None:
+def run_worker(force_cpu: bool, flags: str = "") -> dict | None:
     """Returns the worker's JSON dict (success or structured error), None if it
-    produced no JSON at all, or raises WorkerTimeout if it had to be killed."""
+    produced no JSON at all, or raises WorkerTimeout if it had to be killed.
+    `flags` (tuned XLA/libtpu flags) only ever applies to TPU workers — the
+    CPU fallback must stay flag-free (host XLA aborts on unknown flags)."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     if force_cpu:
         cmd.append("--cpu")
+    env = None
+    if flags and not force_cpu:
+        env = apply_flags_env(os.environ.copy(), flags)
+        log(f"worker env: tuned flags {flags!r}")
     timeout_s = CPU_WORKER_TIMEOUT_S if force_cpu else WORKER_TIMEOUT_S
     try:
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout_s,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
     except subprocess.TimeoutExpired as e:
         log(f"worker timed out after {timeout_s}s")
@@ -433,12 +489,13 @@ def main():
     # in round 2 and WORKER_TIMEOUT_S still bounds a mid-ladder hang.
     if probe_status == "failed":
         log("probe failed fast (not the dead-tunnel hang); trying the worker ladder")
+    tuned_flags = read_tuning_flags()
     for attempt in range(RETRIES):
         if attempt > 0 and time.monotonic() - t_start > TPU_DEADLINE_S:
             last_err += f"; TPU deadline {TPU_DEADLINE_S}s exceeded, skipping remaining retries"
             break
         try:
-            result = run_worker(force_cpu=False)
+            result = run_worker(force_cpu=False, flags=tuned_flags)
         except WorkerTimeout:
             # a killed mid-compile TPU job can wedge the single-chip tunnel;
             # retrying against a possibly-wedged claim only burns timeouts —

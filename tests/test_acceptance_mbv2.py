@@ -128,6 +128,71 @@ def _eval_cfg(fix, log_dir, **data_over):
     })
 
 
+def test_full_scale_bn_mode_prediction_agreement(mbv2_fixture):
+    """The PROFILE.md round-3 decision rule's 'top-1-parity argument' for the
+    perf bn_modes (VERDICT r3 #5), at full scale: the imported MBV2's
+    predictions on the 200 real JPEGs, forwarded in bfloat16 (the production
+    training dtype — the only regime where `compute` differs from `folded`),
+    must agree with the exact-mode predictions to within the same near-tie
+    tolerance the acceptance tests grant decoder differences. This test is
+    the evidence `scripts/tpu_watch.py --allow-compute` cites: a >3% compute
+    win on hardware is adoptable because its forward perturbation is below
+    the noise the fixture already tolerates.
+
+    `fused_vjp` shares folded's eval expression (ops/layers.py BatchNorm
+    .apply) and its train-mode gradients are pinned elsewhere
+    (test_ops.py test_batchnorm_fused_vjp_*); the training-dynamics half of
+    the compute argument is test_train.py::test_bn_variants_converge_identically."""
+    from yet_another_mobilenet_series_tpu.ckpt.torch_import import load_torch_checkpoint
+
+    net = get_model(ModelConfig(arch="mobilenet_v2", dropout=0.0), image_size=224)
+    params, state = load_torch_checkpoint(mbv2_fixture["pth"], net)
+
+    raw = str(mbv2_fixture["tmp"] / "raw")
+    paths = sorted(os.path.join(raw, f) for f in os.listdir(raw) if f.endswith(".jpg"))
+    assert len(paths) == N_IMAGES
+    # identical inputs for every mode: the torch-side preprocessing chain
+    imgs = np.concatenate(
+        [_torch_preprocess(p).numpy() for p in paths]
+    ).transpose(0, 2, 3, 1)  # NHWC
+
+    import jax
+
+    def predict(bn_mode, conv1x1_dot, compute_dtype="bfloat16"):
+        import jax.numpy as jnp
+
+        dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[compute_dtype]
+
+        @jax.jit
+        def fwd(x):
+            logits, _ = net.apply(
+                params, state, x.astype(dt), train=False, compute_dtype=dt,
+                bn_mode=bn_mode, conv1x1_dot=conv1x1_dot,
+            )
+            return jnp.argmax(logits, -1)
+
+        return np.concatenate(
+            [np.asarray(fwd(imgs[i : i + 50])) for i in range(0, N_IMAGES, 50)]
+        )
+
+    base = predict("exact", False)
+    # sanity: bf16 exact agrees with the torch-side f32 ground truth to the
+    # acceptance tolerance (bf16 rounding ~ decoder noise, both sub-percent)
+    assert np.mean(base == np.asarray(mbv2_fixture["preds"])) >= 0.95
+
+    agreement = {}
+    for mode, dot in [("folded", False), ("fused_vjp", False), ("exact", True),
+                      ("compute", False), ("compute", True)]:
+        agreement[(mode, dot)] = float(np.mean(predict(mode, dot) == base))
+    # folded/fused_vjp/dot are re-association/lowering changes: sub-bf16-ulp
+    for key in [("folded", False), ("fused_vjp", False), ("exact", True)]:
+        assert agreement[key] >= 0.98, agreement
+    # compute (bf16 FMA scale/bias) is the gated mode: its flips must stay
+    # within the near-tie band the fixture grants decoder differences
+    assert agreement[("compute", False)] >= 0.95, agreement
+    assert agreement[("compute", True)] >= 0.95, agreement
+
+
 def test_full_scale_eval_folder_native(mbv2_fixture, tmp_path):
     cfg = _eval_cfg(
         mbv2_fixture, tmp_path,

@@ -207,6 +207,32 @@ def test_batchnorm_fused_vjp_matches_autodiff():
     np.testing.assert_array_equal(np.asarray(y_eval_fused), np.asarray(y_eval_folded))
 
 
+def test_batchnorm_fused_vjp_rejects_stat_cotangents():
+    """ADVICE r3 #1: the closed-form backward DISCARDS the mean/var output
+    cotangents by contract (they feed only the never-differentiated running
+    stats). With symbolic_zeros enforcement, a loss term that reads the
+    batch statistics must fail LOUDLY at trace time under fused_vjp rather
+    than silently training with zero stat-gradients."""
+    spec = ops.BatchNorm(4)
+    params, state = spec.init()
+    x = jnp.asarray(np.random.RandomState(0).normal(0, 1, (2, 3, 3, 4)).astype(np.float32))
+
+    def stat_loss(p):
+        _, st = spec.apply(p, state, x, train=True, mode="fused_vjp")
+        return jnp.sum(st["mean"])  # differentiates the batch statistics
+
+    with pytest.raises(TypeError, match="fused_vjp.*cotangents"):
+        jax.grad(stat_loss)(params)
+
+    # the same loss is fine under the autodiff modes
+    def stat_loss_folded(p):
+        _, st = spec.apply(p, state, x, train=True, mode="folded")
+        return jnp.sum(st["mean"])
+
+    g = jax.grad(stat_loss_folded)(params)
+    assert all(np.all(np.isfinite(np.asarray(v))) for v in g.values())
+
+
 def test_batchnorm_fused_vjp_sharded_grad_contract_matches_exact():
     """The per-device gradient CONTRACT under shard_map: fused_vjp's custom
     backward must produce the same per-device partial gradients of the LOCAL

@@ -188,3 +188,35 @@ def test_mesh_validation():
         mesh_lib.local_batch_slice(17, m)  # not divisible by 8 devices
     assert mesh_lib.local_batch_slice(64, m) == 64  # single host
     assert mesh_lib.is_coordinator()
+
+
+def test_check_vma_contract():
+    """Every production shard_map must pass check_vma=False explicitly
+    (ADVICE r3 #2): bn_mode='fused_vjp' returns LOCAL partial dgamma/dbeta
+    by contract (ops/layers.py _bn_train_fused_bwd), which is only the
+    gradient autodiff produces under check_vma=False maps. Anyone flipping
+    a site to check_vma=True (or dropping the kwarg, inheriting a future
+    default) must revisit that VJP — this test makes the coupling fail
+    loudly instead of silently rescaling BN affine grads."""
+    import ast
+    import inspect
+
+    from yet_another_mobilenet_series_tpu.parallel import zero
+
+    for module in (dp, zero):
+        tree = ast.parse(inspect.getsource(module))
+        sites = [
+            node for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and (getattr(node.func, "id", None) == "shard_map"
+                 or getattr(node.func, "attr", None) == "shard_map")
+        ]
+        assert sites, f"{module.__name__}: no shard_map call sites found"
+        for call in sites:
+            kw = {k.arg: k.value for k in call.keywords}
+            assert "check_vma" in kw, (
+                f"{module.__name__}:{call.lineno}: shard_map without an explicit "
+                "check_vma kwarg (the fused_vjp grad contract requires False)")
+            assert isinstance(kw["check_vma"], ast.Constant) and kw["check_vma"].value is False, (
+                f"{module.__name__}:{call.lineno}: check_vma is not the literal False — "
+                "revisit ops/layers.py _bn_train_fused_bwd before changing this")

@@ -280,15 +280,25 @@ def test_remat_policy_validated():
 
 @pytest.mark.slow
 def test_bn_variants_converge_identically():
-    """20 training steps under each bn_mode track the exact-mode loss
-    trajectory (single device, f32): per-step fp re-association (~1e-7)
-    must not compound into divergent optimization."""
+    """300 training steps under each bn_mode track the exact-mode loss
+    trajectory (single device, f32) with bounded divergence — the
+    training-dynamics half of the PROFILE.md decision rule's top-1-parity
+    argument for `compute` (VERDICT r3 #5; the eval-forward half is
+    test_acceptance_mbv2.py::test_full_scale_bn_mode_prediction_agreement).
+
+    Raw losses cannot stay close for hundreds of steps: benign ~1e-7
+    re-association differences compound chaotically through RMSProp's rsqrt
+    (~0.5% rel by step 20, observed). The long-horizon guarantee is "same
+    optimization", asserted as (a) every mode converges to the same
+    overfit plateau band, and (b) end-state train-batch predictions match
+    exact's exactly."""
     batch = {
         "image": jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3)),
         "label": jnp.arange(8) % 4,
     }
     rng = jax.random.PRNGKey(42)
-    traces = {}
+    n_steps, tail = 300, 50
+    traces, end_preds = {}, {}
     for mode in ("exact", "folded", "compute", "fused_vjp"):
         cfg = _tiny_cfg(train={"compute_dtype": "float32", "bn_mode": mode})
         net = get_model(cfg.model, image_size=16)
@@ -298,19 +308,24 @@ def test_bn_variants_converge_identically():
         ts = steps.init_train_state(net, cfg, opt, jax.random.PRNGKey(0))
         step_fn = jax.jit(steps.make_train_step(net, cfg, opt, lr_fn))
         losses = []
-        for _ in range(20):
+        for _ in range(n_steps):
             ts, metrics = step_fn(ts, batch, rng)
             losses.append(float(metrics["loss"]))
         traces[mode] = np.asarray(losses)
-    # early steps are near-identical; benign ~1e-7 re-association differences
-    # then compound chaotically through RMSProp's rsqrt (observed ~0.5% rel
-    # by step 20), so the late-trace bound is coarse — the guarantee is
-    # "same optimization", not bitwise trajectories
+        logits, _ = net.apply(ts.params, ts.state, batch["image"], train=False)
+        end_preds[mode] = np.asarray(jnp.argmax(logits, -1))
     for mode in ("folded", "fused_vjp", "compute"):
+        # short horizon: trajectories are still numerically locked
         np.testing.assert_allclose(traces[mode][:8], traces["exact"][:8], rtol=1e-3, atol=1e-4)
-        np.testing.assert_allclose(traces[mode], traces["exact"], rtol=5e-2, atol=1e-3)
-    # and training actually progressed in every mode
-    assert all(t[-1] < t[0] * 0.9 for t in traces.values())
+        # long horizon: same plateau (mean over the last `tail` steps) ...
+        exact_tail = traces["exact"][-tail:].mean()
+        mode_tail = traces[mode][-tail:].mean()
+        assert abs(mode_tail - exact_tail) <= max(0.05, 0.15 * exact_tail), (
+            mode, mode_tail, exact_tail)
+        # ... and the same learned classification of the train batch
+        np.testing.assert_array_equal(end_preds[mode], end_preds["exact"], err_msg=mode)
+    # and training actually overfit in every mode (4 classes, 8 samples)
+    assert all(t[-tail:].mean() < t[0] * 0.5 for t in traces.values())
 
 
 def test_train_step_overfits_tiny_batch():

@@ -161,6 +161,9 @@ def _bn_train_fused_fwd_impl(x, gamma, beta, eps, axis_name):
 
 
 def _bn_train_fused_fwd(x, gamma, beta, eps, axis_name):
+    # symbolic_zeros=True (see defvjp below) wraps each differentiable
+    # primal in a CustomVJPPrimal carrier: unwrap to the actual arrays
+    x, gamma, beta = x.value, gamma.value, beta.value
     y, mean, var, (inv, n) = _bn_train_fused_fwd_impl(x, gamma, beta, eps, axis_name)
     # residuals are the bf16 input + per-channel f32 stats — x_hat and any
     # f32 copy of the activation are recomputed, never stored
@@ -187,14 +190,29 @@ def _bn_train_fused_bwd(eps, axis_name, res, cts):
       forward psum).
 
     The two local reductions fuse into ONE pass over (x, dy); dx is one
-    more elementwise pass. Cotangents of the mean/var outputs are ignored:
-    they feed only the running-stat state, which the training loss never
-    differentiates (train/steps.py returns new_state as aux). The var
+    more elementwise pass. Cotangents of the mean/var outputs must be
+    symbolically zero: they feed only the running-stat state, which the
+    training loss never differentiates (train/steps.py returns new_state as
+    aux) — and that assumption is ENFORCED below (ADVICE r3 #1), so a
+    future loss term reading the batch stats fails loudly at trace time
+    instead of silently training with zero stat-gradients. The var
     zero-clamp in _bn_moments is treated as inactive (it only engages when
     catastrophic cancellation makes var numerically negative)."""
     del eps  # static; backward needs only the saved residuals
     x, gamma, mean, inv, n = res
-    dy, _dmean_ct, _dvar_ct = cts
+    dy, dmean_ct, dvar_ct = cts
+    zero = jax.custom_derivatives.SymbolicZero
+    if not (isinstance(dmean_ct, zero) and isinstance(dvar_ct, zero)):
+        raise TypeError(
+            "bn_mode='fused_vjp' received non-zero cotangents for the batch "
+            "mean/var outputs; its closed-form backward discards them by "
+            "contract. A loss term differentiating the batch statistics "
+            "(e.g. a stat regularizer) must use an autodiff bn_mode "
+            "('exact'/'folded') or extend _bn_train_fused_bwd."
+        )
+    if isinstance(dy, zero):
+        # nothing differentiates y either: all three gradients vanish
+        return jnp.zeros_like(x), jnp.zeros_like(gamma), jnp.zeros_like(gamma)
     dyf = dy.astype(jnp.float32)
     x_hat = (x.astype(jnp.float32) - mean) * inv
     dbeta = jnp.sum(dyf, axis=(0, 1, 2))
@@ -208,7 +226,9 @@ def _bn_train_fused_bwd(eps, axis_name, res, cts):
 
 
 _bn_train_fused = jax.custom_vjp(_bn_train_fused, nondiff_argnums=(3, 4))
-_bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_train_fused_bwd)
+# symbolic_zeros=True so the backward can DETECT (and reject) a real
+# cotangent on the mean/var outputs rather than silently dropping it
+_bn_train_fused.defvjp(_bn_train_fused_fwd, _bn_train_fused_bwd, symbolic_zeros=True)
 
 
 @dataclass(frozen=True)

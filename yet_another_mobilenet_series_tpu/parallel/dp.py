@@ -80,6 +80,12 @@ def make_dp_train_step(
         mesh=mesh,
         in_specs=(ts_spec, P(DATA_AXIS), P()),
         out_specs=(ts_spec, P()),
+        # check_vma=False is LOAD-BEARING for bn_mode='fused_vjp': its
+        # closed-form backward returns LOCAL partial dgamma/dbeta that the
+        # step's pmean/psum_scatter combines (ops/layers.py
+        # _bn_train_fused_bwd contract). Flipping to check_vma=True changes
+        # shard_map's replication semantics — revisit that VJP first
+        # (pinned by tests/test_parallel.py::test_check_vma_contract).
         check_vma=False,
     )
     return jax.jit(fn, donate_argnums=(0,))

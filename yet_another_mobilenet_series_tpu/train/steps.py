@@ -206,9 +206,15 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
     """Returns eval_fn(params, state, batch, masks) -> summed metric counts
     {'top1','top5','n','loss_sum'} — allreduce-able AverageMeter counts
     (SURVEY.md §2 #13). Runs on EMA shadow weights when the caller passes
-    them (reference: eval-on-shadow, SURVEY.md §2 #8)."""
+    them (reference: eval-on-shadow, SURVEY.md §2 #8).
+
+    Perf knobs do NOT leak into the metric path (ADVICE r3 #3): eval always
+    normalizes with the reference-parity exact BN expression and the stock
+    conv lowering regardless of train.bn_mode/train.conv1x1_dot, so a tuned
+    training config can never perturb reported accuracy. (The bn_mode
+    perturbation itself is measured — on purpose, via net.apply directly —
+    by test_acceptance_mbv2.py::test_full_scale_bn_mode_prediction_agreement.)"""
     compute_dtype = _dtype(cfg.train.compute_dtype)
-    _check_bn_mode(cfg)
 
     def eval_fn(params, state, batch, masks):
         imasks = {int(k): v for k, v in masks.items()} or None
@@ -219,8 +225,8 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
             train=False,
             compute_dtype=compute_dtype,
             masks=imasks,
-            bn_mode=cfg.train.bn_mode,
-            conv1x1_dot=cfg.train.conv1x1_dot,
+            bn_mode="exact",
+            conv1x1_dot=False,
         )
         labels = batch["label"]
         # padded examples carry label -1: mask them out of every count
