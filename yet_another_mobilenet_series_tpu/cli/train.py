@@ -308,7 +308,12 @@ def run(cfg: Config) -> dict:
 
     local_batch = mesh_lib.local_batch_slice(cfg.train.batch_size, mesh)
     train_iter = mesh_lib.prefetch_to_mesh(
-        data_lib.make_train_source(cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count()),
+        data_lib.make_train_source(
+            cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count(),
+            # resume continues the data order at the restored step (each
+            # global step consumed exactly one local batch per host)
+            start_step=int(ts.step),
+        ),
         mesh,
         depth=cfg.data.device_prefetch,
     )

@@ -27,16 +27,27 @@ def _check(cfg: DataConfig) -> None:
         )
 
 
-def make_train_source(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1) -> Iterator[dict]:
-    """Infinite iterator of {'image','label'} numpy batches (this host's shard)."""
+def make_train_source(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0,
+                      process_count: int = 1, start_step: int = 0) -> Iterator[dict]:
+    """Infinite iterator of {'image','label'} numpy batches (this host's shard).
+
+    start_step: local batches this host already consumed (== the global train
+    step; identical on every host). A resumed run CONTINUES the data order
+    from there instead of replaying the epoch-0 shuffle — bit-exact for the
+    fake/tfdata and folder/native paths, epoch-faithful for TFRecords
+    (pipeline.make_train_dataset docstring; tests/test_resume_data.py)."""
     _check(cfg)
     if cfg.loader == "native":
         from . import native_loader
 
-        return iter(native_loader.make_native_train_iter(cfg, local_batch, seed, process_index, process_count))
+        return iter(native_loader.make_native_train_iter(
+            cfg, local_batch, seed, process_index, process_count, start_step=start_step))
     if cfg.loader == "synthetic":
+        # position-independent by construction (the same device-resident
+        # batch forever) — nothing to skip
         return _pipeline.synthetic_device_batches(cfg, local_batch, cfg.fake_num_classes or 1000)
-    ds = _pipeline.make_train_dataset(cfg, local_batch, seed, process_index, process_count)
+    ds = _pipeline.make_train_dataset(cfg, local_batch, seed, process_index, process_count,
+                                      start_step=start_step)
     return _pipeline.as_numpy(ds)
 
 

@@ -56,7 +56,7 @@ def _load():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
-        ctypes.c_float, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_int64, ctypes.c_int64,
     ]
     lib.loader_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
     lib.loader_start.argtypes = [ctypes.c_void_p]
@@ -113,9 +113,13 @@ class NativeLoader:
         seed: int = 0,
         num_threads: int | None = None,
         pad_batches: int = 0,
+        start_batch: int = 0,
     ):
         """pad_batches > 0: every pass serves exactly that many batches,
-        padding past the sample list with label=-1 (exact eval counting)."""
+        padding past the sample list with label=-1 (exact eval counting).
+        start_batch: resume position — the stream begins at this global
+        batch index, bit-identical to an uninterrupted run's (every batch
+        is a pure function of (seed, global_batch) in the C++ pipeline)."""
         lib = _load()
         mean = (ctypes.c_float * 3)(*cfg.mean)
         std = (ctypes.c_float * 3)(*cfg.std)
@@ -126,7 +130,7 @@ class NativeLoader:
             cfg.image_size, cfg.eval_resize, batch,
             num_threads or cfg.decode_threads, int(train), seed, mean, std,
             cfg.rrc_area_min, cfg.rrc_area_max, cfg.rrc_ratio_min, cfg.rrc_ratio_max,
-            cfg.color_jitter if train else 0.0, pad_batches,
+            cfg.color_jitter if train else 0.0, pad_batches, start_batch,
         )
         for p, l in zip(paths, labels):
             lib.loader_add_file(self._handle, os.fsencode(p), int(l))
@@ -185,12 +189,16 @@ def _host_shard(paths, labels, process_index: int, process_count: int):
 
 
 def make_native_train_iter(
-    cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1
+    cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0, process_count: int = 1,
+    start_step: int = 0,
 ) -> NativeLoader:
+    """start_step: local batches this host already consumed (== the global
+    train step on every host) — the resumed stream continues from there."""
     paths, labels, _ = list_image_folder(os.path.join(cfg.data_dir, cfg.train_split))
     paths, labels = _host_shard(paths, labels, process_index, process_count)
     # per-host seed offset decorrelates shuffle order across hosts
-    return NativeLoader(paths, labels, cfg, local_batch, train=True, seed=seed + process_index)
+    return NativeLoader(paths, labels, cfg, local_batch, train=True, seed=seed + process_index,
+                        start_batch=start_step)
 
 
 def make_native_eval_loader(
