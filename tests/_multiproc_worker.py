@@ -1,11 +1,18 @@
 """Worker for the true multi-process distributed test (tests/test_multiproc.py).
 
-Runs as `python tests/_multiproc_worker.py <pid> <nproc> <port> <tmpdir>`:
+Runs as `python tests/_multiproc_worker.py <pid> <nproc> <port> <tmpdir> [scenario]`:
 joins a real jax.distributed cluster of <nproc> CPU processes (4 fake devices
 each), then drives the full cli_train.run() — per-process data sharding
 (make_array_from_process_local_data), psum SyncBN + grad pmean across hosts,
 eval batch-count equalization, coordinator-only logging, and the coordinated
 Orbax save. Prints one `RESULT {json}` line for the parent to compare.
+
+Scenarios (VERDICT r3 #6 added the second):
+  fake   — tf.data synthetic pipeline (default)
+  folder — ImageFolder tree under <tmpdir>/data through the native C++
+           loader: per-host file sharding, padded label=-1 eval tails, and
+           the equal-collective-step-count (pod-deadlock) guard exercised
+           under REAL multi-process jax.distributed.
 """
 
 import json
@@ -15,6 +22,7 @@ import sys
 
 def main():
     pid, nproc, port, tmpdir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    scenario = sys.argv[5] if len(sys.argv) > 5 else "fake"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 
     import jax
@@ -35,6 +43,20 @@ def main():
     from yet_another_mobilenet_series_tpu.cli import train as cli_train
     from yet_another_mobilenet_series_tpu.config import config_from_dict
 
+    if scenario == "folder":
+        # 80 train JPEGs (40/host >= one local batch of 32) and 54 val
+        # JPEGs: 27/host at local eval batch 16 -> 2 padded batches/host
+        # with label=-1 tails; eval_n must still psum to exactly 54
+        data = {"dataset": "folder", "loader": "native",
+                "data_dir": os.path.join(tmpdir, "data"), "image_size": 32,
+                "num_train_examples": 80, "num_eval_examples": 54,
+                "decode_threads": 2}
+        epochs = 4.0
+    else:
+        # fake_eval_size 72 does NOT divide eval batches evenly: 72/2 hosts =
+        # 36 each, batch 16 -> 3 padded batches/host (equalization exercised)
+        data = {"dataset": "fake", "image_size": 32, "fake_train_size": 1280, "fake_eval_size": 72}
+        epochs = 2.0
     cfg = config_from_dict({
         "name": "multiproc",
         "model": {
@@ -46,16 +68,14 @@ def main():
                 {"t": 3, "c": 24, "n": 1, "s": 2, "k": 3},
             ],
         },
-        # fake_eval_size 72 does NOT divide eval batches evenly: 72/2 hosts =
-        # 36 each, batch 16 -> 3 padded batches/host (equalization exercised)
-        "data": {"dataset": "fake", "image_size": 32, "fake_train_size": 1280, "fake_eval_size": 72},
+        "data": data,
         "optim": {"optimizer": "sgd", "momentum": 0.9, "weight_decay": 1e-5},
         "schedule": {"schedule": "constant", "base_lr": 0.05, "scale_by_batch": False, "warmup_epochs": 0.2},
         "ema": {"enable": True, "decay": 0.99},
         "train": {
             "batch_size": 64,
             "eval_batch_size": 32,
-            "epochs": 2,
+            "epochs": epochs,
             "log_every": 2,
             "compute_dtype": "float32",
             "log_dir": tmpdir,

@@ -169,12 +169,16 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
     # sharded by slicing, so a host's share is its file fraction — not the
     # uniform 1/process_count (with 16 shards on 3 hosts one host reads 6/16
     # of the records; the uniform estimate would drift ~12% per epoch and a
-    # deep resume would land whole epochs away from the uninterrupted run)
+    # deep resume would land whole epochs away from the uninterrupted run).
+    # Arithmetic is in RECORDS, not batches: batching runs over the
+    # continuous record stream (no per-epoch remainder drop), so after k
+    # steps exactly k*local_batch records are consumed — a batches-per-epoch
+    # floor would drift by (records_per_epoch % local_batch) every epoch.
     records_per_epoch = max(
         -(-cfg.num_train_examples * len(host_files) // len(files)), 1)
-    batches_per_epoch = max(records_per_epoch // local_batch, 1)
-    start_epoch = start_step // batches_per_epoch
-    skip_records = (start_step % batches_per_epoch) * local_batch
+    start_records = start_step * local_batch
+    start_epoch = start_records // records_per_epoch
+    skip_records = start_records % records_per_epoch
 
     def epoch_files(e):
         # stateless per-epoch file permutation: epoch e's order is identical
