@@ -187,7 +187,7 @@ def main():
                     help="per-flag-set child budget in --xla-flags-sweep")
     ap.add_argument(
         "--variants",
-        default="exact:0,folded:0,compute:0,fused_vjp:0,exact:full,exact:save_conv,compute:save_conv,exact:0:dot",
+        default="exact:0,folded:0,compute:0,fused_vjp:0,sdot:0,compute_sdot:0,exact:full,exact:save_conv,compute:save_conv,exact:0:dot,sdot:0:dot",
         help="comma list of bn_mode:remat[:dot] where remat is 0 (off), "
              "1/full (jax.checkpoint), or save_conv (keep MXU outputs, "
              "recompute BN/act chains); a trailing ':dot' lowers 1x1 convs "

@@ -64,17 +64,36 @@ HEADLINE_TIMEOUT_S = 6000  # above bench.py's own worst case (~4950 s): it
 # lose the decision step on rows already persisted.
 SWEEP_CHILD_S = 600       # TPU child: ~34 s init + ~90 s compile + 20 iters
 SWEEP_TIMEOUT_S = 5 * SWEEP_CHILD_S + 1200
+# trace capture: ~60-step CLI run with the profiler window under the FINAL
+# adopted config — the op-cost re-rank the next round's attack needs
+TRACE_TIMEOUT_S = 1500
 
 # PROFILE.md "Round 3" decision rule: a parity-safe variant must beat the
 # exact/no-remat/no-dot baseline by >3% to become the bench default.
 WIN_THRESHOLD = 1.03
-PARITY_SAFE_MODES = ("exact", "folded", "fused_vjp")  # bit-level-equivalent
-# `compute` (bf16 FMA) needs the top-1-parity argument before defaulting —
+# exact/folded/fused_vjp: bit-level-equivalent math; sdot: identical
+# expressions with MXU-dot statistics (f32 accumulation-order rounding only,
+# ~1e-7 — same class as folded's re-association)
+PARITY_SAFE_MODES = ("exact", "folded", "fused_vjp", "sdot")
+# the `compute` family (bf16 FMA normalize, incl. the compute_sdot
+# composite) needs the top-1-parity argument before defaulting —
 # tests/test_acceptance_mbv2.py's bn_mode prediction-agreement test supplies
 # it; pass --allow-compute once that test is green on the round's tree.
+COMPUTE_MODES = ("compute", "compute_sdot")
 LOSS_SANITY_ABS = 0.02    # same data/key => losses near-identical across variants
 
 START_TIME = time.time()
+# monotonic deadline set by main(); best-effort stages (sweep, trace) check
+# it so a dying window can never leave them mid-flight when the round's
+# driver wants the chip
+T_END = None
+
+
+def _time_left_for(seconds: float, label: str) -> bool:
+    if T_END is not None and time.monotonic() + seconds >= T_END:
+        log(f"skipping {label}: worst case ({seconds:.0f}s) does not fit before the deadline")
+        return False
+    return True
 
 
 def log(msg):
@@ -170,7 +189,7 @@ def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
         "adopted": False,
     }
     if base is not None:
-        eligible_modes = PARITY_SAFE_MODES + (("compute",) if allow_compute else ())
+        eligible_modes = PARITY_SAFE_MODES + (COMPUTE_MODES if allow_compute else ())
         best, best_speedup = None, WIN_THRESHOLD
         for r in rows:
             if r["bn_mode"] not in eligible_modes:
@@ -244,13 +263,14 @@ def decide_sweep(sweep_path: str, decision_path: str) -> None:
         f.write("\n")
 
 
-def _run_job(cmd: list[str], timeout_s: int, label: str):
+def _run_job(cmd: list[str], timeout_s: int, label: str, env: dict | None = None):
     """Run one TPU job to its own completion (timeout only catches a window
     that died mid-job, leaving the process stuck in dead-tunnel init — the
     safe-to-kill case, NOT a running TPU computation)."""
     log(f"session: {label} starting")
     try:
-        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout_s)
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout_s,
+                           env=env)
     except subprocess.TimeoutExpired:
         log(f"{label} exceeded its window (closed mid-session?); will keep watching")
         return None
@@ -305,6 +325,53 @@ def _record_headline(r, headline_path: str) -> bool:
     return True
 
 
+def run_trace(round_n: int) -> None:
+    """Best-effort trace capture under the FINAL adopted config (tuning keys
+    as CLI overrides, adopted flags in the env): ~60 steps of the headline
+    recipe with the profiler window, decoded to TRACE_OPS_r{N}.txt — the
+    op-cost re-rank the next round's attack is planned from."""
+    tuning = _read_tuning()
+    trace_dir = os.path.join(REPO, "traces", f"r{round_n}")
+    cmd = [sys.executable, "-m", "yet_another_mobilenet_series_tpu.cli.train",
+           "app:yet_another_mobilenet_series_tpu/apps/mobilenet_v3_large.yml",
+           "data.dataset=fake", "data.loader=synthetic",
+           # steps_per_epoch for dataset=fake is fake_train_size/batch: pin
+           # it so exactly 60 steps run and the profiler window (30..50)
+           # actually opens (a fractional-epoch guess here once produced a
+           # 1-step run and no trace at all)
+           "data.fake_train_size=15360", "train.batch_size=256", "train.epochs=1",
+           "train.eval_every_epochs=0",
+           "train.profile_start_step=30", "train.profile_num_steps=20",
+           f"train.log_dir={trace_dir}"]
+    for cfg_key, t_key in (("train.bn_mode", "bn_mode"),
+                           ("train.conv1x1_dot", "conv1x1_dot"),
+                           ("train.remat", "remat"),
+                           ("train.remat_policy", "remat_policy")):
+        if t_key in tuning:
+            v = tuning[t_key]
+            cmd.append(f"{cfg_key}={str(v).lower() if isinstance(v, bool) else v}")
+    env = None
+    if tuning.get("flags"):
+        try:
+            from bench import apply_flags_env
+
+            env = apply_flags_env(os.environ.copy(), tuning["flags"])
+        except ValueError as e:
+            log(f"trace: ignoring malformed tuned flags: {e}")
+    r = _run_job(cmd, TRACE_TIMEOUT_S, "trace capture", env=env)
+    if r is None or r.returncode != 0:
+        return
+    rd = _run_job([sys.executable, os.path.join(REPO, "scripts", "trace_ops.py"),
+                   os.path.join(trace_dir, "trace"), "40"],
+                  600, "trace decode")
+    if rd is not None and rd.returncode == 0 and rd.stdout.strip():
+        out_path = os.path.join(REPO, f"TRACE_OPS_r{round_n}.txt")
+        with open(out_path, "w") as f:
+            f.write(f"# op breakdown under config {tuning or 'baseline'}\n")
+            f.write(rd.stdout)
+        log(f"trace decoded -> {os.path.basename(out_path)}")
+
+
 def run_session(args) -> bool:
     """Returns True only if the round's A/B + headline artifacts were actually
     produced — a False lets the caller keep watching for the next window."""
@@ -336,7 +403,7 @@ def run_session(args) -> bool:
         log("headline run produced no TPU measurement; will rewatch")
         return False
 
-    if args.with_sweep:
+    if args.with_sweep and _time_left_for(SWEEP_TIMEOUT_S + HEADLINE_TIMEOUT_S, "xla flag sweep"):
         sweep_path = os.path.join(REPO, f"BENCH_XLA_r{args.round}.json")
         _run_job(
             [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
@@ -360,6 +427,10 @@ def run_session(args) -> bool:
                 r4 = _run_job([sys.executable, os.path.join(REPO, "bench.py")],
                               HEADLINE_TIMEOUT_S, "headline re-run under adopted flags")
                 _record_headline(r4, headline_path)
+    # trace LAST: it captures the op mix of whatever config the session
+    # adopted, which is what the next round plans from
+    if _time_left_for(TRACE_TIMEOUT_S + 600, "trace capture"):
+        run_trace(args.round)
     log("session complete")
     return True
 
@@ -377,9 +448,12 @@ def main():
     ap.add_argument("--with-sweep", action="store_true",
                     help="after a secured headline, run the XLA flag sweep too")
     args = ap.parse_args()
-    session_budget = (QUIET_WAIT_S + PROBE_TIMEOUT_S + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
-                      + (SWEEP_TIMEOUT_S if args.with_sweep else 0))
-    t_end = time.monotonic() + args.deadline_min * 60
+    # gate session START on the MANDATORY stages' worst case only; the
+    # best-effort stages (sweep + its headline re-run, trace) each re-check
+    # the deadline themselves and are skipped when they no longer fit
+    session_budget = QUIET_WAIT_S + PROBE_TIMEOUT_S + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
+    global T_END
+    t_end = T_END = time.monotonic() + args.deadline_min * 60
     n = 0
     # probes run until the deadline (cheap, kill-safe); only a SESSION start
     # is gated on the full budget fitting before t_end, so a late-found

@@ -282,7 +282,54 @@ def test_batchnorm_fused_vjp_sharded_grad_contract_matches_exact():
     np.testing.assert_allclose(np.asarray(gx_fused), np.asarray(gx_exact), rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("mode", ["exact", "folded", "compute", "fused_vjp"])
+def test_batchnorm_sdot_stats_match_reduce():
+    """mode='sdot' (MXU-dot batch statistics, the round-4 A/B candidate):
+    values, gradients, and running stats must match 'folded' (identical
+    normalize expression) within f32 accumulation-order rounding — the one
+    mode whose statistics are NOT bit-identical to the reduce-based ones,
+    by construction."""
+    c = 12
+    spec = ops.BatchNorm(c)
+    params, state = spec.init()
+    rs = np.random.RandomState(7)
+    params["gamma"] = jnp.asarray(rs.uniform(0.5, 1.5, c).astype(np.float32))
+    params["beta"] = jnp.asarray(rs.uniform(-0.5, 0.5, c).astype(np.float32))
+    x = jnp.asarray(rs.normal(1.0, 2.0, (8, 7, 7, c)).astype(np.float32))
+
+    y_ref, st_ref = spec.apply(params, state, x, train=True, mode="folded")
+    y_dot, st_dot = spec.apply(params, state, x, train=True, mode="sdot")
+    np.testing.assert_allclose(np.asarray(y_dot), np.asarray(y_ref), rtol=1e-5, atol=1e-5)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(np.asarray(st_dot[k]), np.asarray(st_ref[k]), rtol=1e-5, atol=1e-6)
+
+    w = jnp.asarray(rs.normal(0, 1, (8, 7, 7, c)).astype(np.float32))
+
+    def loss(p, xx, mode):
+        y, _ = spec.apply(p, state, xx, train=True, mode=mode)
+        return jnp.sum(y * w)
+
+    (g_ref, gx_ref) = jax.grad(loss, argnums=(0, 1))(params, x, "folded")
+    (g_dot, gx_dot) = jax.grad(loss, argnums=(0, 1))(params, x, "sdot")
+    np.testing.assert_allclose(np.asarray(gx_dot), np.asarray(gx_ref), rtol=1e-4, atol=1e-5)
+    for k in ("gamma", "beta"):
+        np.testing.assert_allclose(np.asarray(g_dot[k]), np.asarray(g_ref[k]), rtol=1e-4, atol=1e-5)
+
+    # bf16 activations (the real training dtype): the dot's bf16 products
+    # are exact in the f32 accumulator, so stats stay at f32-rounding
+    # distance even from bf16 inputs
+    xb = x.astype(jnp.bfloat16)
+    _, st_b16 = spec.apply(params, state, xb, train=True, mode="sdot")
+    _, st_ref16 = spec.apply(params, state, xb, train=True, mode="folded")
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(np.asarray(st_b16[k]), np.asarray(st_ref16[k]), rtol=1e-5, atol=1e-6)
+
+    # eval mode uses running stats: sdot is folded exactly
+    y_eval_dot, _ = spec.apply(params, st_dot, x, train=False, mode="sdot")
+    y_eval_folded, _ = spec.apply(params, st_dot, x, train=False, mode="folded")
+    np.testing.assert_array_equal(np.asarray(y_eval_dot), np.asarray(y_eval_folded))
+
+
+@pytest.mark.parametrize("mode", ["exact", "folded", "compute", "fused_vjp", "sdot", "compute_sdot"])
 def test_syncbn_equals_full_batch_bn(mode):
     """psum-of-moments SyncBN over 8 shards == BN over the unsharded batch
     (SURVEY.md §4.2) — the apex-SyncBatchNorm parity contract, in every

@@ -66,6 +66,7 @@ def test_dp_step_bn_modes_agree(setup):
         "folded": {"bn_mode": "folded"},
         "fused_vjp": {"bn_mode": "fused_vjp"},
         "exact+dot": {"bn_mode": "exact", "conv1x1_dot": True},
+        "sdot": {"bn_mode": "sdot"},
     }
     results = {}
     for name, over in variants.items():
@@ -75,7 +76,7 @@ def test_dp_step_bn_modes_agree(setup):
         ts, met = step(ts, b, jax.random.PRNGKey(7))
         results[name] = (jax.device_get(ts.params), float(met["grad_norm"]), float(met["loss"]))
     p_ref, gn_ref, loss_ref = results["exact"]
-    for mode in ("folded", "fused_vjp", "exact+dot"):
+    for mode in ("folded", "fused_vjp", "exact+dot", "sdot"):
         p, gn, loss = results[mode]
         np.testing.assert_allclose(loss, loss_ref, rtol=1e-5)
         np.testing.assert_allclose(gn, gn_ref, rtol=1e-4)

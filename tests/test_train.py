@@ -299,7 +299,7 @@ def test_bn_variants_converge_identically():
     rng = jax.random.PRNGKey(42)
     n_steps, tail = 300, 50
     traces, end_preds = {}, {}
-    for mode in ("exact", "folded", "compute", "fused_vjp"):
+    for mode in ("exact", "folded", "compute", "fused_vjp", "sdot", "compute_sdot"):
         cfg = _tiny_cfg(train={"compute_dtype": "float32", "bn_mode": mode})
         net = get_model(cfg.model, image_size=16)
         lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
@@ -314,7 +314,7 @@ def test_bn_variants_converge_identically():
         traces[mode] = np.asarray(losses)
         logits, _ = net.apply(ts.params, ts.state, batch["image"], train=False)
         end_preds[mode] = np.asarray(jnp.argmax(logits, -1))
-    for mode in ("folded", "fused_vjp", "compute"):
+    for mode in ("folded", "fused_vjp", "compute", "sdot", "compute_sdot"):
         # short horizon: trajectories are still numerically locked
         np.testing.assert_allclose(traces[mode][:8], traces["exact"][:8], rtol=1e-3, atol=1e-4)
         # long horizon: same plateau (mean over the last `tail` steps) ...

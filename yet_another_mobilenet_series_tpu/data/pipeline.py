@@ -149,10 +149,17 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
     - imagenet/TFRecord: epoch-faithful continuation — the per-epoch file
       order is keyed statelessly by (seed, epoch) and the stream starts at
       start_step's epoch with the intra-epoch remainder of records skipped
-      pre-decode. The parallel interleave (deterministic=False, kept for
-      throughput) and the cross-epoch shuffle buffer make the record-level
-      order approximate, but a resumed run consumes the SAME epoch's file
-      set from approximately the same position — never an epoch-0 replay."""
+      pre-decode. Record-level EXACTNESS additionally requires
+      decode_threads=1 and shuffle_buffer=1 (what the resume tests pin):
+      under production settings the parallel interleave
+      (deterministic=False, kept for throughput) reorders records, and the
+      resume point restarts the shuffle buffer — up to shuffle_buffer
+      records that sat unemitted in the interrupted run's buffer are
+      skipped, and the same count near the skip point can repeat. Bounded
+      by ONE buffer (16k records ~ 1% of an ImageNet epoch) per resume,
+      not compounding; the guarantee that matters — the SAME epoch's file
+      set from the same position, never an epoch-0 replay — holds
+      regardless."""
     tf = _tf_mod()
     if cfg.dataset == "fake":
         return _fake_dataset(cfg, local_batch, seed, train=True,

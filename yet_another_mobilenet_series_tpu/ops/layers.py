@@ -32,7 +32,7 @@ Array = jax.Array
 
 # the BatchNorm.apply normalize variants (single source of truth — the step
 # builders and the A/B bench validate against this same tuple)
-BN_MODES = ("exact", "folded", "compute", "fused_vjp")
+BN_MODES = ("exact", "folded", "compute", "fused_vjp", "sdot", "compute_sdot")
 
 
 # ---------------------------------------------------------------------------
@@ -129,13 +129,10 @@ class Conv2D:
 # ---------------------------------------------------------------------------
 
 
-def _bn_moments(x, axis_name):
-    """Global (psum'd) f32 moments of x over N,H,W: (mean, var_biased, n).
-    f32 accumulators reduce the input dtype directly — bit-equal to casting
-    first, with no materialized f32 copy of the activation."""
-    n_local = x.shape[0] * x.shape[1] * x.shape[2]
-    s1 = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
-    s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+def _finalize_moments(s1, s2, n_local, axis_name):
+    """Shared psum + mean/biased-var tail of both stat paths — one copy, so
+    a future change to the clamp or the psum structure cannot drift the
+    modes apart below the parity tests' tolerance."""
     n = jnp.asarray(n_local, jnp.float32)
     if axis_name is not None:
         s1 = lax.psum(s1, axis_name)
@@ -144,6 +141,42 @@ def _bn_moments(x, axis_name):
     mean = s1 / n
     var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)  # biased
     return mean, var, n
+
+
+def _bn_moments(x, axis_name):
+    """Global (psum'd) f32 moments of x over N,H,W: (mean, var_biased, n).
+    f32 accumulators reduce the input dtype directly — bit-equal to casting
+    first, with no materialized f32 copy of the activation."""
+    n_local = x.shape[0] * x.shape[1] * x.shape[2]
+    s1 = jnp.sum(x, axis=(0, 1, 2), dtype=jnp.float32)
+    s2 = jnp.sum(jnp.square(x.astype(jnp.float32)), axis=(0, 1, 2))
+    return _finalize_moments(s1, s2, n_local, axis_name)
+
+
+def _bn_moments_dot(x, axis_name):
+    """Batch moments computed as MXU contractions instead of VPU reduces —
+    the round-4 attack candidate on the trace's 51.8% convert_reduce_fusion
+    share (PROFILE.md): s1 = ones·x is a plain dot; s2 = Σ_nhw x² is a
+    C-batched self-contraction (batch dim C, contract NHW), whose bf16
+    products are EXACT in the f32 accumulator (8-bit mantissas double to 16
+    < 24). Forcing dot lowerings also forces the BACKWARD companions of the
+    stat reductions onto the MXU (autodiff transposes a dot to dots).
+    Within f32 accumulation-order rounding (~1e-7 rel) of _bn_moments —
+    NOT bit-identical, hence a separate opt-in mode. The exact-products
+    argument above is for bf16 INPUTS; f32 inputs on the MXU would be
+    silently truncated to bf16 under default precision (~1e-3 stat error,
+    invisible to the CPU parity tests), so f32 requests HIGHEST precision —
+    the bf16 training path keeps the fast default."""
+    c = x.shape[-1]
+    xt = x.reshape(-1, c)
+    n_local = xt.shape[0]
+    ones = jnp.ones((n_local,), x.dtype)
+    prec = lax.Precision.HIGHEST if x.dtype == jnp.float32 else lax.Precision.DEFAULT
+    s1 = lax.dot_general(ones, xt, (((0,), (0,)), ((), ())),
+                         preferred_element_type=jnp.float32, precision=prec)
+    s2 = lax.dot_general(xt, xt, (((0,), (0,)), ((1,), (1,))),
+                         preferred_element_type=jnp.float32, precision=prec)
+    return _finalize_moments(s1, s2, n_local, axis_name)
 
 
 def _bn_train_fused(x, gamma, beta, eps, axis_name):
@@ -297,6 +330,15 @@ class BatchNorm:
           copies are recomputed, never stored), and the dγ/dβ reductions
           fuse into one pass over (x, dy). Values equal "folded" exactly;
           gradients equal autodiff within reduction-order rounding.
+        - "sdot" — the "folded" normalize, but batch statistics computed as
+          MXU dots (_bn_moments_dot): the one family whose statistics are
+          not bit-identical to the others (f32 accumulation order on the
+          MXU; ~1e-7 rel). Opt-in for the hardware A/B against the VPU
+          stat-reduce share of the trace.
+        - "compute_sdot" — the "compute" (bf16 FMA) normalize over the
+          MXU-dot statistics: the composite of the two independent levers,
+          so the A/B can measure their combination directly instead of
+          inferring additivity.
         """
         if mode not in BN_MODES:
             raise ValueError(f"unknown bn mode {mode!r}")
@@ -318,7 +360,8 @@ class BatchNorm:
                 n = n * lax.psum(1, axis_name)
             return y, running(mean, var, n)
         if train:
-            mean, var, n = _bn_moments(x, axis_name)
+            moments = _bn_moments_dot if mode in ("sdot", "compute_sdot") else _bn_moments
+            mean, var, n = moments(x, axis_name)
             new_state = running(mean, var, n)
         else:
             mean, var = state["mean"], state["var"]
@@ -326,10 +369,10 @@ class BatchNorm:
         scale = lax.rsqrt(var + self.eps) * params["gamma"]
         if mode == "exact":
             y = (x.astype(jnp.float32) - mean) * scale + params["beta"]
-        elif mode == "compute":
+        elif mode in ("compute", "compute_sdot"):
             bias = params["beta"] - mean * scale
             y = x * scale.astype(out_dtype) + bias.astype(out_dtype)
-        else:  # "folded", and eval-mode "fused_vjp" (same expression)
+        else:  # "folded"/"sdot", and eval-mode "fused_vjp" (same expression)
             bias = params["beta"] - mean * scale
             y = x.astype(jnp.float32) * scale + bias
         return y.astype(out_dtype), new_state
