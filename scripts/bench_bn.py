@@ -177,6 +177,12 @@ def main():
                     help="force the CPU backend (the sandbox's sitecustomize "
                          "force-selects the axon TPU platform otherwise, and a "
                          "dead tunnel burns ~25 min in backend init)")
+    ap.add_argument("--dispatch-probe", action="store_true",
+                    help="after the variants, time the exact/no-remat config "
+                         "both as chained per-step dispatches and as ONE "
+                         "lax.scan(iters) dispatch; the delta is the per-step "
+                         "host-dispatch/tunnel tax the chained methodology "
+                         "includes and the MFU math should know about")
     ap.add_argument("--xla-flags-sweep", action="store_true",
                     help="sweep --flag-sets over the BENCH_TUNING.json winner "
                          "(one child process per flag set) instead of the variant A/B")
@@ -255,7 +261,8 @@ def main():
             "bench": "bn_mode_train_step_ab", "platform": platform, "device_kind": kind,
             "batch": args.batch, "image_size": args.image_size, "iters": args.iters,
             "dtype": "bfloat16",
-            "variants_completed": len(rows), "variants_planned": len(variants),
+            "variants_completed": len(rows),
+            "variants_planned": len(variants) + (1 if args.dispatch_probe else 0),
             "partial": partial,
             "method": "chained train steps, device_get(loss) barrier (PROFILE.md methodology)",
             "rows": rows,
@@ -297,7 +304,73 @@ def main():
         # free the variant's buffers before building the next one
         step_fn = ts = b = None
 
+    # secure the complete A/B artifact BEFORE the diagnostic probe: a probe
+    # failure (OOM from the un-donated scan state, a dying window) must
+    # never void 11 measured variants — the watcher would discard the
+    # scarce window and re-run everything
+    emit(partial=False)
+    if args.dispatch_probe:
+        try:
+            rows.append(_dispatch_probe(args, build_train_fixture, sync))
+        except Exception as e:
+            log(f"dispatch probe failed ({type(e).__name__}: {e}); A/B artifact unaffected")
+
     print(json.dumps(emit(partial=False)), flush=True)
+
+
+def _dispatch_probe(args, build_train_fixture, sync):
+    """One scan-of-steps dispatch vs per-step chained dispatches, same
+    exact/no-remat config. The scan number is device-only time; chained −
+    scan ≈ the per-step dispatch/tunnel overhead baked into every chained
+    measurement (and into the headline MFU denominator). The row's bn_mode
+    is deliberately NOT a valid mode token so the watcher's adoption rule
+    can never pick it as a winner."""
+    import jax
+    from jax import lax
+
+    key = jax.random.PRNGKey(0)
+    step_fn, ts, b, _ = build_train_fixture(args.batch, args.image_size)
+
+    def scan_n(ts, b, rng):
+        def body(carry, _):
+            new_ts, metrics = step_fn(carry, b, rng)  # jitted fn inlines under trace
+            return new_ts, metrics["loss"]
+        return lax.scan(body, ts, None, length=args.iters)
+
+    # scan FIRST: step_fn donates its TrainState argument, so the chained
+    # loop must only run once the scan is done with `ts` (scan_jit itself
+    # does not donate; the inlined step's donation is ignored under trace)
+    scan_jit = jax.jit(scan_n)
+    ts2, losses = scan_jit(ts, b, key)  # compile + first scan
+    sync(losses[-1])
+    t0 = time.perf_counter()
+    ts2, losses = scan_jit(ts2, b, key)
+    loss = sync(losses[-1])
+    ms_scan = (time.perf_counter() - t0) / args.iters * 1e3
+
+    # chained baseline (same methodology as the variant rows, INCLUDING the
+    # 3-step warmup — first post-compile steps run slow, and an unwarmed
+    # chained number would inflate the dispatch tax the probe exists to
+    # measure)
+    ts1, metrics = step_fn(ts, b, key)
+    sync(metrics["loss"])
+    for _ in range(3):
+        ts1, metrics = step_fn(ts1, b, key)
+    sync(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        ts1, metrics = step_fn(ts1, b, key)
+    sync(metrics["loss"])
+    ms_chain = (time.perf_counter() - t0) / args.iters * 1e3
+    log(f"  dispatch probe: chained {ms_chain:.2f} ms/step vs scan {ms_scan:.2f} ms/step "
+        f"-> {ms_chain - ms_scan:+.2f} ms/step dispatch tax")
+    return {
+        "bn_mode": f"exact[scan{args.iters}]", "remat": "off", "conv1x1_dot": False,
+        "ms_per_step": round(ms_scan, 2), "ms_per_step_chained": round(ms_chain, 2),
+        "dispatch_tax_ms": round(ms_chain - ms_scan, 2), "loss": round(loss, 4),
+        "img_s_per_chip": round(args.batch / ms_scan * 1e3 / len(jax.devices()), 1),
+        "note": "scan row is device-only time; not an adoptable variant",
+    }
 
 
 if __name__ == "__main__":

@@ -386,7 +386,8 @@ def run_session(args) -> bool:
         log("fresh complete A/B artifact already present; skipping straight to decision")
     else:
         r1 = _run_job(
-            [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"), "--out", ab_path],
+            [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
+             "--dispatch-probe", "--out", ab_path],
             AB_TIMEOUT_S, "bench_bn A/B")
         if r1 is None or r1.returncode != 0 or not _fresh_complete_ab(ab_path):
             log("A/B failed or incomplete (window closed?); will keep watching")

@@ -1,0 +1,146 @@
+"""Host-side decision logic of the standing TPU watcher (scripts/tpu_watch.py):
+the >3% adoption rules run unattended in a scarce alive window, so their
+edge cases — key ownership between the A/B and sweep decisions, stale-state
+cleanup, the better-headline guard — are pinned here instead of being
+discovered mid-window. Pure JSON/process-free tests (no jax, no backend)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture()
+def tw(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "scripts", "tpu_watch.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "TUNING_PATH", str(tmp_path / "BENCH_TUNING.json"))
+    mod._tmp = tmp_path
+    return mod
+
+
+def _ab(tmp, rows):
+    p = str(tmp / "ab.json")
+    json.dump({"platform": "tpu", "device_kind": "TPU v5 lite", "rows": rows}, open(p, "w"))
+    return p
+
+
+def _sweep(tmp, rows):
+    p = str(tmp / "sw.json")
+    json.dump({"bench": "xla_flags_sweep", "rows": rows}, open(p, "w"))
+    return p
+
+
+def _row(mode, ms, remat="off", dot=False, loss=6.9):
+    return {"bn_mode": mode, "remat": remat, "conv1x1_dot": dot,
+            "ms_per_step": ms, "loss": loss, "img_s_per_chip": round(256e3 / ms, 1)}
+
+
+def test_ab_win_adopts_and_preserves_sweep_flags(tw):
+    tw._write_tuning({"flags": "--xla_tpu_rwb_fusion=false", "flags_source": "earlier"})
+    tw.decide(_ab(tw._tmp, [_row("exact", 35.7), _row("folded", 33.0, loss=6.9001)]),
+              str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert t["bn_mode"] == "folded" and t["flags"] == "--xla_tpu_rwb_fusion=false"
+    dec = json.load(open(tw._tmp / "dec.json"))
+    assert dec["adopted"] and dec["winner"]["speedup_vs_exact"] == pytest.approx(35.7 / 33.0, abs=1e-3)
+
+
+def test_ab_no_win_clears_only_ab_keys(tw):
+    tw._write_tuning({"bn_mode": "folded", "source": "old", "flags": "--xla_a=1", "flags_source": "s"})
+    tw.decide(_ab(tw._tmp, [_row("exact", 35.7), _row("folded", 35.5)]),
+              str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert "bn_mode" not in t and t["flags"] == "--xla_a=1"
+
+
+def test_ab_sub_threshold_and_loss_sanity(tw):
+    # 2% is under the rule; a >3% candidate with a broken loss is rejected
+    tw.decide(_ab(tw._tmp, [_row("exact", 35.7), _row("folded", 35.0),
+                            _row("fused_vjp", 30.0, loss=8.5)]),
+              str(tw._tmp / "dec.json"), allow_compute=False)
+    assert not os.path.exists(tw.TUNING_PATH)
+    assert json.load(open(tw._tmp / "dec.json"))["adopted"] is False
+
+
+def test_compute_family_gated_on_allow_compute(tw):
+    rows = [_row("exact", 35.7), _row("compute_sdot", 28.0, loss=6.903)]
+    tw.decide(_ab(tw._tmp, rows), str(tw._tmp / "dec.json"), allow_compute=False)
+    assert not os.path.exists(tw.TUNING_PATH)
+    tw.decide(_ab(tw._tmp, rows), str(tw._tmp / "dec.json"), allow_compute=True)
+    assert tw._read_tuning()["bn_mode"] == "compute_sdot"
+
+
+def test_ab_winner_maps_remat_and_dot_tokens(tw):
+    tw.decide(_ab(tw._tmp, [_row("exact", 35.7), _row("exact", 32.0, remat="save_conv", dot=True)]),
+              str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert t == {"bn_mode": "exact", "remat": True, "remat_policy": "save_conv",
+                 "conv1x1_dot": True, "source": t["source"]}
+
+
+def test_sweep_win_merges_flags_and_no_win_removes_empty_file(tw):
+    tw._write_tuning({"bn_mode": "folded", "source": "ab"})
+    tw.decide_sweep(_sweep(tw._tmp, [{"flags": "", "ms_per_step": 35.7},
+                                     {"flags": "--xla_tpu_scoped_vmem_limit_kib=98304",
+                                      "ms_per_step": 33.0}]),
+                    str(tw._tmp / "dsw.json"))
+    t = tw._read_tuning()
+    assert t["bn_mode"] == "folded" and t["flags"].endswith("98304")
+
+    # flags-only tuning + no-win: the file must be REMOVED, not left stale
+    tw._write_tuning({"flags": "--xla_a=1", "flags_source": "s"})
+    tw.decide_sweep(_sweep(tw._tmp, [{"flags": "", "ms_per_step": 35.7},
+                                     {"flags": "--xla_a=1", "ms_per_step": 35.6},
+                                     {"flags": "--xla_b=1", "error": "child rc=1"}]),
+                    str(tw._tmp / "dsw.json"))
+    assert not os.path.exists(tw.TUNING_PATH)
+
+
+def test_record_headline_keeps_better_session_number(tw):
+    class R:
+        returncode = 0
+        stderr = ""
+
+        def __init__(self, value):
+            self.stdout = json.dumps({"metric": "m", "value": value, "platform": "tpu"})
+
+    hp = str(tw._tmp / "head.json")
+    assert tw._record_headline(R(7000.0), hp)
+    assert json.load(open(hp))["value"] == 7000.0
+    # a worse re-run (e.g. under adopted flags) must not overwrite
+    assert tw._record_headline(R(6500.0), hp)
+    assert json.load(open(hp))["value"] == 7000.0
+    assert tw._record_headline(R(7500.0), hp)
+    assert json.load(open(hp))["value"] == 7500.0
+    # CPU-fallback / value-less output never counts as a headline
+    class Bad(R):
+        def __init__(self):
+            self.stdout = json.dumps({"metric": "m", "value": 9.5, "platform": "cpu"})
+    assert not tw._record_headline(Bad(), str(tw._tmp / "head2.json"))
+
+
+def test_run_trace_builds_cli_overrides_from_tuning(tw, monkeypatch):
+    tw._write_tuning({"bn_mode": "compute_sdot", "conv1x1_dot": True, "remat": True,
+                      "remat_policy": "save_conv", "flags": "--xla_tpu_rwb_fusion=false"})
+    captured = []
+    monkeypatch.setattr(tw, "_run_job",
+                        lambda cmd, t, label, env=None: captured.append((label, cmd, env)) and None)
+    tw.run_trace(9)
+    label, cmd, env = captured[0]
+    assert "train.bn_mode=compute_sdot" in cmd and "train.conv1x1_dot=true" in cmd
+    assert "train.remat=true" in cmd and "train.remat_policy=save_conv" in cmd
+    assert any(a.startswith("train.profile_start_step=") for a in cmd)
+    assert env["LIBTPU_INIT_ARGS"].endswith("--xla_tpu_rwb_fusion=false")
+
+
+def test_sweep_budget_covers_all_children(tw):
+    # the outer sweep budget must cover every child hitting its own timeout
+    # (the designed dead-window path) — r4 review finding, kept pinned
+    assert tw.SWEEP_TIMEOUT_S > 5 * tw.SWEEP_CHILD_S

@@ -149,9 +149,12 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
     - imagenet/TFRecord: epoch-faithful continuation — the per-epoch file
       order is keyed statelessly by (seed, epoch) and the stream starts at
       start_step's epoch with the intra-epoch remainder of records skipped
-      pre-decode. Record-level EXACTNESS additionally requires
-      decode_threads=1 and shuffle_buffer=1 (what the resume tests pin):
-      under production settings the parallel interleave
+      pre-decode. Record-level EXACTNESS requires
+      cfg.deterministic_input — single-stream deterministic interleave with
+      the (seed, epoch) file permutation as the only shuffle — or,
+      equivalently, decode_threads=1 + shuffle_buffer=1 (the resume tests
+      pin both forms): under default production settings the parallel
+      interleave
       (deterministic=False, kept for throughput) reorders records, and the
       resume point restarts the shuffle buffer — up to shuffle_buffer
       records that sat unemitted in the interrupted run's buffer are
@@ -199,12 +202,19 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
     ds = tf.data.Dataset.range(start_epoch, tf.int64.max).flat_map(epoch_files)
     ds = ds.interleave(
         lambda f: tf.data.TFRecordDataset(f, buffer_size=16 * 1024 * 1024),
-        cycle_length=cfg.decode_threads,
-        num_parallel_calls=tf.data.AUTOTUNE,
-        deterministic=False,
+        # deterministic_input buys record-exact resume (and run-to-run
+        # reproducible record order) at interleave-parallelism cost; the
+        # default keeps throughput and accepts the one-buffer resume
+        # approximation documented above
+        cycle_length=1 if cfg.deterministic_input else cfg.decode_threads,
+        num_parallel_calls=1 if cfg.deterministic_input else tf.data.AUTOTUNE,
+        deterministic=bool(cfg.deterministic_input),
     )
     ds = ds.skip(skip_records)  # serialized records: skipped without decoding
-    ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
+    if not cfg.deterministic_input:
+        # under deterministic_input the (seed, epoch) file permutation IS the
+        # shuffle; a stateful record buffer would reintroduce resume drift
+        ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
 
     def map_fn(serialized):
         image_bytes, label = _parse_example(tf, serialized)
