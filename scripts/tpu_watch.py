@@ -389,9 +389,15 @@ def run_session(args) -> bool:
             [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
              "--dispatch-probe", "--out", ab_path],
             AB_TIMEOUT_S, "bench_bn A/B")
-        if r1 is None or r1.returncode != 0 or not _fresh_complete_ab(ab_path):
+        # the ARTIFACT gates the session, not the exit code: the variants
+        # emit a complete artifact before the best-effort dispatch probe, so
+        # a probe-stage death (OOM kill, hang into the timeout) must not
+        # discard 11 measured variants and abandon the alive window
+        if not _fresh_complete_ab(ab_path):
             log("A/B failed or incomplete (window closed?); will keep watching")
             return False
+        if r1 is None or r1.returncode != 0:
+            log("A/B artifact complete but the probe stage died; continuing the session")
     try:
         decide(ab_path, decision_path, args.allow_compute)
     except Exception as e:  # a decision bug must not cost the alive window

@@ -221,3 +221,52 @@ def test_check_vma_contract():
             assert isinstance(kw["check_vma"], ast.Constant) and kw["check_vma"].value is False, (
                 f"{module.__name__}:{call.lineno}: check_vma is not the literal False — "
                 "revisit ops/layers.py _bn_train_fused_bwd before changing this")
+
+
+def test_grouped_step_equals_single_steps(setup):
+    """steps_per_dispatch semantics: k steps in ONE jit dispatch
+    (dp.make_grouped_train_step) equal k single dispatches — same batches
+    in the same order, same per-step rng fold (via ts.step) — up to XLA
+    fusion-boundary rounding: compiling k steps as one program lets XLA
+    fuse ACROSS steps, so f32 reduction orders differ at ~1e-7 rel
+    (measured; bit-identity is NOT the contract, unlike remat)."""
+    cfg, net, lr_fn, opt, ts0, _ = setup
+    m = mesh_lib.make_mesh(8)
+    rng = jax.random.PRNGKey(9)
+    batches = [
+        mesh_lib.shard_batch({
+            "image": np.asarray(jax.random.normal(jax.random.PRNGKey(10 + i), (16, 16, 16, 3))),
+            "label": np.asarray((jnp.arange(16) + i) % 8),
+        }, m)
+        for i in range(4)
+    ]
+    step = dp.make_dp_train_step(net, cfg, opt, lr_fn, m)
+
+    # independent copies per path: the steps donate, and on fake CPU devices
+    # replication can alias the source buffers (see the fixture note)
+    ts_single = mesh_lib.replicate(jax.tree.map(jnp.copy, ts0), m)
+    single_metrics = []
+    for b in batches:
+        ts_single, met = step(ts_single, b, rng)
+        single_metrics.append(met)
+    params_single = jax.device_get(ts_single.params)
+
+    grouped = dp.make_grouped_train_step(step, 2)
+    ts_grp = mesh_lib.replicate(jax.tree.map(jnp.copy, ts0), m)
+    grouped_metrics = []
+    ts_grp, mets = grouped(ts_grp, tuple(batches[:2]), rng)
+    grouped_metrics += mets
+    ts_grp, mets = grouped(ts_grp, tuple(batches[2:]), rng)
+    grouped_metrics += mets
+    params_grp = jax.device_get(ts_grp.params)
+
+    assert int(ts_grp.step) == 4
+    for a, b in zip(jax.tree.leaves(params_single), jax.tree.leaves(params_grp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+    for i, (ms, mg) in enumerate(zip(single_metrics, grouped_metrics)):
+        for key in ("loss", "grad_norm", "top1", "lr"):
+            np.testing.assert_allclose(float(ms[key]), float(mg[key]),
+                                       rtol=1e-5, err_msg=f"step {i} {key}")
+
+    with pytest.raises(ValueError, match="k >= 2"):
+        dp.make_grouped_train_step(step, 1)

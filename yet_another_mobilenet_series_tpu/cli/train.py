@@ -337,84 +337,107 @@ def run(cfg: Config) -> dict:
     remat_cad = StepCadence(cfg.prune.remat_epochs, spe, host_step)
     best_ckpt: CheckpointManager | None = None  # created on first new-best eval
 
+    # multi-step dispatch (train.steps_per_dispatch): k steps per jit call,
+    # amortizing the per-step host-dispatch/tunnel tax the bench's
+    # --dispatch-probe measures. Per-step HOST features (pruning mask
+    # updates, the profiler window) need step-granular host control, so
+    # they force k=1 with a visible warning instead of silently changing
+    # semantics.
+    k_dispatch = max(1, cfg.train.steps_per_dispatch)
+    if k_dispatch > 1 and (cfg.prune.enable or cfg.train.profile_start_step):
+        log.log("WARNING: steps_per_dispatch>1 is incompatible with pruning/profiler "
+                "window; forcing 1")
+        k_dispatch = 1
+    grouped_step = dp.make_grouped_train_step(trainer.train_step, k_dispatch) if k_dispatch > 1 else None
+
     try:
         while epoch < total_epochs:
             epoch_steps = min(spe, max(int((total_epochs - epoch) * spe), 1))
             t_epoch = time.perf_counter()
-            for _ in range(epoch_steps):
-                b = next(train_iter)  # already on-mesh (prefetch_to_mesh)
-                ts, metrics = trainer.train_step(ts, b, rng)
-                # host-side counter: int(ts.step) would sync the host with the
-                # device every step and stall async dispatch
-                host_step += 1
-                step_i = host_step
-                metric_log.update(metrics, batch_images=cfg.train.batch_size)
+            steps_done = 0
+            while steps_done < epoch_steps:
+                if grouped_step is not None and epoch_steps - steps_done >= k_dispatch:
+                    bs = tuple(next(train_iter) for _ in range(k_dispatch))
+                    ts, metric_list = grouped_step(ts, bs, rng)
+                else:
+                    b = next(train_iter)  # already on-mesh (prefetch_to_mesh)
+                    ts, metrics = trainer.train_step(ts, b, rng)
+                    metric_list = [metrics]
+                steps_done += len(metric_list)
+                # per-sub-step host processing: metrics entries are lazy
+                # device arrays; nothing below syncs unless a cadence fires
+                for metrics in metric_list:
+                    # host-side counter: int(ts.step) would sync the host
+                    # with the device every step and stall async dispatch
+                    host_step += 1
+                    step_i = host_step
+                    metric_log.update(metrics, batch_images=cfg.train.batch_size)
 
-                if cfg.train.profile_start_step and is_coord:
-                    if step_i == cfg.train.profile_start_step:
-                        jax.profiler.start_trace(cfg.train.log_dir + "/trace")
-                        trace_active = True
-                    elif trace_active and step_i >= cfg.train.profile_start_step + cfg.train.profile_num_steps:
-                        # true barrier before closing the trace: through the
-                        # axon tunnel block_until_ready can return at
-                        # dispatch-acknowledge and truncate the trace window
-                        # (PROFILE.md "measurement methodology")
-                        jax.device_get(metrics["loss"])
-                        jax.profiler.stop_trace()
-                        trace_active = False
-                        log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
+                    if cfg.train.profile_start_step and is_coord:
+                        if step_i == cfg.train.profile_start_step:
+                            jax.profiler.start_trace(cfg.train.log_dir + "/trace")
+                            trace_active = True
+                        elif trace_active and step_i >= cfg.train.profile_start_step + cfg.train.profile_num_steps:
+                            # true barrier before closing the trace: through the
+                            # axon tunnel block_until_ready can return at
+                            # dispatch-acknowledge and truncate the trace window
+                            # (PROFILE.md "measurement methodology")
+                            jax.device_get(metrics["loss"])
+                            jax.profiler.stop_trace()
+                            trace_active = False
+                            log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
 
-                if (
-                    cfg.prune.enable
-                    and trainer.mask_update is not None
-                    and step_i % cfg.prune.mask_interval == 0
-                    and step_i <= prune_stop_step
-                ):
-                    # mask_summary is a host sync (np.asarray on device masks);
-                    # only pay it when a target-FLOPs decision needs it
-                    reached = False
-                    if cfg.prune.target_flops:
-                        summary = masking.mask_summary(trainer.net, ts.masks)
-                        reached = summary["effective_macs"] <= cfg.prune.target_flops
-                    if cfg.prune.rho_schedule == "adaptive" and cfg.prune.target_flops:
-                        # FLOPs-gap feedback: push harder while above target,
-                        # anneal once reached (SURVEY.md §2 #11)
-                        rate = cfg.prune.rho_adapt_rate
-                        rho_mult_host *= (1.0 - rate) if reached else (1.0 + rate)
-                        rho_mult_host = min(max(rho_mult_host, cfg.prune.rho_adapt_min), cfg.prune.rho_adapt_max)
-                        ts = ts.replace(
-                            rho_mult=mesh_lib.replicate(np.float32(rho_mult_host), trainer.mesh)
-                        )
-                    if not reached:
-                        ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
+                    if (
+                        cfg.prune.enable
+                        and trainer.mask_update is not None
+                        and step_i % cfg.prune.mask_interval == 0
+                        and step_i <= prune_stop_step
+                    ):
+                        # mask_summary is a host sync (np.asarray on device masks);
+                        # only pay it when a target-FLOPs decision needs it
+                        reached = False
+                        if cfg.prune.target_flops:
+                            summary = masking.mask_summary(trainer.net, ts.masks)
+                            reached = summary["effective_macs"] <= cfg.prune.target_flops
+                        if cfg.prune.rho_schedule == "adaptive" and cfg.prune.target_flops:
+                            # FLOPs-gap feedback: push harder while above target,
+                            # anneal once reached (SURVEY.md §2 #11)
+                            rate = cfg.prune.rho_adapt_rate
+                            rho_mult_host *= (1.0 - rate) if reached else (1.0 + rate)
+                            rho_mult_host = min(max(rho_mult_host, cfg.prune.rho_adapt_min), cfg.prune.rho_adapt_max)
+                            ts = ts.replace(
+                                rho_mult=mesh_lib.replicate(np.float32(rho_mult_host), trainer.mesh)
+                            )
+                        if not reached:
+                            ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
 
-                if step_i % cfg.train.log_every == 0:
-                    snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
-                    if cfg.prune.enable:
-                        snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
-                        if cfg.prune.rho_schedule == "adaptive":
-                            snap["rho_mult"] = rho_mult_host
-                    if cfg.data.loader == "native":
-                        # corrupt inputs must be visible, not silent
-                        # (train path resamples; the counter still climbs)
-                        from ..data import native_loader as _nl
+                    if step_i % cfg.train.log_every == 0:
+                        snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
+                        if cfg.prune.enable:
+                            snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
+                            if cfg.prune.rho_schedule == "adaptive":
+                                snap["rho_mult"] = rho_mult_host
+                        if cfg.data.loader == "native":
+                            # corrupt inputs must be visible, not silent
+                            # (train path resamples; the counter still climbs)
+                            from ..data import native_loader as _nl
 
-                        snap["decode_failures"] = float(_nl.total_decode_failures())
-                    log.log(format_metrics(f"step {step_i}:", snap))
-                    log.scalars(step_i, snap, "train/")
-                    if snap.get("finite", 1.0) < 1.0:
-                        log.error("non-finite loss detected; aborting")
-                        raise FloatingPointError("non-finite loss")
-                if cfg.train.check_finite_every and step_i % cfg.train.check_finite_every == 0:
-                    # forced host sync — a debug guard, off by default
-                    if float(metrics["finite"]) < 1.0:
-                        log.error(f"non-finite loss at step {step_i}")
-                        raise FloatingPointError("non-finite loss")
-                if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
-                    div = float(trainer.sync_check(ts.params))
-                    if div != 0.0:
-                        log.error(f"replica divergence {div} at step {step_i}")
-                        raise RuntimeError("replica divergence")
+                            snap["decode_failures"] = float(_nl.total_decode_failures())
+                        log.log(format_metrics(f"step {step_i}:", snap))
+                        log.scalars(step_i, snap, "train/")
+                        if snap.get("finite", 1.0) < 1.0:
+                            log.error("non-finite loss detected; aborting")
+                            raise FloatingPointError("non-finite loss")
+                    if cfg.train.check_finite_every and step_i % cfg.train.check_finite_every == 0:
+                        # forced host sync — a debug guard, off by default
+                        if float(metrics["finite"]) < 1.0:
+                            log.error(f"non-finite loss at step {step_i}")
+                            raise FloatingPointError("non-finite loss")
+                    if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
+                        div = float(trainer.sync_check(ts.params))
+                        if div != 0.0:
+                            log.error(f"replica divergence {div} at step {step_i}")
+                            raise RuntimeError("replica divergence")
             epoch += epoch_steps / spe
             log.log(f"epoch {epoch:.2f} done in {time.perf_counter()-t_epoch:.1f}s")
 

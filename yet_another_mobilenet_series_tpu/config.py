@@ -260,6 +260,15 @@ class TrainConfig:
     # for profile_num_steps steps; trace lands in log_dir/trace. 0 = off.
     profile_start_step: int = 0
     profile_num_steps: int = 5
+    # >1: run this many train steps per host dispatch (one jit call of k
+    # unrolled steps) to amortize per-step dispatch/tunnel latency —
+    # adopt when bench_bn's --dispatch-probe shows a real tax. Same data
+    # order/RNG/resume accounting as single dispatches; numerics agree to
+    # XLA cross-step fusion rounding ~1e-7 (parallel/dp.py
+    # make_grouped_train_step). Forced to 1 (with a logged warning) when
+    # per-step host features are active: pruning mask updates or the
+    # profiler window.
+    steps_per_dispatch: int = 1
 
 
 @dataclass(frozen=True)

@@ -91,6 +91,33 @@ def make_dp_train_step(
     return jax.jit(fn, donate_argnums=(0,))
 
 
+def make_grouped_train_step(step_fn, k: int):
+    """ONE host dispatch running ``k`` sequential train steps: the jitted
+    step inlines under trace, so the program is k unrolled step graphs
+    back-to-back. Amortizes the per-step host-dispatch/tunnel latency that
+    bench_bn's --dispatch-probe measures (PROFILE.md round 4) without any
+    batch-stacking copy — each prefetched on-mesh batch is consumed in
+    place, so data order, RNG folding (per-step via ts.step), and resume
+    accounting are IDENTICAL to k single dispatches. Numerics agree to XLA
+    fusion-boundary rounding (~1e-7 rel, measured: compiling k steps as one
+    program lets XLA fuse across steps — NOT bit-identical, unlike remat;
+    tests/test_parallel.py::test_grouped_step_equals_single_steps).
+
+    Returns grouped(ts, (b_0..b_{k-1}), rng) -> (ts, [metrics_0..]).
+    Compile time scales with k (unrolled); intended for small k (2-8)."""
+    if k < 2:
+        raise ValueError(f"grouped step needs k >= 2, got {k}")
+
+    def grouped(ts: TrainState, batches, rng):
+        out = []
+        for b in batches:
+            ts, metrics = step_fn(ts, b, rng)
+            out.append(metrics)
+        return ts, out
+
+    return jax.jit(grouped, donate_argnums=(0,))
+
+
 def make_dp_eval_step(net: Network, cfg: Config, mesh: Mesh):
     """jitted (params, state, batch, masks) -> summed metric counts."""
     inner = make_eval_step(net, cfg, axis_name=DATA_AXIS)
