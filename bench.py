@@ -84,7 +84,7 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 # must pick the tuned variant up with no extra flags so the driver's
 # end-of-round artifact reflects the repo's best-known configuration.
 TUNING_PATH = os.path.join(REPO_DIR, "BENCH_TUNING.json")
-_TUNING_KEYS = {"bn_mode", "remat", "remat_policy", "conv1x1_dot"}
+_TUNING_KEYS = {"bn_mode", "remat", "remat_policy", "conv1x1_dot", "steps_per_dispatch"}
 
 
 def partition_flags(flags_str: str) -> tuple[str, str]:
@@ -160,6 +160,11 @@ def load_tuning() -> dict:
             raise ValueError("remat must be a bool")
         if not isinstance(tuning.get("conv1x1_dot", False), bool):
             raise ValueError("conv1x1_dot must be a bool")
+        k = tuning.get("steps_per_dispatch", 1)
+        if isinstance(k, bool) or not isinstance(k, int) or not 1 <= k <= 16:
+            # bool is an int subclass: {"steps_per_dispatch": true} would
+            # otherwise silently measure single-step dispatch
+            raise ValueError("steps_per_dispatch must be an int in [1, 16]")
         tuning["source"] = raw.get("source")
         return tuning
     except FileNotFoundError:
@@ -368,10 +373,28 @@ def _worker_body(force_cpu: bool):
     sync(metrics["loss"])
 
     iters = 20 if platform == "tpu" else 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        ts, metrics = step_fn(ts, b, key)
-    sync(metrics["loss"])
+    k_dispatch = int(tuning.get("steps_per_dispatch", 1))
+    if k_dispatch > 1:
+        # measure the ADOPTED production dispatch mode: k steps per jit call
+        # (cli/train.py steps_per_dispatch) — same step math, amortized
+        # host-dispatch tax (the delta bench_bn's --dispatch-probe measured)
+        from yet_another_mobilenet_series_tpu.parallel.dp import make_grouped_train_step
+
+        gstep = make_grouped_train_step(step_fn, k_dispatch)
+        batches = (b,) * k_dispatch
+        groups = max(iters // k_dispatch, 1)
+        iters = groups * k_dispatch
+        ts, mets = gstep(ts, batches, key)  # compile + warm the grouped program
+        sync(mets[-1]["loss"])
+        t0 = time.perf_counter()
+        for _ in range(groups):
+            ts, mets = gstep(ts, batches, key)
+        sync(mets[-1]["loss"])
+    else:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ts, metrics = step_fn(ts, b, key)
+        sync(metrics["loss"])
     dt = time.perf_counter() - t0
     img_s = batch * iters / dt
     img_s_chip = img_s / n_chips
@@ -405,7 +428,8 @@ def _worker_body(force_cpu: bool):
             # remat on / forced policy to full, and the artifact must
             # describe what actually ran
             "bn_mode": bn_mode, "remat": used_remat, "remat_policy": used_policy,
-            "conv1x1_dot": conv1x1_dot, "tuning_source": tuning.get("source"),
+            "conv1x1_dot": conv1x1_dot, "steps_per_dispatch": k_dispatch,
+            "tuning_source": tuning.get("source"),
             # what the process actually ran under (tuned flags arrive via env)
             "xla_flags_env": os.environ.get("XLA_FLAGS", ""),
             "libtpu_init_args_env": os.environ.get("LIBTPU_INIT_ARGS", ""),

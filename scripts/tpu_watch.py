@@ -131,10 +131,21 @@ def _fresh_complete_ab(path: str) -> bool:
     return d.get("partial") is False and d.get("platform") == "tpu"
 
 
-# the A/B decision owns these tuning keys; the sweep decision owns
-# 'flags'/'flags_source' — each preserves the other's keys on every path
+# three owners of BENCH_TUNING.json keys, each preserving the others' keys
+# on every path: the A/B variant decision, the dispatch-probe decision
+# (NOT in _AB_KEYS: a no-win A/B round whose probe died must leave a
+# previously MEASURED dispatch adoption alone — _decide_dispatch is the
+# only writer/clearer of these), and the flag-sweep decision
 _AB_KEYS = ("bn_mode", "remat", "remat_policy", "conv1x1_dot", "source")
+_DISPATCH_KEYS = ("steps_per_dispatch", "steps_per_dispatch_source")
 _FLAG_KEYS = ("flags", "flags_source")
+# dispatch-tax adoption: when the A/B's --dispatch-probe row shows the
+# per-step dispatch overhead is a meaningful slice of the chained step
+# time, turn on modest multi-step dispatch in the tuned config (bench.py
+# measures it grouped; cli train.steps_per_dispatch is the production
+# knob). k=4 amortizes ~75% of the tax at bounded compile-time cost.
+DISPATCH_TAX_THRESHOLD = 0.03
+DISPATCH_K = 4
 
 
 def _read_tuning() -> dict:
@@ -222,9 +233,40 @@ def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
             _drop_stale_ab_tuning("no variant beat the threshold (negative result recorded)")
     else:
         _drop_stale_ab_tuning("A/B has no baseline row")
+    _decide_dispatch(rows, decision)
     with open(decision_path, "w") as f:
         json.dump(decision, f, indent=1)
         f.write("\n")
+
+
+def _decide_dispatch(rows, decision: dict) -> None:
+    """Adopt multi-step dispatch from the A/B's --dispatch-probe row: when
+    the measured per-step dispatch tax exceeds DISPATCH_TAX_THRESHOLD of
+    the chained step time, set steps_per_dispatch=DISPATCH_K in the tuning
+    (bench.py measures grouped; cli train.steps_per_dispatch is the
+    production knob). Independent of which bn_mode variant won — the tax
+    applies to every config. No probe row (probe died): leave any
+    previously-measured value alone."""
+    probe = next((r for r in rows if "dispatch_tax_ms" in r), None)
+    if probe is None or not probe.get("ms_per_step_chained"):
+        decision["dispatch_probe"] = None
+        return
+    frac = probe["dispatch_tax_ms"] / probe["ms_per_step_chained"]
+    decision["dispatch_probe"] = dict(probe, tax_fraction=round(frac, 4))
+    tuning = _read_tuning()
+    if probe["dispatch_tax_ms"] > 0 and frac > DISPATCH_TAX_THRESHOLD:
+        tuning["steps_per_dispatch"] = DISPATCH_K
+        tuning["steps_per_dispatch_source"] = (
+            f"dispatch probe: {probe['dispatch_tax_ms']} ms tax = {frac:.1%} "
+            f"of the chained step")
+        decision["dispatch_adopted"] = True
+        log(f"decision: dispatch tax {frac:.1%} -> steps_per_dispatch={DISPATCH_K}")
+    else:
+        for key in _DISPATCH_KEYS:
+            tuning.pop(key, None)
+        decision["dispatch_adopted"] = False
+        log(f"decision: dispatch tax {frac:.1%} below threshold; single-step dispatch kept")
+    _write_tuning(tuning)
 
 
 def decide_sweep(sweep_path: str, decision_path: str) -> None:
@@ -391,13 +433,26 @@ def run_session(args) -> bool:
             AB_TIMEOUT_S, "bench_bn A/B")
         # the ARTIFACT gates the session, not the exit code: the variants
         # emit a complete artifact before the best-effort dispatch probe, so
-        # a probe-stage death (OOM kill, hang into the timeout) must not
-        # discard 11 measured variants and abandon the alive window
+        # a probe-stage death must not discard 11 measured variants
         if not _fresh_complete_ab(ab_path):
             log("A/B failed or incomplete (window closed?); will keep watching")
             return False
-        if r1 is None or r1.returncode != 0:
-            log("A/B artifact complete but the probe stage died; continuing the session")
+        if r1 is None:
+            # the probe hung and _run_job KILLED it — and a killed TPU job
+            # can wedge the tunnel (module header). Bank the A/B via the
+            # decision step, but do NOT launch more TPU stages into a
+            # possibly-wedged claim; the next alive window fast-paths
+            # straight to the headline off the fresh artifact.
+            log("A/B artifact complete but the probe stage was KILLED at timeout; "
+                "running the decision, then abandoning this window")
+            try:
+                decide(ab_path, decision_path, args.allow_compute)
+            except Exception as e:
+                log(f"decision step failed ({type(e).__name__}: {e})")
+            return False
+        if r1.returncode != 0:
+            log("A/B artifact complete but the probe stage died (nonzero exit); "
+                "continuing the session")
     try:
         decide(ab_path, decision_path, args.allow_compute)
     except Exception as e:  # a decision bug must not cost the alive window

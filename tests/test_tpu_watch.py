@@ -144,3 +144,44 @@ def test_sweep_budget_covers_all_children(tw):
     # the outer sweep budget must cover every child hitting its own timeout
     # (the designed dead-window path) — r4 review finding, kept pinned
     assert tw.SWEEP_TIMEOUT_S > 5 * tw.SWEEP_CHILD_S
+
+
+def test_dispatch_tax_adoption(tw):
+    """The A/B probe row drives steps_per_dispatch: adopted above the tax
+    threshold, cleared below it, untouched when the probe died."""
+    base_rows = [_row("exact", 35.7), _row("folded", 33.0, loss=6.9001)]
+    probe = {"bn_mode": "exact[scan20]", "remat": "off", "conv1x1_dot": False,
+             "ms_per_step": 30.0, "ms_per_step_chained": 35.7,
+             "dispatch_tax_ms": 5.7, "loss": 6.9}
+    # 16% tax -> adopt (alongside the folded win)
+    tw.decide(_ab(tw._tmp, base_rows + [probe]), str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert t["bn_mode"] == "folded" and t["steps_per_dispatch"] == tw.DISPATCH_K
+    dec = json.load(open(tw._tmp / "dec.json"))
+    assert dec["dispatch_adopted"] and dec["dispatch_probe"]["tax_fraction"] == pytest.approx(5.7 / 35.7, abs=1e-4)
+
+    # sub-threshold tax -> cleared (bn_mode win preserved)
+    probe2 = dict(probe, dispatch_tax_ms=0.5, ms_per_step=35.2)
+    tw.decide(_ab(tw._tmp, base_rows + [probe2]), str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert "steps_per_dispatch" not in t and t["bn_mode"] == "folded"
+
+    # probe died -> previous adoption left alone
+    tw._write_tuning(dict(t, steps_per_dispatch=4, steps_per_dispatch_source="earlier"))
+    tw.decide(_ab(tw._tmp, base_rows), str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert t["steps_per_dispatch"] == 4
+    assert json.load(open(tw._tmp / "dec.json"))["dispatch_probe"] is None
+
+
+def test_no_win_round_with_dead_probe_keeps_dispatch_adoption(tw):
+    """Regression (r4 review): a no-win A/B whose dispatch probe died must
+    NOT wipe a previously-measured steps_per_dispatch — only a live probe
+    measurement may adopt or clear it."""
+    tw._write_tuning({"bn_mode": "folded", "source": "old",
+                      "steps_per_dispatch": 4, "steps_per_dispatch_source": "measured r4"})
+    tw.decide(_ab(tw._tmp, [_row("exact", 35.7), _row("folded", 35.5)]),  # no win, no probe row
+              str(tw._tmp / "dec.json"), allow_compute=False)
+    t = tw._read_tuning()
+    assert "bn_mode" not in t  # A/B keys cleared
+    assert t["steps_per_dispatch"] == 4  # dispatch adoption preserved
