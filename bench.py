@@ -195,7 +195,7 @@ def latest_tpu_artifact() -> dict | None:
             best = (rnd, path, d)
     if best is None:
         return None
-    rnd, path, d = best
+    _, path, d = best
     # measured_utc is stamped into the artifact at write time (see
     # _worker_body); file mtime is only a last resort — for a git-tracked
     # artifact it is checkout time, not measurement time, so label it.
@@ -373,7 +373,7 @@ def _worker_body(force_cpu: bool):
     sync(metrics["loss"])
 
     iters = 20 if platform == "tpu" else 5
-    k_dispatch = int(tuning.get("steps_per_dispatch", 1))
+    k_dispatch = tuning.get("steps_per_dispatch", 1)  # validated int (load_tuning)
     if k_dispatch > 1:
         # measure the ADOPTED production dispatch mode: k steps per jit call
         # (cli/train.py steps_per_dispatch) — same step math, amortized

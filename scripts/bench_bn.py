@@ -42,7 +42,6 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 
 
 def log(msg):
@@ -67,8 +66,10 @@ DEFAULT_FLAG_SETS = (
 
 def _variant_token_from_tuning() -> str:
     """BENCH_TUNING.json winner as a --variants token, else the baseline."""
+    from bench import TUNING_PATH  # single source for the tuning-file path
+
     try:
-        with open(os.path.join(REPO, "BENCH_TUNING.json")) as f:
+        with open(TUNING_PATH) as f:
             raw = json.load(f)
         mode = raw.get("bn_mode", "exact")
         if raw.get("remat", False):
