@@ -113,8 +113,8 @@ def test_tf_color_jitter_matches_native_semantics():
     img = tf.fill([32, 32, 3], 128.0)
     ratios = []
     for i in range(32):
-        tf.random.set_seed(i)
-        out = data_lib._color_jitter(tf, img, s).numpy()
+        seed2 = tf.constant([7, i], tf.int64)  # stateless: keyed per sample
+        out = data_lib._color_jitter(tf, img, s, seed2).numpy()
         assert float(out.std()) < 1e-3  # uniform in, uniform out
         ratios.append(float(out.mean()) / 128.0)
     ratios = np.asarray(ratios)
@@ -132,10 +132,14 @@ def test_tf_color_jitter_exact_semantics():
     s = 0.4
     rng = np.random.RandomState(3)
     img_np = rng.uniform(0, 255, (6, 6, 3)).astype(np.float32)
-    tf.random.set_seed(123)
-    fb, fc, fs = (float(tf.random.uniform([], 1 - s, 1 + s)) for _ in range(3))
-    tf.random.set_seed(123)
-    out = data_lib._color_jitter(tf, tf.constant(img_np), s).numpy()
+    seed2 = tf.constant([123, 5], tf.int64)
+    # stateless draws: replay the exact per-factor keys _color_jitter uses
+    fb, fc, fs = (
+        float(tf.random.stateless_uniform([], seed=seed2 + tf.constant([o, 0], tf.int64),
+                                          minval=1 - s, maxval=1 + s))
+        for o in (1, 2, 3)
+    )
+    out = data_lib._color_jitter(tf, tf.constant(img_np), s, seed2).numpy()
 
     lum = np.array([0.2989, 0.587, 0.114], np.float32)
     x = np.clip(img_np * fb, 0, 255)

@@ -64,9 +64,10 @@ def test_train_transform_statistics():
     cfg = DataConfig(image_size=64, rrc_area_min=0.25)
     jpeg, _ = _make_jpeg(128, 128)
     outs = []
-    tf.random.set_seed(0)
-    for _ in range(8):
-        img = data_lib._decode_and_random_crop(tf, tf.constant(jpeg), cfg)
+    for i in range(8):
+        # stateless crop: the per-sample key is what varies the windows
+        seed2 = tf.constant([0, i], tf.int64)
+        img = data_lib._decode_and_random_crop(tf, tf.constant(jpeg), cfg, seed2)
         outs.append(data_lib._normalize(tf, img, cfg).numpy())
     outs = np.stack(outs)
     assert outs.shape == (8, 64, 64, 3)

@@ -124,12 +124,12 @@ class DataConfig:
     rrc_ratio_min: float = 0.75
     rrc_ratio_max: float = 1.3333333333333333
     color_jitter: float = 0.0  # brightness/contrast/saturation strength, 0=off
-    # record-exact TFRecord streams: single-stream deterministic interleave,
-    # no record shuffle buffer (the stateless (seed, epoch) file permutation
-    # is the shuffle) -> resume is record-exact and the RECORD ORDER is
-    # run-to-run reproducible (augmentations still draw stateful TF RNG, so
-    # pixels are not bitwise-reproducible), at host decode-parallelism cost.
-    # Off = production throughput with the one-buffer resume approximation
+    # bitwise-reproducible TFRecord streams: single-stream deterministic
+    # interleave, no record shuffle buffer (the stateless (seed, epoch)
+    # file permutation is the shuffle). Augmentations are stateless (keyed
+    # by stream position), so resume and rebuilds reproduce PIXELS, not
+    # just record order — at host decode-parallelism cost. Off = production
+    # throughput with the one-buffer resume approximation
     # (data/pipeline.py make_train_dataset).
     deterministic_input: bool = False
     mean: Sequence[float] = (0.485, 0.456, 0.406)

@@ -45,13 +45,20 @@ def _tf_mod():
 # ---------------------------------------------------------------------------
 
 
-def _decode_and_random_crop(tf, image_bytes, cfg: DataConfig):
-    """Inception-style random-resized-crop, the reference's train transform."""
+def _decode_and_random_crop(tf, image_bytes, cfg: DataConfig, seed2):
+    """Inception-style random-resized-crop, the reference's train transform.
+
+    STATELESS randomness keyed by seed2 = [seed, stream position] (like the
+    native C++ loader's (seed, global_batch, i) keying): augmentations are a
+    pure function of the record's position, so a deterministic_input stream
+    is bitwise-reproducible end-to-end and a resumed stream reproduces the
+    uninterrupted run's pixels, not just its records."""
     shape = tf.io.extract_jpeg_shape(image_bytes)
     bbox = tf.constant([0.0, 0.0, 1.0, 1.0], dtype=tf.float32, shape=[1, 1, 4])
-    begin, size, _ = tf.image.sample_distorted_bounding_box(
+    begin, size, _ = tf.image.stateless_sample_distorted_bounding_box(
         shape,
         bounding_boxes=bbox,
+        seed=seed2,
         min_object_covered=0.1,
         aspect_ratio_range=(cfg.rrc_ratio_min, cfg.rrc_ratio_max),
         area_range=(cfg.rrc_area_min, cfg.rrc_area_max),
@@ -81,23 +88,29 @@ def _decode_center_crop(tf, image_bytes, cfg: DataConfig):
     return tf.image.crop_to_bounding_box(image, top, left, cfg.image_size, cfg.image_size)
 
 
-def _color_jitter(tf, image, strength: float):
+def _color_jitter(tf, image, strength: float, seed2):
     """torchvision-ColorJitter semantics on a [0,255] float image, fixed
     order brightness→contrast→saturation: brightness multiplies (additive
     tf.image.random_brightness would be a no-op at this scale), contrast
     blends with the mean of the grayscale image, saturation blends with the
     per-pixel grayscale; each op clamps. The native C++ loader implements
     the identical definition (native/yamt_loader.cc color_jitter) so the two
-    loaders' augmentations agree."""
+    loaders' augmentations agree. Stateless draws keyed by seed2 + a
+    per-factor offset (same distributions as the stateful originals)."""
     lo, hi = 1.0 - strength, 1.0 + strength
-    image = tf.clip_by_value(image * tf.random.uniform([], lo, hi), 0.0, 255.0)
+
+    def draw(offset):
+        return tf.random.stateless_uniform([], seed=seed2 + tf.constant([offset, 0], tf.int64),
+                                           minval=lo, maxval=hi)
+
+    image = tf.clip_by_value(image * draw(1), 0.0, 255.0)
     gray = tf.image.rgb_to_grayscale(image)  # luminance weights .2989/.587/.114
     gm = tf.reduce_mean(gray)
-    image = tf.clip_by_value(gm + (image - gm) * tf.random.uniform([], lo, hi), 0.0, 255.0)
+    image = tf.clip_by_value(gm + (image - gm) * draw(2), 0.0, 255.0)
     # saturation blends with the grayscale of the POST-contrast image
     # (recomputed, as the C++ loader does) — not the pre-contrast gray
     gray = tf.image.rgb_to_grayscale(image)
-    image = tf.clip_by_value(gray + (image - gray) * tf.random.uniform([], lo, hi), 0.0, 255.0)
+    image = tf.clip_by_value(gray + (image - gray) * draw(3), 0.0, 255.0)
     return image
 
 
@@ -215,13 +228,26 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
         # under deterministic_input the (seed, epoch) file permutation IS the
         # shuffle; a stateful record buffer would reintroduce resume drift
         ds = ds.shuffle(cfg.shuffle_buffer, seed=seed + 1)
+    # stream position (= records consumed, matching the uninterrupted run's
+    # numbering) keys the per-record stateless augmentation RNG: the same
+    # position draws the same crop/flip/jitter whether reached by streaming
+    # or by resume
+    ds = ds.enumerate(start=start_records)
 
-    def map_fn(serialized):
+    # per-host seed offset (the native loader's convention,
+    # native_loader.make_native_train_iter): without it every host would
+    # draw the SAME crop/flip/jitter parameters at the same stream
+    # position, correlating augmentations across the global batch
+    aug_seed = seed + process_index
+
+    def map_fn(pos, serialized):
+        seed2 = tf.stack([tf.constant(aug_seed, tf.int64), pos])
         image_bytes, label = _parse_example(tf, serialized)
-        image = _decode_and_random_crop(tf, image_bytes, cfg)
-        image = tf.image.random_flip_left_right(image)
+        image = _decode_and_random_crop(tf, image_bytes, cfg, seed2)
+        image = tf.image.stateless_random_flip_left_right(
+            image, seed2 + tf.constant([4, 0], tf.int64))
         if cfg.color_jitter > 0:
-            image = _color_jitter(tf, image, cfg.color_jitter)
+            image = _color_jitter(tf, image, cfg.color_jitter, seed2)
         image = _normalize(tf, image, cfg)
         image.set_shape([cfg.image_size, cfg.image_size, 3])
         return {"image": image, "label": label}
