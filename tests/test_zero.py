@@ -262,3 +262,47 @@ def test_zero_grad_clip_matches_replicated(setup):
     np.testing.assert_allclose(float(met_rep["grad_norm"]), float(met_z["grad_norm"]), rtol=1e-4)
     for a, c in zip(jax.tree.leaves(ts_rep.params), jax.tree.leaves(ts_z.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(c), rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_zero_grouped_dispatch_matches_single_steps(setup):
+    """steps_per_dispatch composes with ZeRO: k steps in one jit dispatch
+    over the sharded-optimizer step equal k single dispatches (same data,
+    same per-step rng fold) within cross-step-fusion rounding — the grouped
+    program (k UNROLLED step graphs, dp.make_grouped_train_step) must
+    thread the flat-sharded opt_state through consecutive psum_scatter
+    updates AND leave it sharded on output, not just the replicated path
+    test_parallel pins."""
+    net, lr_fn, opt, mesh, batch = setup
+    cfg = _cfg(True)
+    rng = jax.random.PRNGKey(9)
+    step = dp.make_dp_train_step(net, cfg, opt, lr_fn, mesh)
+    batches = [
+        mesh_lib.shard_batch({
+            "image": np.asarray(jax.random.normal(jax.random.PRNGKey(20 + i), (16, 16, 16, 3))),
+            "label": np.asarray((jnp.arange(16) + i) % 5),
+        }, mesh)
+        for i in range(4)
+    ]
+
+    ts_single = _zero_state(net, cfg, opt, mesh)
+    for b in batches:
+        ts_single, met_s = step(ts_single, b, rng)
+
+    grouped = dp.make_grouped_train_step(step, 2)
+    ts_grp = _zero_state(net, cfg, opt, mesh)
+    ts_grp, mets = grouped(ts_grp, tuple(batches[:2]), rng)
+    ts_grp, mets = grouped(ts_grp, tuple(batches[2:]), rng)
+
+    assert int(ts_grp.step) == 4
+    # the grouped jit must not silently gather/replicate the ZeRO shards on
+    # output — that would keep numerics while defeating the memory saving
+    opt_leaves = [l for l in jax.tree.leaves(ts_grp.opt_state)
+                  if hasattr(l, "sharding") and l.ndim >= 1]
+    assert opt_leaves
+    for l in opt_leaves:
+        assert l.sharding.spec == P("data"), (l.shape, l.sharding)
+    for a, b2 in zip(jax.tree.leaves(jax.device_get(ts_single.params)),
+                     jax.tree.leaves(jax.device_get(ts_grp.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b2), rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(float(met_s["loss"]), float(mets[-1]["loss"]), rtol=1e-5)
