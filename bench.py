@@ -94,11 +94,14 @@ def partition_flags(flags_str: str) -> tuple[str, str]:
     options (fatal 'Unknown flag in XLA_FLAGS' at first backend touch,
     verified 2026-07-30); on PJRT-plugin TPUs those flags are consumed by
     libtpu via LIBTPU_INIT_ARGS instead. Every token must start with
-    '--xla' (a typo'd token would be silently exported into the env)."""
+    '--xla_' — the underscore matters (ADVICE r4 #2): a near-miss like
+    '--xlatpu_...' would pass a bare '--xla' prefix check, land in host
+    XLA_FLAGS, and hit the exact fatal 'Unknown flag' abort this guard
+    exists to catch at validation time."""
     xla, libtpu = [], []
     for tok in flags_str.split():
-        if not tok.startswith("--xla"):
-            raise ValueError(f"flag token {tok!r} does not start with --xla")
+        if not tok.startswith("--xla_"):
+            raise ValueError(f"flag token {tok!r} does not start with --xla_")
         (libtpu if tok.startswith("--xla_tpu_") else xla).append(tok)
     return " ".join(xla), " ".join(libtpu)
 

@@ -284,8 +284,21 @@ def decide_sweep(sweep_path: str, decision_path: str) -> None:
     best, best_speedup = None, WIN_THRESHOLD
     if base is not None:
         for r in rows:
+            if not r["flags"]:
+                continue
+            # same loss-sanity gate decide() applies to A/B variants
+            # (ADVICE r4 #3): a fusion/scheduler flag can change reduction
+            # order or worse — a flag set that perturbs the measured loss
+            # must not win on speed alone and steer every later bench
+            # (rows from older sweeps may lack loss; only compare when both
+            # sides carry one)
+            if (base.get("loss") is not None and r.get("loss") is not None
+                    and abs(r["loss"] - base["loss"]) > LOSS_SANITY_ABS):
+                log(f"sweep decision: skipping {r['flags']!r}: loss "
+                    f"{r['loss']} vs baseline {base['loss']} fails sanity")
+                continue
             speedup = base["ms_per_step"] / r["ms_per_step"]
-            if r["flags"] and speedup > best_speedup:
+            if speedup > best_speedup:
                 best, best_speedup = r, speedup
     tuning = _read_tuning()  # preserve A/B-owned keys
     if best is not None:

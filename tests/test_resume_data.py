@@ -69,15 +69,16 @@ def test_native_resume_continues_stream(tmp_path):
     _assert_batches_equal(resumed, full[4:], "folder/native")
 
 
-def _write_tfrecords(dst, n_shards=3, per_shard=7, img_size=16):
+def _write_tfrecords(dst, n_shards=3, per_shard=7, img_size=16, shard_sizes=None):
     import tensorflow as tf
 
     os.makedirs(dst)
     rs = np.random.RandomState(1)
-    for s in range(n_shards):
-        path = os.path.join(dst, f"train-{s:05d}-of-{n_shards:05d}")
+    shard_sizes = shard_sizes or [per_shard] * n_shards
+    for s, n_recs in enumerate(shard_sizes):
+        path = os.path.join(dst, f"train-{s:05d}-of-{len(shard_sizes):05d}")
         with tf.io.TFRecordWriter(path) as w:
-            for i in range(per_shard):
+            for i in range(n_recs):
                 img = Image.fromarray(rs.randint(0, 255, (img_size, img_size, 3), np.uint8))
                 import io
 
@@ -150,6 +151,53 @@ def test_tfrecord_resume_continues_epoch_order(tmp_path):
                                   process_count=pc, start_step=start), 10 - start)]
             for i, (a, b) in enumerate(zip(resumed, host_full[start:])):
                 np.testing.assert_array_equal(a, b, err_msg=f"host {pi}/{pc} start={start} batch {i}")
+
+
+def test_tfrecord_resume_uneven_shards_exact(tmp_path):
+    """UNEVEN shards (7/3/11 records) break the equal-shards estimate the
+    resume arithmetic used before ADVICE r4 #1: host 0 of 2 reads shards
+    {0,2} = 18 records/epoch where the estimate says ceil(21*2/3) = 14 — a
+    4-record/epoch drift that compounds every epoch crossed. The arithmetic
+    now counts records per shard (TFRecord framing walk), so resume must be
+    label-exact under deterministic settings regardless of shard balance."""
+    from yet_another_mobilenet_series_tpu.data import pipeline as pl
+
+    _write_tfrecords(str(tmp_path / "rec"), shard_sizes=[7, 3, 11])
+    # the framing walk itself, against known counts
+    files = sorted(os.listdir(tmp_path / "rec"))
+    counts = [pl._count_tfrecord_records(str(tmp_path / "rec" / f))
+              for f in files if not f.startswith(".")]
+    assert counts == [7, 3, 11]
+
+    cfg = DataConfig(dataset="imagenet", loader="tfdata", data_dir=str(tmp_path / "rec"),
+                     image_size=8, num_train_examples=21,
+                     decode_threads=1, shuffle_buffer=1)
+    # single host: 12 batches x 4 = 48 records = 2.28 epochs of 21
+    full = [b["label"] for b in _take(make_train_source(cfg, local_batch=4, seed=5), 12)]
+    for start in (2, 6, 9):
+        resumed = [b["label"] for b in
+                   _take(make_train_source(cfg, local_batch=4, seed=5, start_step=start), 12 - start)]
+        for i, (a, b) in enumerate(zip(resumed, full[start:])):
+            np.testing.assert_array_equal(a, b, err_msg=f"uneven start={start} batch {i}")
+    # two hosts with maximally uneven shares: host 0 -> 18 rec/epoch,
+    # host 1 -> 3 rec/epoch (deep into epoch space after a few batches)
+    for pi, pc in ((0, 2), (1, 2)):
+        host_full = [b["label"] for b in _take(
+            make_train_source(cfg, local_batch=4, seed=5,
+                              process_index=pi, process_count=pc), 10)]
+        for start in (3, 7):
+            resumed = [b["label"] for b in _take(
+                make_train_source(cfg, local_batch=4, seed=5, process_index=pi,
+                                  process_count=pc, start_step=start), 10 - start)]
+            for i, (a, b) in enumerate(zip(resumed, host_full[start:])):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"uneven host {pi}/{pc} start={start} batch {i}")
+    # the sidecar cache was written and holds the exact counts
+    import json
+
+    with open(tmp_path / "rec" / ".record_counts.json") as f:
+        disk = json.load(f)
+    assert sorted(int(v) for v in disk.values()) == [3, 7, 11]
 
 
 @pytest.mark.slow

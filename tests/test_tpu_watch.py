@@ -103,6 +103,49 @@ def test_sweep_win_merges_flags_and_no_win_removes_empty_file(tw):
     assert not os.path.exists(tw.TUNING_PATH)
 
 
+def test_partition_flags_rejects_near_miss_typos():
+    """ADVICE r4 #2: '--xlatpu_...' (missing underscore) used to pass the
+    bare '--xla' prefix check, land in host XLA_FLAGS, and abort the backend
+    with the exact fatal the guard exists to pre-empt. The check now
+    requires the full '--xla_' prefix and routes '--xla_tpu_*' to
+    LIBTPU_INIT_ARGS."""
+    import importlib.util as ilu
+
+    spec = ilu.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = ilu.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    xla, libtpu = bench.partition_flags(
+        "--xla_latency_hiding_scheduler=true --xla_tpu_rwb_fusion=false")
+    assert xla == "--xla_latency_hiding_scheduler=true"
+    assert libtpu == "--xla_tpu_rwb_fusion=false"
+    for bad in ("--xlatpu_scoped_vmem_limit_kib=98304", "xla_foo=1", "--notxla_x=1"):
+        with pytest.raises(ValueError):
+            bench.partition_flags(bad)
+
+
+def test_sweep_loss_sanity_blocks_numerics_perturbing_flags(tw):
+    """ADVICE r4 #3: a flag set that wins on speed but moves the measured
+    loss beyond LOSS_SANITY_ABS must not be adopted — fusion/scheduler
+    toggles can change reduction order (or worse) and would otherwise steer
+    every later bench with zero correctness signal."""
+    rows = [{"flags": "", "ms_per_step": 35.7, "loss": 6.9},
+            {"flags": "--xla_bad=1", "ms_per_step": 30.0,
+             "loss": 6.9 + 2 * tw.LOSS_SANITY_ABS},       # fastest, fails sanity
+            {"flags": "--xla_ok=1", "ms_per_step": 33.0, "loss": 6.9001}]
+    tw.decide_sweep(_sweep(tw._tmp, rows), str(tw._tmp / "dsw.json"))
+    t = tw._read_tuning()
+    assert t["flags"] == "--xla_ok=1"  # sane runner-up wins, not the perturber
+    # when even the sane candidate is sub-threshold, nothing is adopted
+    rows = [{"flags": "", "ms_per_step": 35.7, "loss": 6.9},
+            {"flags": "--xla_bad=1", "ms_per_step": 30.0, "loss": 99.0}]
+    tw.decide_sweep(_sweep(tw._tmp, rows), str(tw._tmp / "dsw.json"))
+    assert not os.path.exists(tw.TUNING_PATH)
+    dec = json.load(open(tw._tmp / "dsw.json"))
+    assert not dec["adopted"]
+
+
 def test_record_headline_keeps_better_session_number(tw):
     class R:
         returncode = 0

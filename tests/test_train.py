@@ -356,6 +356,16 @@ def test_train_step_overfits_tiny_batch():
     assert max(jax.tree.leaves(diffs)) > 0
 
 
+def test_eval_step_validates_bn_mode():
+    """ADVICE r4 #4: eval pins bn_mode='exact' internally, but a misspelled
+    train.bn_mode must still fail fast in an eval-only run — before this,
+    the typo surfaced only if a train step was ever built."""
+    cfg = _tiny_cfg(train={"compute_dtype": "float32", "bn_mode": "exactt"})
+    net = get_model(cfg.model, image_size=16)
+    with pytest.raises(ValueError, match="bn_mode"):
+        steps.make_eval_step(net, cfg)
+
+
 def test_eval_step_counts_and_padding():
     cfg = _tiny_cfg()
     net = get_model(cfg.model, image_size=16)

@@ -214,6 +214,10 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
     training config can never perturb reported accuracy. (The bn_mode
     perturbation itself is measured — on purpose, via net.apply directly —
     by test_acceptance_mbv2.py::test_full_scale_bn_mode_prediction_agreement.)"""
+    # the value is ignored here (eval pins exact), but a misspelled
+    # train.bn_mode must still fail fast in an eval-only run rather than
+    # only when a train step is ever built (ADVICE r4 #4)
+    _check_bn_mode(cfg)
     compute_dtype = _dtype(cfg.train.compute_dtype)
 
     def eval_fn(params, state, batch, masks):
