@@ -84,7 +84,6 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 # must pick the tuned variant up with no extra flags so the driver's
 # end-of-round artifact reflects the repo's best-known configuration.
 TUNING_PATH = os.path.join(REPO_DIR, "BENCH_TUNING.json")
-_TUNING_KEYS = {"bn_mode", "remat", "remat_policy", "conv1x1_dot", "steps_per_dispatch"}
 
 
 def partition_flags(flags_str: str) -> tuple[str, str]:
@@ -144,30 +143,19 @@ def load_tuning() -> dict:
     value is validated here (not just parsed): an invalid bn_mode would
     otherwise raise in EVERY ladder rung of both the TPU worker and the CPU
     fallback, shipping a value=null headline artifact. Worker-side only
-    (imports the package, hence jax)."""
-    from yet_another_mobilenet_series_tpu.ops.layers import BN_MODES
+    (imports the package, hence jax); validation is single-sourced in
+    train/tuning.py so bench and the production CLI (train.tuning_file)
+    can never disagree about well-formedness."""
+    from yet_another_mobilenet_series_tpu.train.tuning import validate_tuning
 
     try:
         with open(TUNING_PATH) as f:
             raw = json.load(f)
-        tuning = {k: raw[k] for k in _TUNING_KEYS if k in raw}
+        tuning = validate_tuning(raw)
         if not tuning:
             # a file with no tuning keys is the baseline, not a winner —
             # returning a truthy dict here would stamp a bogus tuning_source
             return {}
-        if tuning.get("bn_mode", "exact") not in BN_MODES:
-            raise ValueError(f"bn_mode must be one of {BN_MODES}")
-        if tuning.get("remat_policy", "full") not in ("full", "save_conv"):
-            raise ValueError("remat_policy must be 'full' or 'save_conv'")
-        if not isinstance(tuning.get("remat", False), bool):
-            raise ValueError("remat must be a bool")
-        if not isinstance(tuning.get("conv1x1_dot", False), bool):
-            raise ValueError("conv1x1_dot must be a bool")
-        k = tuning.get("steps_per_dispatch", 1)
-        if isinstance(k, bool) or not isinstance(k, int) or not 1 <= k <= 16:
-            # bool is an int subclass: {"steps_per_dispatch": true} would
-            # otherwise silently measure single-step dispatch
-            raise ValueError("steps_per_dispatch must be an int in [1, 16]")
         tuning["source"] = raw.get("source")
         return tuning
     except FileNotFoundError:

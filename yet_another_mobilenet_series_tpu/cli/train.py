@@ -249,6 +249,15 @@ def _init_or_warm_start(cfg: Config, net: Network, mesh, log: Logger, rng):
 def run(cfg: Config) -> dict:
     import dataclasses as dc
 
+    tuning_lines: list[str] = []
+    if cfg.train.tuning_file:
+        # before ANY backend touch (jax.distributed / make_mesh): a 'flags'
+        # entry lands in XLA_FLAGS/LIBTPU_INIT_ARGS, read once at backend
+        # init. Malformed file = hard error: the user explicitly pointed the
+        # run at it (unlike bench.py, where tuning is an aux artifact).
+        from ..train import tuning as tuning_lib
+
+        cfg, tuning_lines = tuning_lib.apply_tuning_file(cfg)
     if cfg.dist.multihost:
         # multi-host rendezvous: the reference's torch.distributed env://
         # init; on TPU pods the coordinator/process env is auto-discovered.
@@ -259,6 +268,8 @@ def run(cfg: Config) -> dict:
     log = Logger(cfg.train.log_dir, enabled=is_coord, tensorboard=bool(cfg.train.log_dir))
     mesh = mesh_lib.make_mesh(cfg.dist.num_devices)
     log.log(f"devices: {mesh.size} ({jax.devices()[0].platform}), hosts: {jax.process_count()}")
+    for line in tuning_lines:  # provenance of measured-winner overrides
+        log.log(line)
 
     net = get_model(cfg.model, cfg.data.image_size)
     prof = profile_network(net)

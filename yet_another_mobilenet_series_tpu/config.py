@@ -269,6 +269,14 @@ class TrainConfig:
     # per-step host features are active: pruning mask updates or the
     # profiler window.
     steps_per_dispatch: int = 1
+    # path to a BENCH_TUNING.json-format file (written by the tpu_watch
+    # measurement watcher's adoption step): its step-config keys (bn_mode,
+    # remat, remat_policy, conv1x1_dot, steps_per_dispatch) and XLA flags
+    # override this config at startup with provenance logged — measured
+    # winners reach production runs without hand-editing YAML
+    # (train/tuning.py; eval accuracy is immune: eval always runs exact BN
+    # + stock conv lowering). "" = off.
+    tuning_file: str = ""
 
 
 @dataclass(frozen=True)
