@@ -4,7 +4,6 @@ effective step config when the run opts in via train.tuning_file — and must
 never be able to perturb eval accuracy (eval pins exact BN regardless).
 """
 
-import dataclasses as dc
 import importlib.util
 import json
 import os
@@ -119,9 +118,9 @@ def test_cli_consumes_tuning_file_and_eval_stays_exact(tmp_path, monkeypatch):
         seen_train_cfgs.append(cfg.train)
         return real_train(net, cfg, *a, **kw)
 
-    def rec_grouped(step, k):
+    def rec_grouped(step, k, **kw):
         seen_grouped_k.append(k)
-        return real_grouped(step, k)
+        return real_grouped(step, k, **kw)
 
     monkeypatch.setattr(dp, "make_dp_train_step", rec_train)
     monkeypatch.setattr(dp, "make_grouped_train_step", rec_grouped)

@@ -65,7 +65,15 @@ class Trainer:
             clip_shard_aware=cfg.dist.shard_optimizer,  # optimizer built with shard_axis above
         )
         self.eval_step = dp.make_dp_eval_step(net, cfg, mesh)
-        self.mask_update = jax.jit(masking.make_mask_update(net, cfg.prune)) if cfg.prune.enable else None
+        # the complete per-cadence prune event (reached check + adaptive rho
+        # + mask update) as ONE device program — shared verbatim between the
+        # single-step dispatch path and the grouped program, so
+        # steps_per_dispatch>1 no longer has to be forced off under pruning
+        self.prune_stop_step = int(cfg.prune.stop_epoch_frac * cfg.train.epochs * self.steps_per_epoch)
+        self.prune_event = (
+            jax.jit(masking.make_prune_event(net, cfg.prune, self.prune_stop_step))
+            if cfg.prune.enable else None
+        )
         self.sync_check = dp.make_replica_sync_check(mesh)
         if cfg.dist.shard_optimizer:
             from ..parallel import zero
@@ -331,16 +339,11 @@ def run(cfg: Config) -> dict:
 
     total_epochs = cfg.train.epochs
     spe = trainer.steps_per_epoch
-    prune_stop_step = int(cfg.prune.stop_epoch_frac * total_epochs * spe)
     metric_log = MetricLogger()
     eval_result: dict = {}
     epoch = start_epoch
     best_top1 = float(restored[2].get("best_top1", 0.0)) if restored is not None else 0.0
     host_step = int(ts.step)  # one sync at (re)start, then host-side counting
-    # host mirror of the adaptive rho multiplier (device copy is the one the
-    # step reads; TrainState carries it through checkpoints, so resume picks
-    # the adapted value back up here — one sync at (re)start)
-    rho_mult_host = float(jax.device_get(ts.rho_mult)) if ts.rho_mult is not None else 1.0
     trace_active = False
     # integer-step cadences (exact boundaries under fractional epochs/resume)
     eval_cad = StepCadence(cfg.train.eval_every_epochs, spe, host_step)
@@ -350,16 +353,24 @@ def run(cfg: Config) -> dict:
 
     # multi-step dispatch (train.steps_per_dispatch): k steps per jit call,
     # amortizing the per-step host-dispatch/tunnel tax the bench's
-    # --dispatch-probe measures. Per-step HOST features (pruning mask
-    # updates, the profiler window) need step-granular host control, so
-    # they force k=1 with a visible warning instead of silently changing
-    # semantics.
+    # --dispatch-probe measures. Pruning composes since round 5: the prune
+    # event runs in-device after every unrolled sub-step (its own step gate
+    # keeps the cadence identical to single dispatches). Only the profiler
+    # window still needs step-granular host control (start/stop_trace are
+    # host calls at exact step indices) and forces k=1 with a warning.
     k_dispatch = max(1, cfg.train.steps_per_dispatch)
-    if k_dispatch > 1 and (cfg.prune.enable or cfg.train.profile_start_step):
-        log.log("WARNING: steps_per_dispatch>1 is incompatible with pruning/profiler "
+    if k_dispatch > 1 and cfg.train.profile_start_step:
+        log.log("WARNING: steps_per_dispatch>1 is incompatible with the profiler "
                 "window; forcing 1")
         k_dispatch = 1
-    grouped_step = dp.make_grouped_train_step(trainer.train_step, k_dispatch) if k_dispatch > 1 else None
+
+    def build_grouped():
+        if k_dispatch < 2:
+            return None
+        return dp.make_grouped_train_step(trainer.train_step, k_dispatch,
+                                          event_fn=trainer.prune_event)
+
+    grouped_step = build_grouped()
 
     try:
         while epoch < total_epochs:
@@ -399,35 +410,34 @@ def run(cfg: Config) -> dict:
                             log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
 
                     if (
-                        cfg.prune.enable
-                        and trainer.mask_update is not None
+                        len(metric_list) == 1
+                        and trainer.prune_event is not None
                         and step_i % cfg.prune.mask_interval == 0
-                        and step_i <= prune_stop_step
+                        and step_i <= trainer.prune_stop_step
                     ):
-                        # mask_summary is a host sync (np.asarray on device masks);
-                        # only pay it when a target-FLOPs decision needs it
-                        reached = False
-                        if cfg.prune.target_flops:
-                            summary = masking.mask_summary(trainer.net, ts.masks)
-                            reached = summary["effective_macs"] <= cfg.prune.target_flops
-                        if cfg.prune.rho_schedule == "adaptive" and cfg.prune.target_flops:
-                            # FLOPs-gap feedback: push harder while above target,
-                            # anneal once reached (SURVEY.md §2 #11)
-                            rate = cfg.prune.rho_adapt_rate
-                            rho_mult_host *= (1.0 - rate) if reached else (1.0 + rate)
-                            rho_mult_host = min(max(rho_mult_host, cfg.prune.rho_adapt_min), cfg.prune.rho_adapt_max)
-                            ts = ts.replace(
-                                rho_mult=mesh_lib.replicate(np.float32(rho_mult_host), trainer.mesh)
-                            )
-                        if not reached:
-                            ts = ts.replace(masks=trainer.mask_update(ts.params, ts.masks))
+                        # the whole event (reached-target check via in-jit
+                        # effective MACs, adaptive-rho feedback — SURVEY.md
+                        # §2 #11, conditional mask update) runs on device;
+                        # the host gate above only skips the off-cadence
+                        # dispatches (the event's own step gate is true
+                        # exactly when this condition is). Inside a grouped
+                        # dispatch (len(metric_list) == k > 1) the event
+                        # already ran in-device after every sub-step — but
+                        # an epoch-TAIL step dispatched singly (fewer than k
+                        # steps left) has no in-device event and must take
+                        # this host path even when grouping is on.
+                        masks, rho_mult = trainer.prune_event(
+                            ts.params, ts.masks, ts.rho_mult, ts.step)
+                        ts = ts.replace(masks=masks, rho_mult=rho_mult)
 
                     if step_i % cfg.train.log_every == 0:
                         snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
                         if cfg.prune.enable:
                             snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
                             if cfg.prune.rho_schedule == "adaptive":
-                                snap["rho_mult"] = rho_mult_host
+                                # adaptation lives on device now; one host
+                                # sync per log boundary, not per event
+                                snap["rho_mult"] = float(jax.device_get(ts.rho_mult))
                         if cfg.data.loader == "native":
                             # corrupt inputs must be visible, not silent
                             # (train path resamples; the counter still climbs)
@@ -454,7 +464,14 @@ def run(cfg: Config) -> dict:
 
             # coarse-cadence physical shrink (recompile paid here, not per-step)
             if cfg.prune.enable and remat_cad.due(host_step):
+                old_trainer = trainer
                 trainer, ts = _maybe_rematerialize(trainer, ts, log)
+                if trainer is not old_trainer:
+                    # shapes (and the prune event's cost table) changed —
+                    # the grouped program must be rebuilt against the new
+                    # trainer; identity check avoids a gratuitous retrace
+                    # when nothing died
+                    grouped_step = build_grouped()
 
             # final eval AND final checkpoint always run, symmetrically, even
             # with the periodic knobs set to 0

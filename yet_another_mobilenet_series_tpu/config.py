@@ -265,9 +265,10 @@ class TrainConfig:
     # adopt when bench_bn's --dispatch-probe shows a real tax. Same data
     # order/RNG/resume accounting as single dispatches; numerics agree to
     # XLA cross-step fusion rounding ~1e-7 (parallel/dp.py
-    # make_grouped_train_step). Forced to 1 (with a logged warning) when
-    # per-step host features are active: pruning mask updates or the
-    # profiler window.
+    # make_grouped_train_step). Composes with pruning (the prune event runs
+    # in-device after every unrolled sub-step, nas/masking.make_prune_event);
+    # only the profiler window (host start/stop_trace at exact steps) still
+    # forces 1 with a logged warning.
     steps_per_dispatch: int = 1
     # path to a BENCH_TUNING.json-format file (written by the tpu_watch
     # measurement watcher's adoption step): its step-config keys (bn_mode,
