@@ -83,7 +83,10 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 # after a BENCH_BN A/B applies PROFILE.md's >3% rule). `python bench.py`
 # must pick the tuned variant up with no extra flags so the driver's
 # end-of-round artifact reflects the repo's best-known configuration.
-TUNING_PATH = os.path.join(REPO_DIR, "BENCH_TUNING.json")
+# BENCH_TUNING_PATH env override exists for the watcher's CPU rehearsal
+# (tpu_watch --cpu-rehearsal): the rehearsal's decision steps must exercise
+# the real adoption plumbing without touching the production tuning file.
+TUNING_PATH = os.environ.get("BENCH_TUNING_PATH") or os.path.join(REPO_DIR, "BENCH_TUNING.json")
 
 
 def partition_flags(flags_str: str) -> tuple[str, str]:

@@ -88,6 +88,20 @@ START_TIME = time.time()
 # driver wants the chip
 T_END = None
 
+# --cpu-rehearsal (VERDICT r4 next #1): the unattended A/B → decide →
+# headline → sweep → trace chain had only ever been exercised piecewise;
+# its first real execution must not double as its integration test. In
+# rehearsal mode run_session runs ONCE against the CPU backend (bench
+# children smoke-scale themselves), artifacts get a _cpu_rehearsal suffix,
+# and every tuning write is redirected to a rehearsal file so the
+# production BENCH_TUNING.json is never touched.
+CPU_MODE = False
+EXPECTED_PLATFORM = "tpu"
+# where session artifacts (BENCH_*/TRACE_*) land; the rehearsal test points
+# this at a tmp dir (env override) so scoped rehearsals cannot litter the
+# repo root
+ARTIFACT_DIR = os.environ.get("TPU_WATCH_ARTIFACT_DIR") or REPO
+
 
 def _time_left_for(seconds: float, label: str) -> bool:
     if T_END is not None and time.monotonic() + seconds >= T_END:
@@ -128,7 +142,7 @@ def _fresh_complete_ab(path: str) -> bool:
             d = json.load(f)
     except (OSError, json.JSONDecodeError):
         return False
-    return d.get("partial") is False and d.get("platform") == "tpu"
+    return d.get("partial") is False and d.get("platform") == EXPECTED_PLATFORM
 
 
 # three owners of BENCH_TUNING.json keys, each preserving the others' keys
@@ -362,7 +376,7 @@ def _record_headline(r, headline_path: str) -> bool:
                 break
         except json.JSONDecodeError:
             continue
-    if headline is None or headline.get("value") is None or headline.get("platform") != "tpu":
+    if headline is None or headline.get("value") is None or headline.get("platform") != EXPECTED_PLATFORM:
         return False
     try:
         with open(headline_path) as f:
@@ -380,24 +394,28 @@ def _record_headline(r, headline_path: str) -> bool:
     return True
 
 
-def run_trace(round_n: int) -> None:
+def run_trace(tag: str) -> None:
     """Best-effort trace capture under the FINAL adopted config (tuning keys
     as CLI overrides, adopted flags in the env): ~60 steps of the headline
-    recipe with the profiler window, decoded to TRACE_OPS_r{N}.txt — the
+    recipe with the profiler window, decoded to TRACE_OPS_{tag}.txt — the
     op-cost re-rank the next round's attack is planned from."""
     tuning = _read_tuning()
-    trace_dir = os.path.join(REPO, "traces", f"r{round_n}")
+    trace_dir = os.path.join(ARTIFACT_DIR, "traces", tag)
+    # steps_per_epoch for dataset=fake is fake_train_size/batch: pin the
+    # ratio to exactly 60 steps so the profiler window (30..50) actually
+    # opens (a fractional-epoch guess here once produced a 1-step run and
+    # no trace at all). Rehearsal keeps the same 60-step geometry at
+    # CPU-feasible shapes.
+    batch, train_size = (8, 480) if CPU_MODE else (256, 15360)
     cmd = [sys.executable, "-m", "yet_another_mobilenet_series_tpu.cli.train",
            "app:yet_another_mobilenet_series_tpu/apps/mobilenet_v3_large.yml",
            "data.dataset=fake", "data.loader=synthetic",
-           # steps_per_epoch for dataset=fake is fake_train_size/batch: pin
-           # it so exactly 60 steps run and the profiler window (30..50)
-           # actually opens (a fractional-epoch guess here once produced a
-           # 1-step run and no trace at all)
-           "data.fake_train_size=15360", "train.batch_size=256", "train.epochs=1",
-           "train.eval_every_epochs=0",
+           f"data.fake_train_size={train_size}", f"train.batch_size={batch}",
+           "train.epochs=1", "train.eval_every_epochs=0",
            "train.profile_start_step=30", "train.profile_num_steps=20",
            f"train.log_dir={trace_dir}"]
+    if CPU_MODE:
+        cmd.append("data.image_size=32")
     for cfg_key, t_key in (("train.bn_mode", "bn_mode"),
                            ("train.conv1x1_dot", "conv1x1_dot"),
                            ("train.remat", "remat"),
@@ -406,11 +424,17 @@ def run_trace(round_n: int) -> None:
             v = tuning[t_key]
             cmd.append(f"{cfg_key}={str(v).lower() if isinstance(v, bool) else v}")
     env = None
+    if CPU_MODE:
+        # the CLI child cannot call jax.config.update for itself: force CPU
+        # by dropping the axon sitecustomize from PYTHONPATH (it force-
+        # selects the tpu platform) and selecting the cpu backend
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     if tuning.get("flags"):
         try:
             from bench import apply_flags_env
 
-            env = apply_flags_env(os.environ.copy(), tuning["flags"])
+            env = apply_flags_env(env if env is not None else os.environ.copy(),
+                                  tuning["flags"])
         except ValueError as e:
             log(f"trace: ignoring malformed tuned flags: {e}")
     r = _run_job(cmd, TRACE_TIMEOUT_S, "trace capture", env=env)
@@ -420,18 +444,23 @@ def run_trace(round_n: int) -> None:
                    os.path.join(trace_dir, "trace"), "40"],
                   600, "trace decode")
     if rd is not None and rd.returncode == 0 and rd.stdout.strip():
-        out_path = os.path.join(REPO, f"TRACE_OPS_r{round_n}.txt")
+        out_path = os.path.join(ARTIFACT_DIR, f"TRACE_OPS_{tag}.txt")
         with open(out_path, "w") as f:
             f.write(f"# op breakdown under config {tuning or 'baseline'}\n")
             f.write(rd.stdout)
         log(f"trace decoded -> {os.path.basename(out_path)}")
 
 
+def _tag(args) -> str:
+    return f"r{args.round}" + ("_cpu_rehearsal" if CPU_MODE else "")
+
+
 def run_session(args) -> bool:
     """Returns True only if the round's A/B + headline artifacts were actually
     produced — a False lets the caller keep watching for the next window."""
-    ab_path = os.path.join(REPO, f"BENCH_BN_r{args.round}.json")
-    decision_path = os.path.join(REPO, f"BENCH_DECISION_r{args.round}.json")
+    tag = _tag(args)
+    ab_path = os.path.join(ARTIFACT_DIR, f"BENCH_BN_{tag}.json")
+    decision_path = os.path.join(ARTIFACT_DIR, f"BENCH_DECISION_{tag}.json")
     # a previous session THIS RUN may have secured the A/B — don't spend a
     # fresh (possibly short) alive window redoing it. A pre-existing (stale)
     # artifact from older code must NOT suppress measurement (hence the
@@ -440,10 +469,13 @@ def run_session(args) -> bool:
     if _fresh_complete_ab(ab_path):
         log("fresh complete A/B artifact already present; skipping straight to decision")
     else:
-        r1 = _run_job(
-            [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
-             "--dispatch-probe", "--out", ab_path],
-            AB_TIMEOUT_S, "bench_bn A/B")
+        ab_cmd = [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
+                  "--dispatch-probe", "--out", ab_path]
+        if args.variants:
+            ab_cmd += ["--variants", args.variants]
+        if CPU_MODE:
+            ab_cmd.append("--cpu")  # bench_bn smoke-scales itself on CPU
+        r1 = _run_job(ab_cmd, AB_TIMEOUT_S, "bench_bn A/B")
         # the ARTIFACT gates the session, not the exit code: the variants
         # emit a complete artifact before the best-effort dispatch probe, so
         # a probe-stage death must not discard 11 measured variants
@@ -471,20 +503,25 @@ def run_session(args) -> bool:
     except Exception as e:  # a decision bug must not cost the alive window
         log(f"decision step failed ({type(e).__name__}: {e}); headline runs on current defaults")
 
-    headline_path = os.path.join(REPO, f"BENCH_TPU_r{args.round}.json")
-    r2 = _run_job([sys.executable, os.path.join(REPO, "bench.py")],
-                  HEADLINE_TIMEOUT_S, "headline bench.py")
+    headline_cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    if CPU_MODE:
+        headline_cmd.append("--cpu")  # direct CPU smoke worker, no supervisor
+    headline_path = os.path.join(ARTIFACT_DIR, f"BENCH_TPU_{tag}.json")
+    r2 = _run_job(headline_cmd, HEADLINE_TIMEOUT_S, "headline bench.py")
     if not _record_headline(r2, headline_path):
-        log("headline run produced no TPU measurement; will rewatch")
+        log(f"headline run produced no {EXPECTED_PLATFORM} measurement; will rewatch")
         return False
 
     if args.with_sweep and _time_left_for(SWEEP_TIMEOUT_S + HEADLINE_TIMEOUT_S, "xla flag sweep"):
-        sweep_path = os.path.join(REPO, f"BENCH_XLA_r{args.round}.json")
-        _run_job(
-            [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
-             "--xla-flags-sweep", "--child-timeout", str(SWEEP_CHILD_S),
-             "--out", sweep_path],
-            SWEEP_TIMEOUT_S, "xla flag sweep")
+        sweep_path = os.path.join(ARTIFACT_DIR, f"BENCH_XLA_{tag}.json")
+        sweep_cmd = [sys.executable, os.path.join(REPO, "scripts", "bench_bn.py"),
+                     "--xla-flags-sweep", "--child-timeout", str(SWEEP_CHILD_S),
+                     "--out", sweep_path]
+        if args.flag_sets is not None:
+            sweep_cmd += ["--flag-sets", args.flag_sets]
+        if CPU_MODE:
+            sweep_cmd.append("--cpu")
+        _run_job(sweep_cmd, SWEEP_TIMEOUT_S, "xla flag sweep")
         # sweep is best-effort: A/B + headline already make the session a win.
         # The artifact persists incrementally, so decide on whatever rows
         # exist — even after a mid-sweep window death or an outer timeout
@@ -493,24 +530,25 @@ def run_session(args) -> bool:
         if os.path.exists(sweep_path) and os.path.getmtime(sweep_path) >= START_TIME:
             try:
                 decide_sweep(sweep_path, os.path.join(
-                    REPO, f"BENCH_DECISION_XLA_r{args.round}.json"))
+                    ARTIFACT_DIR, f"BENCH_DECISION_XLA_{tag}.json"))
             except Exception as e:
                 log(f"sweep decision failed ({type(e).__name__}: {e}); flags unchanged")
             # a flag win changes what the headline SHOULD measure — re-run
             # bench.py once so BENCH_TPU_r{N} reflects the adopted config
             if _tuning_has_flags():
-                r4 = _run_job([sys.executable, os.path.join(REPO, "bench.py")],
-                              HEADLINE_TIMEOUT_S, "headline re-run under adopted flags")
+                r4 = _run_job(headline_cmd, HEADLINE_TIMEOUT_S,
+                              "headline re-run under adopted flags")
                 _record_headline(r4, headline_path)
     # trace LAST: it captures the op mix of whatever config the session
     # adopted, which is what the next round plans from
     if _time_left_for(TRACE_TIMEOUT_S + 600, "trace capture"):
-        run_trace(args.round)
+        run_trace(tag)
     log("session complete")
     return True
 
 
 def main():
+    global CPU_MODE, EXPECTED_PLATFORM, TUNING_PATH, T_END
     ap = argparse.ArgumentParser()
     ap.add_argument("--round", type=int, required=True,
                     help="round number N for BENCH_*_r{N}.json artifact names")
@@ -522,12 +560,32 @@ def main():
                          "bn_mode prediction-agreement test to be green on this tree)")
     ap.add_argument("--with-sweep", action="store_true",
                     help="after a secured headline, run the XLA flag sweep too")
+    ap.add_argument("--cpu-rehearsal", action="store_true",
+                    help="run ONE full unattended session against the CPU backend "
+                         "(smoke-scaled, artifacts suffixed _cpu_rehearsal, tuning "
+                         "writes redirected) — integration-proves the A/B -> decide "
+                         "-> headline -> sweep -> trace chain without hardware")
+    ap.add_argument("--variants", default=None,
+                    help="forwarded to bench_bn --variants (rehearsal/test scoping)")
+    ap.add_argument("--flag-sets", default=None,
+                    help="forwarded to bench_bn --flag-sets (rehearsal/test scoping)")
     args = ap.parse_args()
+    if args.cpu_rehearsal:
+        CPU_MODE, EXPECTED_PLATFORM = True, "cpu"
+        # every writer in this process (_write_tuning) and every bench child
+        # (BENCH_TUNING_PATH env, honored by bench.TUNING_PATH) uses the
+        # rehearsal file — the production BENCH_TUNING.json is never touched
+        TUNING_PATH = os.path.join(ARTIFACT_DIR, "BENCH_TUNING_cpu_rehearsal.json")
+        os.environ["BENCH_TUNING_PATH"] = TUNING_PATH
+        _write_tuning({})  # clean slate: drop any previous rehearsal's adoption
+        T_END = time.monotonic() + args.deadline_min * 60
+        ok = run_session(args)
+        log(f"cpu rehearsal {'complete' if ok else 'FAILED'}")
+        sys.exit(0 if ok else 1)
     # gate session START on the MANDATORY stages' worst case only; the
     # best-effort stages (sweep + its headline re-run, trace) each re-check
     # the deadline themselves and are skipped when they no longer fit
     session_budget = QUIET_WAIT_S + PROBE_TIMEOUT_S + AB_TIMEOUT_S + HEADLINE_TIMEOUT_S
-    global T_END
     t_end = T_END = time.monotonic() + args.deadline_min * 60
     n = 0
     # probes run until the deadline (cheap, kill-safe); only a SESSION start

@@ -29,9 +29,11 @@ def main():
     with open(files[-1], "rb") as f:
         xs.ParseFromString(f.read())
 
+    printed_any = False
     for plane in xs.planes:
         if not plane.name.startswith("/device:TPU"):
             continue
+        printed_any = True
         events_meta = {k: v for k, v in plane.event_metadata.items()}
 
         for line in plane.lines:
@@ -83,6 +85,15 @@ def main():
         print(f"\n-- top {top_n} individual sync ops --")
         for k, v in per_op.most_common(top_n):
             print(f"  {k[:98]:<100} {v/total_ps*100:6.2f}%  {v/1e12*1000:8.3f} ms")
+    if not printed_any:
+        # CPU-backend traces (the watcher's --cpu-rehearsal) have no
+        # /device:TPU plane — XLA-CPU ops run inside Eigen threadpool host
+        # lines with start/end marker events, not a device op timeline. Say
+        # so explicitly: an empty stdout here reads as a decoder failure and
+        # makes the rehearsal's trace stage look broken when it is not.
+        print(f"no /device:TPU plane in {os.path.basename(files[-1])} — "
+              f"op-level breakdown needs a TPU-backend trace; "
+              f"planes present: {[p.name for p in xs.planes]}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,67 @@
+"""End-to-end CPU rehearsal of the watcher's unattended session (VERDICT r4
+next #1): run_session had only ever been exercised piecewise — its first real
+execution must not double as its integration test. This drives the REAL
+chain (bench_bn A/B → decision → headline bench.py → trace capture+decode)
+through `tpu_watch.py --cpu-rehearsal` as actual subprocesses against the
+CPU backend, scoped to one A/B variant to fit the slow suite. The sweep
+stage is exercised by the committed full-size rehearsal artifacts and the
+decide_sweep unit tests (test_tpu_watch.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cpu_rehearsal_session_chain(tmp_path):
+    tuning = os.path.join(REPO, "BENCH_TUNING.json")
+    tuning_before = open(tuning).read() if os.path.exists(tuning) else None
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # the rehearsal forces CPU itself (bench children via --cpu, the trace
+    # child via env); the watcher process makes no backend touch. Drop the
+    # pytest conftest's 8-fake-device XLA_FLAGS so children run the bench's
+    # own single-device CPU config.
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["TPU_WATCH_ARTIFACT_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpu_watch.py"),
+         "--round", "99", "--cpu-rehearsal", "--variants", "exact:0,folded:0"],
+        capture_output=True, text=True, timeout=1500, cwd=REPO, env=env)
+    assert r.returncode == 0, f"rehearsal failed:\n{r.stderr[-4000:]}"
+
+    ab = json.load(open(tmp_path / "BENCH_BN_r99_cpu_rehearsal.json"))
+    assert ab["platform"] == "cpu" and ab["partial"] is False
+    modes = {row["bn_mode"] for row in ab["rows"] if "bn_mode" in row}
+    assert {"exact", "folded"} <= modes
+    # the dispatch probe ran inside the A/B (chained vs lax.scan timing)
+    assert any("dispatch_tax_ms" in row for row in ab["rows"])
+
+    dec = json.load(open(tmp_path / "BENCH_DECISION_r99_cpu_rehearsal.json"))
+    assert dec["baseline"] is not None  # rule anchored on the exact row
+
+    head = json.load(open(tmp_path / "BENCH_TPU_r99_cpu_rehearsal.json"))
+    assert head["platform"] == "cpu" and head["value"] > 0
+    assert head["metric"] == "mobilenet_v3_large_train_images_per_sec_per_chip"
+
+    # trace stage: captured through the REAL cli.train profiler window and
+    # decoded by trace_ops.py. A CPU trace has no /device:TPU plane, so the
+    # decoder's explicit no-TPU-plane diagnostic is the CORRECT output here —
+    # the stage proves capture + decode + artifact plumbing, not TPU op math
+    trace_txt = tmp_path / "TRACE_OPS_r99_cpu_rehearsal.txt"
+    assert trace_txt.exists(), f"trace stage produced no artifact:\n{r.stderr[-4000:]}"
+    body = trace_txt.read_text()
+    assert "no /device:TPU plane" in body or "-- by op kind" in body
+
+    # the production tuning file was never touched
+    tuning_after = open(tuning).read() if os.path.exists(tuning) else None
+    assert tuning_after == tuning_before
