@@ -43,7 +43,14 @@ def main():
     ap.add_argument("--batches", type=int, default=20)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--threads", type=int, default=os.cpu_count() or 8)
+    ap.add_argument("--deterministic", action="store_true",
+                    help="tfrecord only: data.deterministic_input=True (record-exact "
+                         "resume via single-stream deterministic interleave) — measures "
+                         "the throughput price of the production resume-exactness switch")
     args = ap.parse_args()
+    if args.deterministic and args.pipeline != "tfrecord":
+        ap.error("--deterministic only applies to --pipeline tfrecord "
+                 "(data.deterministic_input is a TFRecord-interleave switch)")
 
     from yet_another_mobilenet_series_tpu.config import DataConfig
     from yet_another_mobilenet_series_tpu.data import make_train_source
@@ -53,12 +60,14 @@ def main():
                          fake_train_size=max(args.batch * 4, 1024))
     elif args.pipeline == "tfrecord":
         cfg = DataConfig(dataset="imagenet", data_dir=args.data_dir, image_size=args.image_size,
-                         decode_threads=args.threads)
+                         decode_threads=args.threads,
+                         deterministic_input=args.deterministic)
     else:
         cfg = DataConfig(dataset="folder", loader="native", data_dir=args.data_dir,
                          image_size=args.image_size, decode_threads=args.threads)
     it = make_train_source(cfg, args.batch, seed=0)
-    measure(args.pipeline, it, args.batch, args.batches)
+    name = args.pipeline + ("+deterministic" if args.deterministic else "")
+    measure(name, it, args.batch, args.batches)
 
 
 if __name__ == "__main__":

@@ -43,7 +43,14 @@ def main():
     from yet_another_mobilenet_series_tpu.cli import train as cli_train
     from yet_another_mobilenet_series_tpu.config import config_from_dict
 
-    if scenario == "folder":
+    if scenario == "fake4":
+        # 4-process scale scenario (VERDICT r4 next #3): same fake pipeline,
+        # shortened — the 16-device/4-host collective plumbing is the
+        # target. eval 72 does not divide 4 hosts x batch evenly either
+        # (18/host), so padded-tail equalization is still exercised.
+        data = {"dataset": "fake", "image_size": 32, "fake_train_size": 640, "fake_eval_size": 72}
+        epochs = 1.0
+    elif scenario == "folder":
         # 80 train JPEGs (40/host >= one local batch of 32) and 54 val
         # JPEGs: 27/host at local eval batch 16 -> 2 padded batches/host
         # with label=-1 tails; eval_n must still psum to exactly 54
@@ -60,7 +67,7 @@ def main():
     # fake scenario also exercises grouped dispatch under REAL multi-process
     # jax.distributed (2 steps/jit call; cross-host collectives inside the
     # unrolled program). folder's 1 step/epoch never reaches a full group.
-    steps_per_dispatch = 2 if scenario == "fake" else 1
+    steps_per_dispatch = 2 if scenario in ("fake", "fake4") else 1
     cfg = config_from_dict({
         "name": "multiproc",
         "model": {

@@ -20,11 +20,10 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_two_process(tmp_path, scenario):
-    """Launch 2 jax.distributed worker processes, return their agreed RESULT
-    dicts after asserting rc=0 and metric agreement."""
+def _run_two_process(tmp_path, scenario, nproc=2):
+    """Launch nproc jax.distributed worker processes, return their agreed
+    RESULT dicts after asserting rc=0 and metric agreement."""
     port = _free_port()
-    nproc = 2
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + (":" + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
@@ -38,7 +37,11 @@ def _run_two_process(tmp_path, scenario):
     ]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=540)
+        # must exceed the worker's 1200 s jax.distributed shutdown barrier
+        # (set for a lagging coordinator checkpoint flush) plus runtime —
+        # killing a process legitimately waiting in the barrier would turn
+        # a slow flush into a flaky failure
+        out, _ = p.communicate(timeout=540 if nproc == 2 else 1800)
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
@@ -49,12 +52,13 @@ def _run_two_process(tmp_path, scenario):
         assert lines, out[-2000:]
         results.append(json.loads(lines[-1][len("RESULT "):]))
 
-    r0, r1 = results
-    # metrics come out of cross-host collectives: both processes must agree
-    for k in r0:
-        if k == "pid":
-            continue
-        assert r0[k] == r1[k], (k, r0, r1)
+    r0 = results[0]
+    # metrics come out of cross-host collectives: every process must agree
+    for ri in results[1:]:
+        for k in r0:
+            if k == "pid":
+                continue
+            assert r0[k] == ri[k], (k, r0, ri)
     # exactly one coordinated checkpoint tree (written once, not per process)
     metas = glob.glob(str(tmp_path) + "/ckpt/*/meta*")
     assert metas, "no checkpoint written"
@@ -99,3 +103,16 @@ def test_two_process_native_folder_run(tmp_path):
     # 2 present classes; even a degenerate single-class predictor scores .5,
     # so this only smokes that training moved (plumbing is the real target)
     assert r0["eval_top1"] > 0.2, r0
+
+
+@pytest.mark.slow
+def test_four_process_training_run(tmp_path):
+    """VERDICT r4 next #3 (scale axis): a 4-process jax.distributed cluster
+    (16 fake devices) through the full CLI — twice the proven host count, on
+    the path acceptance #5 extrapolates along. Short scenario: the plumbing
+    (4-way make_array_from_process_local_data, cross-host psum over 16
+    devices, 4-host eval equalization, coordinator-only save) is the target,
+    not learning curves."""
+    r0 = _run_two_process(tmp_path, "fake4", nproc=4)
+    assert r0["eval_n"] == 72  # every example counted exactly once across 4 hosts
+    assert r0["epoch"] == 1.0

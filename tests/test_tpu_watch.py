@@ -175,7 +175,7 @@ def test_run_trace_builds_cli_overrides_from_tuning(tw, monkeypatch):
     captured = []
     monkeypatch.setattr(tw, "_run_job",
                         lambda cmd, t, label, env=None: captured.append((label, cmd, env)) and None)
-    tw.run_trace(9)
+    tw.run_trace("r9")
     label, cmd, env = captured[0]
     assert "train.bn_mode=compute_sdot" in cmd and "train.conv1x1_dot=true" in cmd
     assert "train.remat=true" in cmd and "train.remat_policy=save_conv" in cmd
