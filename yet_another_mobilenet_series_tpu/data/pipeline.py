@@ -261,7 +261,11 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
       cfg.deterministic_input — single-stream deterministic interleave with
       the (seed, epoch) file permutation as the only shuffle — or,
       equivalently, decode_threads=1 + shuffle_buffer=1 (the resume tests
-      pin both forms): under default production settings the parallel
+      pin both forms). Measured price (BASELINE.md round 5): within ~7% of
+      the default path on a 1-core host, where decode is serial either way;
+      on a many-core production host the single interleave stream bounds
+      record delivery, so re-measure there before enabling it for a full
+      350-epoch run. Under default production settings the parallel
       interleave
       (deterministic=False, kept for throughput) reorders records, and the
       resume point restarts the shuffle buffer — up to shuffle_buffer
