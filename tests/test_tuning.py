@@ -82,6 +82,12 @@ def test_apply_tuning_file_overrides_and_env(tmp_path, monkeypatch):
                                        "--xla_latency_hiding_scheduler=true")
     assert os.environ["LIBTPU_INIT_ARGS"] == "--xla_tpu_rwb_fusion=false"
     assert any("BENCH_BN_r5" in l for l in lines) and any("sweep r5" in l for l in lines)
+    # a provisional (compute-family) adoption surfaces its warning in the
+    # startup provenance of the run that consumes the tuning
+    json.dump({"bn_mode": "compute", "source": "x",
+               "provisional": "synthetic-fixture parity only"}, open(path, "w"))
+    _, lines_p = tuning_lib.apply_tuning_file(cfg)
+    assert any("PROVISIONAL" in l for l in lines_p)
     # malformed file is a hard error for the production path
     json.dump({"bn_mode": "nope"}, open(path, "w"))
     with pytest.raises(ValueError):

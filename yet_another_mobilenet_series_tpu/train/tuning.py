@@ -27,8 +27,10 @@ from typing import Any
 # delegates here); 'flags' is env-level and handled separately
 TUNING_KEYS = ("bn_mode", "remat", "remat_policy", "conv1x1_dot", "steps_per_dispatch")
 # metadata keys the watcher's adoption step writes alongside the config
-# (scripts/tpu_watch.py _AB_KEYS/_DISPATCH_KEYS/_FLAG_KEYS)
-METADATA_KEYS = ("source", "steps_per_dispatch_source", "flags", "flags_source")
+# (scripts/tpu_watch.py _AB_KEYS/_DISPATCH_KEYS/_FLAG_KEYS); 'provisional'
+# marks a compute-family win whose parity evidence is synthetic-fixture only
+METADATA_KEYS = ("source", "steps_per_dispatch_source", "flags", "flags_source",
+                 "provisional")
 
 
 def validate_tuning(raw: dict) -> dict[str, Any]:
@@ -87,6 +89,11 @@ def apply_tuning_file(cfg):
     if tuning:
         src = raw.get("source", "unrecorded")
         lines.append(f"tuning: {path} -> {tuning} (source: {src})")
+        if raw.get("provisional"):
+            # a compute-family adoption whose parity evidence is synthetic:
+            # the warning must reach the operator of the run that consumes
+            # the tuning, not just the decision artifact nobody re-reads
+            lines.append(f"tuning: WARNING — PROVISIONAL adoption: {raw['provisional']}")
         cfg = dc.replace(cfg, train=dc.replace(cfg.train, **tuning))
     flags = raw.get("flags", "")
     if not isinstance(flags, str):
