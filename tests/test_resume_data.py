@@ -201,6 +201,47 @@ def test_tfrecord_resume_uneven_shards_exact(tmp_path):
 
 
 @pytest.mark.slow
+def test_tfrecord_resume_fuzz_random_shards_and_hosts(tmp_path):
+    """Property-style sweep of the exact-resume arithmetic: random uneven
+    shard sizes, host splits, batch sizes, and resume points must all give
+    label-exact continuation under deterministic_input. Complements the
+    hand-picked cases above — the arithmetic has three interacting moduli
+    (records/epoch per host, records/batch, epoch file permutation) and
+    off-by-ones live at their intersections."""
+    rs = np.random.RandomState(42)
+    case_dirs = {}
+    for case in range(6):
+        shard_sizes = [int(rs.randint(1, 10)) for _ in range(int(rs.randint(2, 5)))]
+        total = sum(shard_sizes)
+        key = tuple(shard_sizes)
+        if key not in case_dirs:
+            d = tmp_path / f"rec{case}"
+            _write_tfrecords(str(d), shard_sizes=shard_sizes, img_size=8)
+            case_dirs[key] = str(d)
+        local_batch = int(rs.randint(2, 5))
+        pc = int(rs.randint(1, 3))
+        cfg = DataConfig(dataset="imagenet", loader="tfdata", data_dir=case_dirs[key],
+                         image_size=8, num_train_examples=total,
+                         deterministic_input=True)
+        for pi in range(pc):
+            if not list(range(len(shard_sizes)))[pi::pc]:
+                continue  # a zero-shard host raises by design; skip
+            seed = int(rs.randint(0, 1000))
+            n_batches = 8
+            full = [b["label"] for b in _take(
+                make_train_source(cfg, local_batch, seed=seed,
+                                  process_index=pi, process_count=pc), n_batches)]
+            start = int(rs.randint(1, n_batches))
+            resumed = [b["label"] for b in _take(
+                make_train_source(cfg, local_batch, seed=seed, process_index=pi,
+                                  process_count=pc, start_step=start), n_batches - start)]
+            for i, (a, b) in enumerate(zip(resumed, full[start:])):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"case={case} shards={shard_sizes} host {pi}/{pc} "
+                                  f"batch={local_batch} start={start} batch#{i}")
+
+
+@pytest.mark.slow
 def test_cli_passes_restored_step_as_start_step(tmp_path, monkeypatch):
     """Behavioral pin of the CLI wiring the stream tests above rely on: a
     fresh run builds its train source at start_step=0 and a resumed run at
