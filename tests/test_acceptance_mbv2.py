@@ -151,6 +151,11 @@ def test_full_scale_bn_mode_prediction_agreement(mbv2_fixture):
     raw = str(mbv2_fixture["tmp"] / "raw")
     paths = sorted(os.path.join(raw, f) for f in os.listdir(raw) if f.endswith(".jpg"))
     assert len(paths) == N_IMAGES
+    # 100 of the 200 fixture images: 6 full bf16 predict passes dominate the
+    # suite's slowest test (554 s measured round 5) and the 0.95/0.98
+    # agreement thresholds are equally meaningful at n=100 (granularity 1%);
+    # the eval-CLI tests below still consume all 200
+    paths = paths[::2]
     # identical inputs for every mode: the torch-side preprocessing chain
     imgs = np.concatenate(
         [_torch_preprocess(p).numpy() for p in paths]
@@ -172,13 +177,13 @@ def test_full_scale_bn_mode_prediction_agreement(mbv2_fixture):
             return jnp.argmax(logits, -1)
 
         return np.concatenate(
-            [np.asarray(fwd(imgs[i : i + 50])) for i in range(0, N_IMAGES, 50)]
+            [np.asarray(fwd(imgs[i : i + 50])) for i in range(0, len(imgs), 50)]
         )
 
     base = predict("exact", False)
     # sanity: bf16 exact agrees with the torch-side f32 ground truth to the
     # acceptance tolerance (bf16 rounding ~ decoder noise, both sub-percent)
-    assert np.mean(base == np.asarray(mbv2_fixture["preds"])) >= 0.95
+    assert np.mean(base == np.asarray(mbv2_fixture["preds"])[::2]) >= 0.95
 
     agreement = {}
     for mode, dot in [("folded", False), ("fused_vjp", False), ("exact", True),

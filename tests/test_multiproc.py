@@ -37,11 +37,11 @@ def _run_two_process(tmp_path, scenario, nproc=2):
     ]
     outs = []
     for p in procs:
-        # must exceed the worker's 1200 s jax.distributed shutdown barrier
+        # must exceed the worker's 2400 s jax.distributed shutdown barrier
         # (set for a lagging coordinator checkpoint flush) plus runtime —
         # killing a process legitimately waiting in the barrier would turn
         # a slow flush into a flaky failure
-        out, _ = p.communicate(timeout=540 if nproc == 2 else 1800)
+        out, _ = p.communicate(timeout=540 if nproc == 2 else 3000)
         outs.append(out)
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
