@@ -148,3 +148,113 @@ def test_tf_color_jitter_exact_semantics():
     gray2 = (x @ lum)[..., None]  # recomputed AFTER contrast
     x = np.clip(gray2 + (x - gray2) * fs, 0, 255)
     np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-2)
+
+
+@pytest.mark.slow
+def test_transfer_uint8_matches_f32_path_within_quantization(tfrecord_dir):
+    """data.transfer_uint8 ships raw u8 pixels and normalizes in-step: for
+    the SAME records/augmentations (deterministic_input), device-side
+    normalize(u8 batch) must equal the host-normalized f32 batch within the
+    u8 quantization bound (0.5/255/std per channel) — train AND eval paths.
+    Also pins dtypes: u8 on the wire, f32 after the step-side normalizer."""
+    import itertools
+
+    from yet_another_mobilenet_series_tpu.config import config_from_dict
+    from yet_another_mobilenet_series_tpu.train.steps import _input_normalizer
+
+    def take(cfg_d, n=2):
+        ds = data_lib.make_train_dataset(cfg_d, local_batch=6, seed=3)
+        return list(itertools.islice(data_lib.as_numpy(ds), n))
+
+    cfg_f32 = _cfg(tfrecord_dir, deterministic_input=True)
+    cfg_u8 = _cfg(tfrecord_dir, deterministic_input=True, transfer_uint8=True)
+
+    def full(u8):
+        # ONE base literal, toggled only on the knob under test — the two
+        # eval steps below must differ in nothing but the transfer encoding
+        return config_from_dict({
+            "model": {"arch": "mobilenet_v2", "num_classes": 3,
+                      "block_specs": [{"t": 1, "c": 8, "n": 1, "s": 1}]},
+            "data": {"dataset": "imagenet", "data_dir": tfrecord_dir, "image_size": 32,
+                     "transfer_uint8": u8},
+            "train": {"compute_dtype": "float32"},
+        })
+
+    full_cfg = full(True)
+    prep = _input_normalizer(full_cfg)
+    # max |delta| = 0.5/255 pixel quantization scaled by 1/min(std)
+    tol = 0.5 / 255.0 / min(full_cfg.data.std) + 1e-6
+
+    for a, b in zip(take(cfg_f32), take(cfg_u8)):
+        assert b["image"].dtype == np.uint8  # 4x lighter on the wire
+        np.testing.assert_array_equal(a["label"], b["label"])
+        normed = np.asarray(prep(b["image"]))
+        assert normed.dtype == np.float32
+        assert np.abs(normed - a["image"]).max() <= tol
+
+    ev_f32 = list(data_lib.as_numpy(data_lib.make_eval_dataset(cfg_f32, local_batch=10)))
+    ev_u8 = list(data_lib.as_numpy(data_lib.make_eval_dataset(cfg_u8, local_batch=10)))
+    assert len(ev_f32) == len(ev_u8)
+    for a, b in zip(ev_f32, ev_u8):
+        assert b["image"].dtype == np.uint8
+        np.testing.assert_array_equal(a["label"], b["label"])
+        # padded rows (label=-1) legitimately differ — f32 pads in
+        # normalized space, u8 in pixel space — and are masked out of every
+        # metric; compare the real rows only
+        valid = a["label"] >= 0
+        diff = np.abs(np.asarray(prep(b["image"])) - a["image"])[valid]
+        assert diff.size == 0 or diff.max() <= tol
+
+    # eval top-1 through the REAL eval step is unchanged by the transfer
+    # encoding (same predictions on these well-separated colors)
+    import jax
+
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.train import steps as steps_lib
+
+    net = get_model(full_cfg.model, image_size=32)
+    params, state = net.init(jax.random.PRNGKey(0))
+    ef32 = jax.jit(steps_lib.make_eval_step(net, full(False)))
+    eu8 = jax.jit(steps_lib.make_eval_step(net, full_cfg))
+    m32 = ef32(params, state, ev_f32[0], {})
+    m8 = eu8(params, state, ev_u8[0], {})
+    assert float(m32["n"]) == float(m8["n"]) == 10.0
+    assert float(m32["top1"]) == float(m8["top1"])
+
+
+def test_transfer_uint8_rejected_off_tfrecord_path():
+    from yet_another_mobilenet_series_tpu.data import make_train_source
+
+    for ds_name, loader in (("fake", "tfdata"), ("folder", "native"), ("fake", "synthetic")):
+        cfg = DataConfig(dataset=ds_name, loader=loader, transfer_uint8=True)
+        with pytest.raises(ValueError, match="transfer_uint8"):
+            make_train_source(cfg, 4, seed=0)
+
+
+@pytest.mark.slow
+def test_transfer_uint8_cli_end_to_end(tfrecord_dir, tmp_path):
+    """Real training run over the TFRecord path with transfer_uint8: u8
+    batches ride shard_batch/prefetch_to_mesh onto the 8-device mesh, the
+    step normalizes on device, eval counts every example exactly once."""
+    from yet_another_mobilenet_series_tpu.cli import train as cli_train
+    from yet_another_mobilenet_series_tpu.config import config_from_dict
+
+    cfg = config_from_dict({
+        "name": "u8_e2e",
+        "model": {"arch": "mobilenet_v2", "num_classes": 3, "dropout": 0.0,
+                  "block_specs": [{"t": 2, "c": 8, "n": 1, "s": 2}]},
+        "data": {"dataset": "imagenet", "data_dir": tfrecord_dir, "image_size": 32,
+                 "eval_resize": 36, "num_train_examples": 24, "num_eval_examples": 24,
+                 "transfer_uint8": True},
+        "optim": {"optimizer": "sgd", "weight_decay": 0.0},
+        "schedule": {"schedule": "constant", "base_lr": 0.05,
+                     "scale_by_batch": False, "warmup_epochs": 0.0},
+        "ema": {"enable": False},
+        "train": {"batch_size": 8, "eval_batch_size": 24, "epochs": 2,
+                  "compute_dtype": "float32", "log_dir": str(tmp_path),
+                  "eval_every_epochs": 0.0},
+        "dist": {"num_devices": 8},
+    })
+    result = cli_train.run(cfg)
+    assert result["eval_n"] == 24  # every real example counted exactly once
+    assert np.isfinite(result["eval_loss"])

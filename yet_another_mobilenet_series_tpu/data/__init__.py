@@ -25,6 +25,14 @@ def _check(cfg: DataConfig) -> None:
         raise ValueError(
             f"unsupported data config: dataset={cfg.dataset!r} loader={cfg.loader!r}; valid: {sorted(ok)}"
         )
+    if cfg.transfer_uint8 and (cfg.dataset, cfg.loader) != ("imagenet", "tfdata"):
+        # fake templates live in normalized space (no [0,255] pixels to
+        # quantize) and the native C++ loader emits normalized f32 — the
+        # uint8 transfer path exists for the real-JPEG tf.data pipeline
+        raise ValueError(
+            "data.transfer_uint8 requires dataset=imagenet loader=tfdata "
+            f"(got dataset={cfg.dataset!r} loader={cfg.loader!r})"
+        )
 
 
 def make_train_source(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0,

@@ -121,6 +121,19 @@ def _normalize(tf, image, cfg: DataConfig):
     return (image - mean) / std
 
 
+def _finalize(tf, image, cfg: DataConfig):
+    """Last pixel op before batching: either host-normalized f32 (default)
+    or uint8 for 4x lighter host->device transfer, normalized in-step on
+    device (cfg.transfer_uint8; train/steps.py _input_normalizer applies
+    the IDENTICAL f32 expression, so the only delta vs the default path is
+    the <=0.5/255 rounding of post-augment float pixels — RRC/center-crop
+    resize is bilinear (convex) and the jitter clamps, so values are
+    already in [0,255])."""
+    if cfg.transfer_uint8:
+        return tf.cast(tf.clip_by_value(tf.round(image), 0.0, 255.0), tf.uint8)
+    return _normalize(tf, image, cfg)
+
+
 def _parse_example(tf, serialized):
     features = {
         "image/encoded": tf.io.FixedLenFeature([], tf.string),
@@ -352,7 +365,7 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
             image, seed2 + tf.constant([4, 0], tf.int64))
         if cfg.color_jitter > 0:
             image = _color_jitter(tf, image, cfg.color_jitter, seed2)
-        image = _normalize(tf, image, cfg)
+        image = _finalize(tf, image, cfg)
         image.set_shape([cfg.image_size, cfg.image_size, 3])
         return {"image": image, "label": label}
 
@@ -394,7 +407,7 @@ def make_eval_dataset(cfg: DataConfig, local_batch: int, process_index: int = 0,
         def map_fn(serialized):
             image_bytes, label = _parse_example(tf, serialized)
             image = _decode_center_crop(tf, image_bytes, cfg)
-            image = _normalize(tf, image, cfg)
+            image = _finalize(tf, image, cfg)
             image.set_shape([cfg.image_size, cfg.image_size, 3])
             return {"image": image, "label": label}
 
@@ -403,7 +416,8 @@ def make_eval_dataset(cfg: DataConfig, local_batch: int, process_index: int = 0,
         ds = ds.map(lambda b: _pad_batch(tf, b, local_batch))
     # equalize: append all-dummy batches, then cut to the agreed count
     dummy = tf.data.Dataset.from_tensors({
-        "image": tf.zeros([local_batch, cfg.image_size, cfg.image_size, 3], tf.float32),
+        "image": tf.zeros([local_batch, cfg.image_size, cfg.image_size, 3],
+                          tf.uint8 if cfg.transfer_uint8 else tf.float32),
         "label": -tf.ones([local_batch], tf.int32),
     }).repeat(target)
     ds = ds.concatenate(dummy).take(target)

@@ -81,6 +81,29 @@ def _check_bn_mode(cfg: Config):
         raise ValueError(f"unknown train.bn_mode {cfg.train.bn_mode!r} (valid: {BN_MODES})")
 
 
+def _input_normalizer(cfg: Config):
+    """Returns prep(image) -> compute-dtype array. Under
+    data.transfer_uint8 the pipeline ships raw uint8 pixels (4x less
+    host->device volume; DataConfig comment has the bandwidth math) and
+    THIS applies the identical f32 normalize expression the host path uses
+    (pipeline._normalize) on device, where XLA fuses it into the first
+    conv's input chain. f32 sub/div are exactly rounded IEEE ops, so for
+    the same u8 input the two paths agree bitwise; the only path delta is
+    the u8 rounding of post-augment float pixels (<=0.5/255, pinned by
+    tests/test_data.py)."""
+    compute_dtype = _dtype(cfg.train.compute_dtype)
+    if not cfg.data.transfer_uint8:
+        return lambda image: image.astype(compute_dtype)
+    mean = jnp.asarray(cfg.data.mean, jnp.float32)
+    std = jnp.asarray(cfg.data.std, jnp.float32)
+
+    def prep(image):
+        x = image.astype(jnp.float32) / 255.0
+        return ((x - mean) / std).astype(compute_dtype)
+
+    return prep
+
+
 def make_train_step(
     net: Network,
     cfg: Config,
@@ -142,8 +165,10 @@ def make_train_step(
                 forward, policy=jax.checkpoint_policies.save_only_these_names("conv_out")
             )
 
+    prep_input = _input_normalizer(cfg)
+
     def loss_fn(params, state, batch, masks, rho_mult, step, rng):
-        logits, new_state = forward(params, state, batch["image"].astype(compute_dtype), masks, rng)
+        logits, new_state = forward(params, state, prep_input(batch["image"]), masks, rng)
         ce = cross_entropy_label_smooth(logits, batch["label"], cfg.optim.label_smoothing)
         pen = (
             penalty_fn(params, masks, rho_mult=rho_mult, step=step)
@@ -220,12 +245,14 @@ def make_eval_step(net: Network, cfg: Config, *, axis_name: str | None = None):
     _check_bn_mode(cfg)
     compute_dtype = _dtype(cfg.train.compute_dtype)
 
+    prep_input = _input_normalizer(cfg)
+
     def eval_fn(params, state, batch, masks):
         imasks = {int(k): v for k, v in masks.items()} or None
         logits, _ = net.apply(
             params,
             state,
-            batch["image"].astype(compute_dtype),
+            prep_input(batch["image"]),
             train=False,
             compute_dtype=compute_dtype,
             masks=imasks,
