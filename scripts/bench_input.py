@@ -47,10 +47,14 @@ def main():
                     help="tfrecord only: data.deterministic_input=True (record-exact "
                          "resume via single-stream deterministic interleave) — measures "
                          "the throughput price of the production resume-exactness switch")
+    ap.add_argument("--transfer-uint8", action="store_true",
+                    help="tfrecord only: data.transfer_uint8=True (u8 on the wire, "
+                         "in-step device normalize) — host-side cost/saving of the "
+                         "4x transfer-volume lever")
     args = ap.parse_args()
-    if args.deterministic and args.pipeline != "tfrecord":
-        ap.error("--deterministic only applies to --pipeline tfrecord "
-                 "(data.deterministic_input is a TFRecord-interleave switch)")
+    for flag, name in ((args.deterministic, "--deterministic"), (args.transfer_uint8, "--transfer-uint8")):
+        if flag and args.pipeline != "tfrecord":
+            ap.error(f"{name} only applies to --pipeline tfrecord")
 
     from yet_another_mobilenet_series_tpu.config import DataConfig
     from yet_another_mobilenet_series_tpu.data import make_train_source
@@ -61,12 +65,14 @@ def main():
     elif args.pipeline == "tfrecord":
         cfg = DataConfig(dataset="imagenet", data_dir=args.data_dir, image_size=args.image_size,
                          decode_threads=args.threads,
-                         deterministic_input=args.deterministic)
+                         deterministic_input=args.deterministic,
+                         transfer_uint8=args.transfer_uint8)
     else:
         cfg = DataConfig(dataset="folder", loader="native", data_dir=args.data_dir,
                          image_size=args.image_size, decode_threads=args.threads)
     it = make_train_source(cfg, args.batch, seed=0)
-    name = args.pipeline + ("+deterministic" if args.deterministic else "")
+    name = (args.pipeline + ("+deterministic" if args.deterministic else "")
+            + ("+uint8" if args.transfer_uint8 else ""))
     measure(name, it, args.batch, args.batches)
 
 
