@@ -72,6 +72,10 @@ struct Config {
   // a resumed training run continues the data order rather than replaying
   // the epoch-0 shuffle (SURVEY.md §5 checkpoint bullet; VERDICT r3 #2).
   int64_t start_batch;
+  // 1: emit raw uint8 pixels (normalize moves in-step on device —
+  // data.transfer_uint8, 4x less host->device volume; the float augment
+  // pipeline is unchanged, workers quantize round+clip into the u8 ring).
+  int transfer_uint8;
 };
 
 struct Sample {
@@ -232,7 +236,8 @@ void sample_rrc(std::mt19937_64& rng, int w, int h, const Config& cfg, int* cx, 
 // --- loader ----------------------------------------------------------------
 
 struct BatchBuf {
-  std::vector<float> images;
+  std::vector<float> images;    // f32 mode (host-normalized)
+  std::vector<uint8_t> images8; // transfer_uint8 mode (raw pixels)
   std::vector<int32_t> labels;
   int64_t batch_index = -1;  // global batch id this buffer holds
 };
@@ -294,8 +299,20 @@ struct Loader {
   }
 
   void zero_sample(BatchBuf& buf, int i, int32_t label) {
-    float* dst = buf.images.data() + size_t(i) * cfg.image_size * cfg.image_size * 3;
-    std::memset(dst, 0, sizeof(float) * cfg.image_size * cfg.image_size * 3);
+    const size_t n = size_t(cfg.image_size) * cfg.image_size * 3;
+    if (cfg.transfer_uint8) {
+      // f32 mode emits NORMALIZED zeros (the mean pixel); the u8
+      // equivalent is mean*255 per channel — raw zeros would device-
+      // normalize to -mean/std (a black image), diverging the two modes
+      // far beyond the quantization bound on decode-failed samples
+      uint8_t fill[3];
+      for (int c = 0; c < 3; ++c)
+        fill[c] = uint8_t(std::clamp(std::lround(cfg.mean[c] * 255.0f), 0L, 255L));
+      uint8_t* dst = buf.images8.data() + size_t(i) * n;
+      for (size_t p = 0; p < n; ++p) dst[p] = fill[p % 3];
+    } else {
+      std::memset(buf.images.data() + size_t(i) * n, 0, sizeof(float) * n);
+    }
     buf.labels[i] = label;
   }
 
@@ -334,7 +351,18 @@ struct Loader {
       zero_sample(buf, i, cfg.train ? s->label : -1);
       return;
     }
-    float* dst = buf.images.data() + size_t(i) * cfg.image_size * cfg.image_size * 3;
+    const size_t tile = size_t(cfg.image_size) * cfg.image_size * 3;
+    // transfer_uint8: augment into a thread-local float tile, quantize into
+    // the u8 ring at the end — the float pipeline (and its exact jitter
+    // semantics) is shared verbatim between the two output modes
+    thread_local std::vector<float> staging;
+    float* dst;
+    if (cfg.transfer_uint8) {
+      staging.resize(tile);
+      dst = staging.data();
+    } else {
+      dst = buf.images.data() + size_t(i) * tile;
+    }
     if (cfg.train) {
       int cx, cy, cw, ch;
       sample_rrc(rng, w, h, cfg, &cx, &cy, &cw, &ch);
@@ -357,7 +385,13 @@ struct Loader {
                   int(std::lround(crop_src)), int(std::lround(crop_src)), dst,
                   cfg.image_size, false);
     }
-    normalize(dst, cfg.image_size, cfg);
+    if (cfg.transfer_uint8) {
+      uint8_t* out = buf.images8.data() + size_t(i) * tile;
+      for (size_t p = 0; p < tile; ++p)
+        out[p] = uint8_t(std::clamp(std::lround(dst[p]), 0L, 255L));
+    } else {
+      normalize(dst, cfg.image_size, cfg);
+    }
     buf.labels[i] = s->label;
   }
 
@@ -430,12 +464,13 @@ extern "C" {
 void* loader_create(int image_size, int eval_resize, int batch, int num_threads,
                     int train, uint64_t seed, const float* mean, const float* std_,
                     float area_min, float area_max, float ratio_min, float ratio_max,
-                    float color_jitter, int64_t epoch_batches, int64_t start_batch) {
+                    float color_jitter, int64_t epoch_batches, int64_t start_batch,
+                    int transfer_uint8) {
   auto* L = new Loader();
   L->cfg = Config{image_size, eval_resize, batch, num_threads, train, seed,
                   {mean[0], mean[1], mean[2]}, {std_[0], std_[1], std_[2]},
                   area_min, area_max, ratio_min, ratio_max,
-                  color_jitter, epoch_batches, start_batch};
+                  color_jitter, epoch_batches, start_batch, transfer_uint8};
   return L;
 }
 
@@ -459,7 +494,9 @@ int loader_start(void* handle) {
   const int depth = std::max(2 * L->cfg.num_threads, 4);
   L->ring.resize(depth);
   for (int i = 0; i < depth; ++i) {
-    L->ring[i].images.resize(size_t(L->cfg.batch) * L->cfg.image_size * L->cfg.image_size * 3);
+    const size_t n = size_t(L->cfg.batch) * L->cfg.image_size * L->cfg.image_size * 3;
+    if (L->cfg.transfer_uint8) L->ring[i].images8.resize(n);
+    else L->ring[i].images.resize(n);
     L->ring[i].labels.resize(L->cfg.batch);
     L->free_slots.push(i);
   }
@@ -473,10 +510,28 @@ int loader_start(void* handle) {
 // Returns 0 on success.
 int loader_next(void* handle, float* images_out, int32_t* labels_out) {
   auto* L = static_cast<Loader*>(handle);
+  if (L->cfg.transfer_uint8) return -2;  // wrong mode: u8 loader, f32 copy-out
   const int slot = L->wait_batch();
   if (slot < 0) return -1;
   BatchBuf& buf = L->ring[slot];
   std::memcpy(images_out, buf.images.data(), buf.images.size() * sizeof(float));
+  std::memcpy(labels_out, buf.labels.data(), buf.labels.size() * sizeof(int32_t));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_slots.push(slot);
+  }
+  L->cv_free.notify_all();
+  return 0;
+}
+
+// transfer_uint8 copy-out: raw pixels, 4x smaller than the f32 batch.
+int loader_next_u8(void* handle, uint8_t* images_out, int32_t* labels_out) {
+  auto* L = static_cast<Loader*>(handle);
+  if (!L->cfg.transfer_uint8) return -2;  // wrong mode: f32 loader, u8 copy-out
+  const int slot = L->wait_batch();
+  if (slot < 0) return -1;
+  BatchBuf& buf = L->ring[slot];
+  std::memcpy(images_out, buf.images8.data(), buf.images8.size());
   std::memcpy(labels_out, buf.labels.data(), buf.labels.size() * sizeof(int32_t));
   {
     std::lock_guard<std::mutex> lk(L->mu);

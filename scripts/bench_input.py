@@ -48,13 +48,14 @@ def main():
                          "resume via single-stream deterministic interleave) — measures "
                          "the throughput price of the production resume-exactness switch")
     ap.add_argument("--transfer-uint8", action="store_true",
-                    help="tfrecord only: data.transfer_uint8=True (u8 on the wire, "
+                    help="tfrecord/native: data.transfer_uint8=True (u8 on the wire, "
                          "in-step device normalize) — host-side cost/saving of the "
                          "4x transfer-volume lever")
     args = ap.parse_args()
-    for flag, name in ((args.deterministic, "--deterministic"), (args.transfer_uint8, "--transfer-uint8")):
-        if flag and args.pipeline != "tfrecord":
-            ap.error(f"{name} only applies to --pipeline tfrecord")
+    if args.deterministic and args.pipeline != "tfrecord":
+        ap.error("--deterministic only applies to --pipeline tfrecord")
+    if args.transfer_uint8 and args.pipeline == "fake":
+        ap.error("--transfer-uint8 needs a real-JPEG pipeline (tfrecord or native)")
 
     from yet_another_mobilenet_series_tpu.config import DataConfig
     from yet_another_mobilenet_series_tpu.data import make_train_source
@@ -69,7 +70,8 @@ def main():
                          transfer_uint8=args.transfer_uint8)
     else:
         cfg = DataConfig(dataset="folder", loader="native", data_dir=args.data_dir,
-                         image_size=args.image_size, decode_threads=args.threads)
+                         image_size=args.image_size, decode_threads=args.threads,
+                         transfer_uint8=args.transfer_uint8)
     it = make_train_source(cfg, args.batch, seed=0)
     name = (args.pipeline + ("+deterministic" if args.deterministic else "")
             + ("+uint8" if args.transfer_uint8 else ""))

@@ -222,10 +222,10 @@ def test_transfer_uint8_matches_f32_path_within_quantization(tfrecord_dir):
     assert float(m32["top1"]) == float(m8["top1"])
 
 
-def test_transfer_uint8_rejected_off_tfrecord_path():
+def test_transfer_uint8_rejected_for_fake_data():
     from yet_another_mobilenet_series_tpu.data import make_train_source
 
-    for ds_name, loader in (("fake", "tfdata"), ("folder", "native"), ("fake", "synthetic")):
+    for ds_name, loader in (("fake", "tfdata"), ("fake", "synthetic")):
         cfg = DataConfig(dataset=ds_name, loader=loader, transfer_uint8=True)
         with pytest.raises(ValueError, match="transfer_uint8"):
             make_train_source(cfg, 4, seed=0)

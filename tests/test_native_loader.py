@@ -233,3 +233,75 @@ def test_native_color_jitter_is_multiplicative_and_bounded(tmp_path_factory):
     # multiplicative: the factor genuinely spreads (additive-at-255-scale or
     # disabled jitter would collapse this to ~0)
     assert ratios.max() - ratios.min() > 0.2, ratios
+
+
+def test_transfer_uint8_matches_f32_within_quantization(image_tree):
+    """The C++ loader's u8 output mode (data.transfer_uint8): same (seed,
+    global_batch, i) augment pipeline, raw-pixel u8 on the wire instead of
+    host-normalized f32. Applying the step-side normalizer to the u8 batch
+    must match the f32 batch within the 0.5/255/std quantization bound —
+    train (RRC/flip deterministic per position) and eval paths, plus dtype
+    pins."""
+    import dataclasses as dc
+
+    from yet_another_mobilenet_series_tpu.config import config_from_dict
+    from yet_another_mobilenet_series_tpu.train.steps import _input_normalizer
+
+    cfg = _cfg()
+    cfg_u8 = dc.replace(cfg, transfer_uint8=True)
+    paths, labels, _ = native_loader.list_image_folder(image_tree)
+    full_cfg = config_from_dict({
+        "model": {"arch": "mobilenet_v2", "num_classes": 3,
+                  "block_specs": [{"t": 1, "c": 8, "n": 1, "s": 1}]},
+        "data": {"dataset": "folder", "loader": "native", "image_size": 32,
+                 "transfer_uint8": True},
+        "train": {"compute_dtype": "float32"},
+    })
+    prep = _input_normalizer(full_cfg)
+    tol = 0.5 / 255.0 / min(cfg.std) + 1e-6
+
+    for train in (True, False):
+        lf = native_loader.NativeLoader(paths, labels, cfg, batch=6, train=train, seed=11)
+        lu = native_loader.NativeLoader(paths, labels, cfg_u8, batch=6, train=train, seed=11)
+        try:
+            for _ in range(3):
+                a, b = lf.next_batch(), lu.next_batch()
+                assert b["image"].dtype == np.uint8
+                np.testing.assert_array_equal(a["label"], b["label"])
+                diff = np.abs(np.asarray(prep(b["image"])) - a["image"])
+                assert diff.max() <= tol, (train, diff.max())
+        finally:
+            lf.close()
+            lu.close()
+
+
+def test_transfer_uint8_decode_failure_fill_and_mode_guard(image_tree, tmp_path):
+    """u8-mode zero_sample fills with the MEAN pixel (mean*255), matching
+    the f32 path's normalized zeros on decode failures; and the C ABI
+    rejects a copy-out in the wrong mode instead of handing back
+    uninitialized memory."""
+    import ctypes
+    import dataclasses as dc
+
+    root = tmp_path / "bad"
+    (root / "c0").mkdir(parents=True)
+    (root / "c0" / "bad.jpg").write_bytes(b"not a jpeg")
+    cfg = dc.replace(_cfg(), transfer_uint8=True)
+    # eval pass over just the corrupt file: padded exact pass of 1 batch
+    loader = native_loader.NativeLoader([str(root / "c0" / "bad.jpg")], [0], cfg,
+                                        batch=2, train=False, pad_batches=1)
+    try:
+        b = loader.next_batch()
+        assert b["image"].dtype == np.uint8
+        assert (b["label"] == -1).all()  # decode failure + padding, both masked
+        expected = np.round(np.asarray(cfg.mean) * 255).astype(np.uint8)
+        np.testing.assert_array_equal(np.unique(b["image"].reshape(-1, 3), axis=0)[0], expected)
+        # wrong-mode copy-out is an error, not silent garbage
+        imgs = np.empty((2, cfg.image_size, cfg.image_size, 3), np.float32)
+        labs = np.empty((2,), np.int32)
+        rc = loader._lib.loader_next(loader._handle,
+                                     imgs.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                                     labs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        assert rc == -2
+    finally:
+        loader.close()

@@ -138,11 +138,13 @@ class DataConfig:
     # instead of shipping normalized f32: 4x less PCIe/transfer volume. At
     # the v4-32 acceptance point the f32 feed costs ~34 GB/s/host (57k
     # img/s/host x 602 KB) — above PCIe4 x16 — while uint8 is ~8.6 GB/s
-    # (BASELINE.md "transfer_uint8"). The reference's DALI decodes on-GPU
-    # and never pays this. Cost: post-augment float pixels round to u8
-    # (<=0.5/255 quantization, under JPEG decode noise; equivalence pinned
-    # by tests). tfdata pipelines only; the native C++ loader emits
-    # normalized f32 (rejected at dispatch).
+    # (BASELINE.md "transfer_uint8": also a measured 1.72x HOST pipeline
+    # win — no host-side normalize, 4x smaller buffers). The reference's
+    # DALI decodes on-GPU and never pays this. Cost: post-augment float
+    # pixels round to u8 (<=0.5/255 quantization, under JPEG decode noise;
+    # equivalence pinned by tests). Real-JPEG pipelines only (tfdata
+    # TFRecords and the native C++ loader; fake data lives in normalized
+    # space and is rejected at dispatch).
     transfer_uint8: bool = False
 
 

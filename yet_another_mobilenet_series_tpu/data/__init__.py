@@ -25,13 +25,15 @@ def _check(cfg: DataConfig) -> None:
         raise ValueError(
             f"unsupported data config: dataset={cfg.dataset!r} loader={cfg.loader!r}; valid: {sorted(ok)}"
         )
-    if cfg.transfer_uint8 and (cfg.dataset, cfg.loader) != ("imagenet", "tfdata"):
-        # fake templates live in normalized space (no [0,255] pixels to
-        # quantize) and the native C++ loader emits normalized f32 — the
-        # uint8 transfer path exists for the real-JPEG tf.data pipeline
+    if cfg.transfer_uint8 and (cfg.dataset, cfg.loader) not in (
+            ("imagenet", "tfdata"), ("folder", "native")):
+        # fake templates live in normalized space — there are no [0,255]
+        # pixels to quantize; the uint8 transfer path exists for the
+        # real-JPEG pipelines (tf.data TFRecords and the native C++ loader)
         raise ValueError(
-            "data.transfer_uint8 requires dataset=imagenet loader=tfdata "
-            f"(got dataset={cfg.dataset!r} loader={cfg.loader!r})"
+            "data.transfer_uint8 requires a real-JPEG pipeline "
+            "(imagenet/tfdata or folder/native); "
+            f"got dataset={cfg.dataset!r} loader={cfg.loader!r}"
         )
 
 

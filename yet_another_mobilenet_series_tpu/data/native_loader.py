@@ -56,13 +56,15 @@ def _load():
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_uint64, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
         ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
-        ctypes.c_float, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_float, ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
     ]
     lib.loader_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
     lib.loader_start.argtypes = [ctypes.c_void_p]
     lib.loader_start.restype = ctypes.c_int
     lib.loader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32)]
     lib.loader_next.restype = ctypes.c_int
+    lib.loader_next_u8.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32)]
+    lib.loader_next_u8.restype = ctypes.c_int
     lib.loader_num_samples.argtypes = [ctypes.c_void_p]
     lib.loader_num_samples.restype = ctypes.c_int64
     lib.loader_decode_failures.argtypes = [ctypes.c_void_p]
@@ -126,11 +128,13 @@ class NativeLoader:
         self._lib = lib
         self._batch = batch
         self._size = cfg.image_size
+        self._uint8 = bool(cfg.transfer_uint8)
         self._handle = lib.loader_create(
             cfg.image_size, cfg.eval_resize, batch,
             num_threads or cfg.decode_threads, int(train), seed, mean, std,
             cfg.rrc_area_min, cfg.rrc_area_max, cfg.rrc_ratio_min, cfg.rrc_ratio_max,
             cfg.color_jitter if train else 0.0, pad_batches, start_batch,
+            int(cfg.transfer_uint8),
         )
         for p, l in zip(paths, labels):
             lib.loader_add_file(self._handle, os.fsencode(p), int(l))
@@ -158,13 +162,23 @@ class NativeLoader:
                 return
 
     def next_batch(self) -> dict:
-        images = np.empty((self._batch, self._size, self._size, 3), np.float32)
         labels = np.empty((self._batch,), np.int32)
-        rc = self._lib.loader_next(
-            self._handle,
-            images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-        )
+        if self._uint8:
+            # raw pixels, 4x smaller on the wire; the train/eval step
+            # normalizes on device (train/steps.py _input_normalizer)
+            images = np.empty((self._batch, self._size, self._size, 3), np.uint8)
+            rc = self._lib.loader_next_u8(
+                self._handle,
+                images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
+        else:
+            images = np.empty((self._batch, self._size, self._size, 3), np.float32)
+            rc = self._lib.loader_next(
+                self._handle,
+                images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            )
         if rc != 0:
             raise LoaderExhausted
         return {"image": images, "label": labels}
