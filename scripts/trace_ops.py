@@ -17,6 +17,18 @@ import re
 import sys
 
 
+def op_kind(name: str) -> str:
+    """Collapse op numbering: 'fusion.123' -> 'fusion'. ONE definition for
+    every backend's aggregation — the TPU and CPU rankings must never
+    diverge on the collapse rule."""
+    return re.split(r"[.\d]", name, maxsplit=1)[0].lstrip("%")
+
+
+def print_ranked(per_cat: collections.Counter, total_ps: int, top_n: int) -> None:
+    for k, v in per_cat.most_common(top_n):
+        print(f"  {k:<40} {v/total_ps*100:6.2f}%  {v/1e12*1000:8.3f} ms")
+
+
 def main():
     root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_trace"
     top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
@@ -34,7 +46,7 @@ def main():
         if not plane.name.startswith("/device:TPU"):
             continue
         printed_any = True
-        events_meta = {k: v for k, v in plane.event_metadata.items()}
+        events_meta = plane.event_metadata
 
         for line in plane.lines:
             if "XLA Modules" in line.name:
@@ -58,8 +70,7 @@ def main():
             for ev in line.events:
                 meta = events_meta.get(ev.metadata_id)
                 name = meta.name if meta else "?"
-                # collapse fusion numbering: fusion.123 -> leading op kind
-                kind = re.split(r"[.\d]", name, maxsplit=1)[0].lstrip("%")
+                kind = op_kind(name)
                 dur = ev.duration_ps
                 n_events += 1
                 if kind.endswith("-start"):
@@ -77,8 +88,7 @@ def main():
         total_ps = max(total_ps, 1)
         print(f"\n== {plane.name}: {n_events} op events, {total_ps/1e12*1000:.2f} ms synchronous device op time")
         print("\n-- by op kind (sync only) --")
-        for k, v in per_cat.most_common(20):
-            print(f"  {k:<40} {v/total_ps*100:6.2f}%  {v/1e12*1000:8.3f} ms")
+        print_ranked(per_cat, total_ps, 20)
         print("\n-- async DMA windows (overlapping; not occupancy) --")
         for k, v in async_cat.most_common(5):
             print(f"  {k:<40} {'':8}{v/1e12*1000:10.3f} ms")
@@ -87,13 +97,39 @@ def main():
             print(f"  {k[:98]:<100} {v/total_ps*100:6.2f}%  {v/1e12*1000:8.3f} ms")
     if not printed_any:
         # CPU-backend traces (the watcher's --cpu-rehearsal) have no
-        # /device:TPU plane — XLA-CPU ops run inside Eigen threadpool host
-        # lines with start/end marker events, not a device op timeline. Say
-        # so explicitly: an empty stdout here reads as a decoder failure and
-        # makes the rehearsal's trace stage look broken when it is not.
+        # /device:TPU plane; XLA-CPU ops run inside Eigen threadpool host
+        # lines. Those thunk events DO carry durations, so aggregate them —
+        # clearly labeled: thread-summed host time, not a device timeline,
+        # and on another backend entirely (useful for rehearsal sanity and
+        # rough op ranking only, never for TPU decisions). The planes list
+        # stays in the output so a trace with NO recognizable plane (GPU
+        # backend, malformed dump) is still diagnosable, not a silent zero.
         print(f"no /device:TPU plane in {os.path.basename(files[-1])} — "
-              f"op-level breakdown needs a TPU-backend trace; "
+              f"falling back to HOST-thread XLA-CPU op times "
+              f"(thread-summed, CPU backend; not comparable to TPU ranks); "
               f"planes present: {[p.name for p in xs.planes]}")
+        per_cat = collections.Counter()
+        n_events = 0
+        for plane in xs.planes:
+            if plane.name != "/host:CPU":
+                continue
+            events_meta = plane.event_metadata
+            for line in plane.lines:
+                if "XLAEigen" not in line.name and "PjRtCpuClient" not in line.name:
+                    continue
+                for ev in line.events:
+                    meta = events_meta.get(ev.metadata_id)
+                    name = meta.name if meta else "?"
+                    if name.startswith(("end:", "ThunkExecutor", "ThreadpoolListener")):
+                        continue  # paired markers / executor bookkeeping
+                    if ev.duration_ps <= 0:
+                        continue
+                    per_cat[op_kind(name)] += ev.duration_ps
+                    n_events += 1
+        total_ps = max(sum(per_cat.values()), 1)
+        print(f"\n== /host:CPU: {n_events} thunk events, "
+              f"{total_ps/1e12*1000:.2f} ms summed host op time")
+        print_ranked(per_cat, total_ps, top_n)
 
 
 if __name__ == "__main__":
