@@ -43,8 +43,20 @@ def _run_two_process(tmp_path, scenario, nproc=2):
         # a slow flush into a flaky failure
         out, _ = p.communicate(timeout=540 if nproc == 2 else 3000)
         outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {pid} failed:\n{out[-4000:]}"
+    if any(p.returncode != 0 for p in procs):
+        # the 4-process scenario has failed ONLY inside full-suite runs
+        # (passes standalone and in this module's own sequence) — persist
+        # every worker's full output so the in-suite failure mode is
+        # diagnosable from the artifact, not from pytest's truncated tail
+        dump = os.path.join("/tmp", f"multiproc_fail_{scenario}_{os.getpid()}.log")
+        with open(dump, "w") as f:
+            for pid, (p, out) in enumerate(zip(procs, outs)):
+                f.write(f"===== process {pid} rc={p.returncode} =====\n{out}\n")
+        rcs = [p.returncode for p in procs]
+        bad = next(i for i, p in enumerate(procs) if p.returncode != 0)
+        raise AssertionError(
+            f"workers rc={rcs}; full logs: {dump}\n"
+            f"--- process {bad} tail ---\n{outs[bad][-3000:]}")
 
     results = []
     for out in outs:
