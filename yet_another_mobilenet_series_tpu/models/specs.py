@@ -22,6 +22,8 @@ Spec dict keys (one dict per *stage*, expanded to ``n`` blocks):
 - ``se_mode``: 'expand' (MobileNetV3: se = make_divisible(ratio * expanded))
   or 'input' (MNASNet: se = max(1, int(ratio * c_in)))
 - ``se_gate``: gate activation ('hsigmoid' V3-style, 'sigmoid' MNAS-style)
+- ``se_inner``: activation between the SE reduce/expand FCs ('relu' V3/MNAS
+  convention; 'swish' for EfficientNet-family specs)
 """
 
 from __future__ import annotations
@@ -47,6 +49,7 @@ class ArchDef:
     default_act: str = "relu6"
     default_se_mode: str = "expand"
     default_se_gate: str = "hsigmoid"
+    default_se_inner: str = "relu"
     # MBV2/V3 convention: head width does not shrink below its 1.0x value.
     head_scales_down: bool = False
 
@@ -198,6 +201,7 @@ def build_network(
         se_ratio = float(spec.get("se", 0.0) or 0.0)
         se_mode = spec.get("se_mode", arch.default_se_mode)
         se_gate = spec.get("se_gate", arch.default_se_gate)
+        se_inner = spec.get("se_inner", arch.default_se_inner)
         for j in range(n):
             stride = s if j == 0 else 1
             if block_type in ("ds", "ds_act"):
@@ -230,6 +234,7 @@ def build_network(
                     active_fn=act,
                     se_channels=se_ch,
                     se_gate_fn=se_gate,
+                    se_inner_act=se_inner,
                     bn_momentum=bn_momentum,
                     bn_eps=bn_eps,
                     project_act=act if block_type == "ds_act" else "identity",

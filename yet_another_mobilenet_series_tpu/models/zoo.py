@@ -8,6 +8,10 @@ Tables are transcribed from the public papers:
 - MNASNet-A1 (arXiv:1807.11626 Fig. 7)
 - AtomNAS supernet (arXiv:1912.09640 §3: MobileNetV2-skeleton with each
   MBConv's expanded channels split into k=3/5/7 atomic groups)
+- EfficientNet-B0 / Lite0 (arXiv:1905.11946 Table 1; beyond reference
+  parity — same MNASNet search-space lineage, expressed in the same spec
+  grammar: SE=0.25 of block INPUT width with sigmoid gate and swish inner
+  FC, swish everywhere; Lite drops SE and uses ReLU6 for int8 friendliness)
 
 Golden param/MAC counts are locked in tests/test_models.py.
 """
@@ -161,6 +165,45 @@ ATOMNAS_SUPERNET_SE = ArchDef(
     default_se_gate="sigmoid",
 )
 
+# --- EfficientNet-B0: MNASNet-style stages, swish + input-mode SE -----------
+_EFFICIENTNET_B0_SPECS = (
+    dict(t=1, c=16, n=1, s=1, k=3),
+    dict(t=6, c=24, n=2, s=2, k=3),
+    dict(t=6, c=40, n=2, s=2, k=5),
+    dict(t=6, c=80, n=3, s=2, k=3),
+    dict(t=6, c=112, n=3, s=1, k=5),
+    dict(t=6, c=192, n=4, s=2, k=5),
+    dict(t=6, c=320, n=1, s=1, k=3),
+)
+
+EFFICIENTNET_B0 = ArchDef(
+    stem_channels=32,
+    block_specs=tuple(dict(s, se=0.25) for s in _EFFICIENTNET_B0_SPECS),
+    head_channels=1280,
+    stem_act="swish",
+    head_act="swish",
+    default_act="swish",
+    default_se_mode="input",
+    default_se_gate="sigmoid",
+    default_se_inner="swish",
+    # EfficientNet round_filters scales EVERY width incl. the head at wm<1
+    # (unlike the MBV2/V3 head-never-shrinks convention).
+    head_scales_down=True,
+)
+
+# Lite0: SE removed, ReLU6 everywhere (quantization-friendly). At width 1.0
+# this is exact; the lite papers also pin stem/head widths across width
+# multipliers — reproduce that at other widths with explicit
+# model.stem_channels=32 model.head_channels=1280 overrides (exact_channels).
+EFFICIENTNET_LITE0 = ArchDef(
+    stem_channels=32,
+    block_specs=_EFFICIENTNET_B0_SPECS,
+    head_channels=1280,
+    stem_act="relu6",
+    head_act="relu6",
+    default_act="relu6",
+)
+
 ARCHS: dict[str, ArchDef] = {
     "mobilenet_v1": MOBILENET_V1,
     "mobilenet_v2": MOBILENET_V2,
@@ -169,6 +212,8 @@ ARCHS: dict[str, ArchDef] = {
     "mnasnet_a1": MNASNET_A1,
     "atomnas_supernet": ATOMNAS_SUPERNET,
     "atomnas_supernet_se": ATOMNAS_SUPERNET_SE,
+    "efficientnet_b0": EFFICIENTNET_B0,
+    "efficientnet_lite0": EFFICIENTNET_LITE0,
 }
 
 
