@@ -51,6 +51,63 @@ def test_efficientnet_exact_published_params():
     assert get_model(ModelConfig(arch="efficientnet_b0", width_mult=0.5)).head.out_channels == 640
 
 
+def test_stochastic_depth(tmp_path):
+    """EfficientNet drop_connect: linear per-block depth ramp, per-SAMPLE
+    Bernoulli residual drop at train time (inverse-scaled), exact no-op at
+    eval and on rate-0 archs (arXiv:1603.09382 / 1905.11946)."""
+    net = get_model(ModelConfig(arch="efficientnet_b0"), image_size=32)
+    nb = len(net.blocks)
+    assert net.blocks[0].drop_path == 0.0
+    assert net.blocks[-1].drop_path == pytest.approx(0.2 * (nb - 1) / nb)
+    # config override beats the arch default
+    assert get_model(ModelConfig(arch="efficientnet_b0", drop_connect=0.0)).blocks[-1].drop_path == 0.0
+    # rate-0 archs build exactly as before
+    assert all(b.drop_path == 0.0 for b in get_model(ModelConfig(arch="mobilenet_v3_large")).blocks)
+    # out-of-range rates fail at build time, not as NaN at step 0
+    with pytest.raises(ValueError, match="drop_connect"):
+        get_model(ModelConfig(arch="efficientnet_b0", drop_connect=1.0))
+    # the network_spec path honors the knob too (training knob, not part of
+    # the serialized architecture): the ramp is re-applied over the blocks
+    import json
+
+    from yet_another_mobilenet_series_tpu.models.serialize import network_to_dict
+
+    spec_path = tmp_path / "arch.json"
+    spec_path.write_text(json.dumps(network_to_dict(get_model(ModelConfig(arch="efficientnet_lite0")))))
+    restored = get_model(ModelConfig(network_spec=str(spec_path), drop_connect=0.4))
+    assert restored.blocks[-1].drop_path == pytest.approx(0.4 * (nb - 1) / nb)
+    assert get_model(ModelConfig(network_spec=str(spec_path), drop_connect=0.0)).blocks[-1].drop_path == 0.0
+
+    params, state = net.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    y_a, _ = net.apply(params, state, x, train=True, rng=jax.random.PRNGKey(2))
+    y_b, _ = net.apply(params, state, x, train=True, rng=jax.random.PRNGKey(3))
+    assert float(jnp.abs(y_a - y_b).max()) > 0  # streams actually differ
+    # eval ignores the rng entirely
+    e1, _ = net.apply(params, state, x, train=False)
+    e2, _ = net.apply(params, state, x, train=False, rng=jax.random.PRNGKey(4))
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+    # per-sample semantics on a single residual block: dropped samples pass
+    # the input through EXACTLY (branch scaled to zero), kept samples are
+    # inverse-scaled by 1/keep_prob
+    from yet_another_mobilenet_series_tpu.ops.blocks import InvertedResidual
+
+    blk = InvertedResidual(in_channels=8, out_channels=8, expanded_channels=24, drop_path=0.5)
+    bp, bs = blk.init(jax.random.PRNGKey(5))
+    xb = jax.random.normal(jax.random.PRNGKey(6), (64, 8, 8, 8))
+    yb, _ = blk.apply(bp, bs, xb, train=True, rng=jax.random.PRNGKey(7))
+    passed_through = np.asarray(jnp.all(jnp.isclose(yb, xb), axis=(1, 2, 3)))
+    assert 0 < passed_through.sum() < 64  # some dropped, some kept
+    # kept samples: (y - x) == branch/keep_prob, i.e. exactly 2x the no-drop
+    # branch under the same train-mode (batch-stat) BN
+    y0, _ = blk.apply(bp, bs, xb, train=True)  # rng=None -> drop disabled
+    kept = ~passed_through
+    np.testing.assert_allclose(
+        np.asarray(yb - xb)[kept], 2.0 * np.asarray(y0 - xb)[kept], rtol=1e-5, atol=1e-6
+    )
+
+
 @pytest.mark.slow
 def test_profiler_matches_actual_param_count():
     """Analytic profiler == number of weights actually initialized."""

@@ -88,6 +88,10 @@ class ModelConfig:
     feature_channels: int | None = None
     bn_momentum: float = 0.1
     bn_eps: float = 1e-5
+    # Stochastic-depth max rate (EfficientNet drop_connect); None = the
+    # arch's default (0 everywhere except efficientnet_b0's paper 0.2).
+    # Per-block rates ramp linearly with depth (models/specs.py).
+    drop_connect: float | None = None
     # Overrides the arch's default activation when set (e.g. swish for the
     # AtomNAS "+" variants); None = keep the arch's own default.
     active_fn: str | None = None

@@ -28,6 +28,16 @@ def get_model(cfg: ModelConfig, image_size: int = 224) -> Network:
             raise ValueError(
                 f"network_spec has {net.classifier.out_features} classes, config wants {cfg.num_classes}"
             )
+        if cfg.drop_connect is not None:
+            if not 0.0 <= cfg.drop_connect < 1.0:
+                raise ValueError(f"drop_connect must be in [0, 1), got {cfg.drop_connect}")
+            # like dropout, drop_connect is a training knob, not part of the
+            # serialized architecture: re-apply the linear depth ramp
+            # (models/specs.py) over the restored blocks
+            nb = len(net.blocks)
+            net = _dc.replace(net, blocks=tuple(
+                _dc.replace(b, drop_path=cfg.drop_connect * i / nb) for i, b in enumerate(net.blocks)
+            ))
         return _dc.replace(net, dropout=cfg.dropout, image_size=image_size)
     arch = get_arch(cfg.arch)
     if cfg.active_fn is not None:
@@ -53,4 +63,5 @@ def get_model(cfg: ModelConfig, image_size: int = 224) -> Network:
         image_size=image_size,
         block_specs_override=cfg.block_specs,
         exact_channels=exact or None,
+        drop_connect=cfg.drop_connect,
     )

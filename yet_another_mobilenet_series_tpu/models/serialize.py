@@ -49,6 +49,7 @@ def _block_to_dict(b: InvertedResidual) -> dict:
         "project_act": b.project_act,
         "allow_residual": b.allow_residual,
         "force_expand": b.force_expand,
+        "drop_path": b.drop_path,
     }
 
 

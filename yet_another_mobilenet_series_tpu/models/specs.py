@@ -50,6 +50,11 @@ class ArchDef:
     default_se_mode: str = "expand"
     default_se_gate: str = "hsigmoid"
     default_se_inner: str = "relu"
+    # Stochastic-depth max rate (EfficientNet drop_connect, 0 = off). Per
+    # block the rate ramps linearly with depth: rate_i = drop_connect * i / n
+    # over the n MBConv blocks (the official EfficientNet schedule; the first
+    # block is never dropped).
+    drop_connect: float = 0.0
     # MBV2/V3 convention: head width does not shrink below its 1.0x value.
     head_scales_down: bool = False
 
@@ -103,6 +108,7 @@ class Network:
         bn_mode: str = "exact",
         conv1x1_dot: bool = False,
     ):
+        import jax
         import jax.numpy as jnp
 
         from ..ops.activations import get_activation
@@ -117,6 +123,11 @@ class Network:
             bn_mode=bn_mode,
         )
         nbs: dict = {}
+        # Per-block stochastic-depth streams fold the block index into the
+        # step rng; the classifier dropout below keeps the UNfolded rng, and
+        # rate-0 blocks skip the fold entirely, so rate-0 networks (every
+        # non-EfficientNet arch) are bit-identical to the pre-drop-path code.
+        need_block_rng = rng is not None and train
         for i, blk in enumerate(self.blocks):
             mask = None if masks is None else masks.get(i)
             h, nbs[str(i)] = blk.apply(
@@ -129,6 +140,7 @@ class Network:
                 mask=mask,
                 bn_mode=bn_mode,
                 conv1x1_dot=conv1x1_dot,
+                rng=jax.random.fold_in(rng, i) if need_block_rng and blk.drop_path > 0 else None,
             )
         new_state["blocks"] = nbs
         if self.head is not None:
@@ -172,6 +184,7 @@ def build_network(
     image_size: int = 224,
     block_specs_override: Sequence[Mapping[str, Any]] | None = None,
     exact_channels: Mapping[str, int] | None = None,
+    drop_connect: float | None = None,
 ) -> Network:
     """exact_channels pins {'stem','head','feature'} widths to FINAL values,
     exempt from width_mult scaling — an explicit ``model.head_channels: 1280``
@@ -185,6 +198,11 @@ def build_network(
     stem_ch = exact["stem"] if "stem" in exact else make_divisible(arch.stem_channels * width_mult)
     stem = ConvBNAct(3, stem_ch, 3, 2, active_fn=arch.stem_act, bn_momentum=bn_momentum, bn_eps=bn_eps)
 
+    dc_rate = arch.drop_connect if drop_connect is None else drop_connect
+    if not 0.0 <= dc_rate < 1.0:
+        raise ValueError(f"drop_connect must be in [0, 1), got {dc_rate}")
+    total_blocks = sum(int(s.get("n", 1)) for s in specs)
+    block_idx = 0
     blocks: list[InvertedResidual] = []
     c_in = stem_ch
     for spec in specs:
@@ -239,8 +257,10 @@ def build_network(
                     bn_eps=bn_eps,
                     project_act=act if block_type == "ds_act" else "identity",
                     allow_residual=block_type not in ("ds", "ds_act"),
+                    drop_path=dc_rate * block_idx / total_blocks,
                 )
             )
+            block_idx += 1
             c_in = c
 
     # membership (not truthiness) so an explicit override of 0 keeps the

@@ -189,6 +189,7 @@ EFFICIENTNET_B0 = ArchDef(
     # EfficientNet round_filters scales EVERY width incl. the head at wm<1
     # (unlike the MBV2/V3 head-never-shrinks convention).
     head_scales_down=True,
+    drop_connect=0.2,  # stochastic-depth max rate, paper default
 )
 
 # Lite0: SE removed, ReLU6 everywhere (quantization-friendly). At width 1.0
@@ -202,6 +203,7 @@ EFFICIENTNET_LITE0 = ArchDef(
     stem_act="relu6",
     head_act="relu6",
     default_act="relu6",
+    drop_connect=0.2,  # the official lite recipe keeps B0's stochastic depth
 )
 
 ARCHS: dict[str, ArchDef] = {

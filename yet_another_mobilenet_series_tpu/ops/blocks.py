@@ -132,10 +132,19 @@ class InvertedResidual:
     # Keep the 1x1 expand conv even when expanded==in (a pruned supernet
     # block can shrink to exactly in_channels; its expand conv must survive).
     force_expand: bool = False
+    # Stochastic depth / drop-connect (arXiv:1603.09382; EfficientNet
+    # arXiv:1905.11946): per-SAMPLE Bernoulli drop of the residual branch at
+    # train time, inverse-scaled by the keep probability so eval needs no
+    # rescale. Only meaningful on residual blocks; 0 = off (all non-
+    # EfficientNet archs). In-jit: one (N,1,1,1) bernoulli, XLA fuses it.
+    drop_path: float = 0.0
 
     def __post_init__(self):
         for name in (self.active_fn, self.project_act, self.se_gate_fn, self.se_inner_act):
             get_activation(name)  # fail at spec-build time, not in jit
+        if not 0.0 <= self.drop_path < 1.0:
+            # keep_prob <= 0 would inverse-scale by 1/0 -> NaN from step 0
+            raise ValueError(f"drop_path must be in [0, 1), got {self.drop_path}")
         groups = self.group_channels or (self.expanded_channels,)
         object.__setattr__(self, "group_channels", tuple(groups))
         if len(self.group_channels) != len(self.kernel_sizes):
@@ -197,6 +206,7 @@ class InvertedResidual:
         mask: Array | None = None,
         bn_mode: str = "exact",
         conv1x1_dot: bool = False,
+        rng: Array | None = None,
     ):
         """mask: optional (expanded_channels,) multiplier zeroing dead atoms.
 
@@ -240,6 +250,10 @@ class InvertedResidual:
         )
         h = get_activation(self.project_act)(h)
         if self.has_residual:
+            if train and self.drop_path > 0 and rng is not None:
+                keep_prob = 1.0 - self.drop_path
+                keep = jax.random.bernoulli(rng, keep_prob, (h.shape[0], 1, 1, 1))
+                h = h * (keep.astype(h.dtype) / jnp.asarray(keep_prob, h.dtype))
             if mask is not None:
                 # A fully-masked block must equal identity exactly — without
                 # this gate the project BN's shift (beta - mean*scale) leaks
