@@ -258,3 +258,92 @@ def test_transfer_uint8_cli_end_to_end(tfrecord_dir, tmp_path):
     result = cli_train.run(cfg)
     assert result["eval_n"] == 24  # every real example counted exactly once
     assert np.isfinite(result["eval_loss"])
+
+
+# --- RandAugment (beyond reference parity, data/randaugment.py) -------------
+
+
+def test_randaugment_op_semantics():
+    """Pin the official op definitions the magnitudes are calibrated for."""
+    tf = data_lib._tf_mod()
+    from yet_another_mobilenet_series_tpu.data import randaugment as ra
+
+    rng = np.random.RandomState(0)
+    img = tf.constant(rng.randint(0, 256, (224, 224, 3)), tf.uint8)
+    # autocontrast: per-channel min->0, max->255
+    ac = ra._autocontrast(tf, img)
+    assert int(tf.reduce_min(ac)) == 0 and int(tf.reduce_max(ac)) == 255
+    # solarize: invert at/above threshold only; PIL's threshold-256 identity
+    sol = np.asarray(ra._solarize(tf, img, 128))
+    im = np.asarray(img)
+    np.testing.assert_array_equal(sol[im < 128], im[im < 128])
+    np.testing.assert_array_equal(sol[im >= 128], 255 - im[im >= 128])
+    np.testing.assert_array_equal(np.asarray(ra._solarize(tf, img, 256)), im)
+    # posterize keeps exactly the high bits (and clamps the official
+    # bits=0 uint8-shift UB to 1 kept bit)
+    post = np.asarray(ra._posterize(tf, img, 4))
+    np.testing.assert_array_equal(post, im & 0xF0)
+    np.testing.assert_array_equal(
+        np.asarray(ra._posterize(tf, img, 0)), np.asarray(ra._posterize(tf, img, 1)))
+    # invert
+    np.testing.assert_array_equal(np.asarray(ra._invert(tf, img)), 255 - im)
+    # cutout paints a gray patch, geometric ops fill with gray
+    cut = np.asarray(ra._cutout(tf, img, 20, tf.constant([1, 2], tf.int64), 0))
+    assert ((cut == 128).all(axis=-1)).sum() > 0
+    rot = np.asarray(ra._rotate(tf, img, tf.constant(30.0)))
+    assert ((rot == 128).all(axis=-1)).sum() > 0  # corners filled
+    # enhance factor 1.0 is identity for the blend ops
+    np.testing.assert_array_equal(np.asarray(ra._color(tf, img, 1.0)), im)
+    np.testing.assert_array_equal(np.asarray(ra._brightness(tf, img, 1.0)), im)
+
+
+def test_randaugment_stateless_and_position_keyed():
+    tf = data_lib._tf_mod()
+    from yet_another_mobilenet_series_tpu.data import randaugment as ra
+
+    rng = np.random.RandomState(1)
+    img = tf.constant(rng.randint(0, 256, (224, 224, 3)).astype(np.float32))
+    s = tf.constant([7, 1000], tf.int64)
+    a = np.asarray(ra.rand_augment(tf, img, 2, 10, s))
+    b = np.asarray(ra.rand_augment(tf, img, 2, 10, s))
+    np.testing.assert_array_equal(a, b)  # pure function of (seed, position)
+    assert a.dtype == np.float32 and a.min() >= 0.0 and a.max() <= 255.0
+    # different stream positions draw different ops
+    diffs = [
+        np.abs(np.asarray(ra.rand_augment(tf, img, 2, 10, tf.constant([7, 1000 + k], tf.int64))) - a).max()
+        for k in range(1, 5)
+    ]
+    assert max(diffs) > 0
+
+
+@pytest.mark.slow
+def test_randaugment_pipeline_deterministic(tfrecord_dir):
+    """Through make_train_dataset: two fresh streams agree bitwise, and
+    RandAugment actually changes pixels vs the plain pipeline."""
+    kw = dict(deterministic_input=True, randaugment_layers=2, randaugment_magnitude=5)
+    cfg = _cfg(tfrecord_dir, **kw)
+
+    def take(c, n=3):
+        it = data_lib.as_numpy(data_lib.make_train_dataset(c, local_batch=6, seed=3))
+        return np.concatenate([next(it)["image"] for _ in range(n)])
+
+    x1, x2 = take(cfg), take(cfg)
+    np.testing.assert_array_equal(x1, x2)
+    plain = take(_cfg(tfrecord_dir, deterministic_input=True))
+    assert np.abs(x1 - plain).max() > 0
+
+
+def test_randaugment_validation():
+    from yet_another_mobilenet_series_tpu import data as data_pkg
+
+    with pytest.raises(ValueError, match="tfdata"):
+        data_pkg._check(DataConfig(dataset="folder", loader="native", data_dir="/nope",
+                                   randaugment_layers=2))
+    with pytest.raises(ValueError, match="randaugment"):
+        data_pkg._check(DataConfig(dataset="imagenet", data_dir="/nope",
+                                   randaugment_layers=2, randaugment_magnitude=11))
+    # fake data would silently skip the augment map — reject like transfer_uint8
+    with pytest.raises(ValueError, match="randaugment_layers=0"):
+        data_pkg._check(DataConfig(dataset="fake", randaugment_layers=2))
+    # tfdata + randaugment is accepted
+    data_pkg._check(DataConfig(dataset="imagenet", data_dir="/nope", randaugment_layers=2))

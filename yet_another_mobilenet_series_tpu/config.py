@@ -128,6 +128,12 @@ class DataConfig:
     rrc_ratio_min: float = 0.75
     rrc_ratio_max: float = 1.3333333333333333
     color_jitter: float = 0.0  # brightness/contrast/saturation strength, 0=off
+    # RandAugment (arXiv:1909.13719, beyond reference parity; the
+    # EfficientNet recipe trains with layers=2): N stateless position-keyed
+    # ops per image at magnitude M (0..10, the official _MAX_LEVEL scale).
+    # tf.data pipelines only — the native C++ loader rejects it.
+    randaugment_layers: int = 0  # 0 = off
+    randaugment_magnitude: int = 10
     # bitwise-reproducible TFRecord streams: single-stream deterministic
     # interleave, no record shuffle buffer (the stateless (seed, epoch)
     # file permutation is the shuffle). Augmentations are stateless (keyed

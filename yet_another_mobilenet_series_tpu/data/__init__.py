@@ -35,6 +35,21 @@ def _check(cfg: DataConfig) -> None:
             "(imagenet/tfdata or folder/native); "
             f"got dataset={cfg.dataset!r} loader={cfg.loader!r}"
         )
+    if cfg.randaugment_layers < 0 or not 0 <= cfg.randaugment_magnitude <= 10:
+        raise ValueError(
+            f"randaugment_layers must be >= 0 and randaugment_magnitude in [0, 10]; "
+            f"got {cfg.randaugment_layers}/{cfg.randaugment_magnitude}"
+        )
+    if cfg.randaugment_layers > 0 and (cfg.dataset, cfg.loader) != ("imagenet", "tfdata"):
+        # implemented once, in the real-JPEG tf.data pipeline
+        # (data/randaugment.py); fake templates live in normalized space and
+        # the native loader has no implementation — rejecting beats silently
+        # training without it (same policy as transfer_uint8 above)
+        raise ValueError(
+            "RandAugment requires the imagenet/tfdata pipeline "
+            f"(data/randaugment.py); got dataset={cfg.dataset!r} loader={cfg.loader!r} "
+            "(for fake-data smoke runs set data.randaugment_layers=0)"
+        )
 
 
 def make_train_source(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0,

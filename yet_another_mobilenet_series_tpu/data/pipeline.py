@@ -365,6 +365,13 @@ def make_train_dataset(cfg: DataConfig, local_batch: int, seed: int, process_ind
             image, seed2 + tf.constant([4, 0], tf.int64))
         if cfg.color_jitter > 0:
             image = _color_jitter(tf, image, cfg.color_jitter, seed2)
+        if cfg.randaugment_layers > 0:
+            from .randaugment import rand_augment
+
+            # offsets >= 16 are reserved for RandAugment's per-layer draws
+            # (randaugment._BASE_OFFSET); this map_fn owns offsets 0..4
+            image = rand_augment(
+                tf, image, cfg.randaugment_layers, cfg.randaugment_magnitude, seed2)
         image = _finalize(tf, image, cfg)
         image.set_shape([cfg.image_size, cfg.image_size, 3])
         return {"image": image, "label": label}

@@ -1,0 +1,249 @@
+"""Stateless RandAugment (arXiv:1909.13719) for the tf.data train pipeline.
+
+Beyond reference parity: the reference's augmentation surface is
+RRC/flip/color-jitter (SURVEY.md §2 #6); RandAugment completes the
+EfficientNet-family training recipe (the official EfficientNet repo trains
+with it in place of AutoAugment). Op set, magnitude mappings (``_MAX_LEVEL``
+10), enhance-factor formulas, gray-fill 128, ``translate_const`` 100 and
+``cutout_const`` 40 follow the public TF implementation so magnitudes mean
+the same thing here as in published recipes; per-layer op selection draws an
+apply-probability ~U(0.2, 0.8) like the official version.
+
+Every draw is STATELESS, keyed by ``seed2 = [aug_seed, stream position]``
+plus a per-draw offset (the pipeline contract, data/pipeline.py map_fn):
+the same record position produces the same ops/magnitudes whether reached
+by streaming or by resume, so ``deterministic_input`` streams stay
+bitwise-reproducible. Ops run in uint8 (the official numerics — posterize
+is bitwise, equalize histogram-based); input/output is the pipeline's
+[0, 255] float32 HWC image.
+
+NOT implemented by the native C++ loader — data/__init__ rejects
+``loader=native`` + RandAugment rather than silently diverging.
+"""
+
+from __future__ import annotations
+
+_MAX_LEVEL = 10.0
+_FILL = 128
+_TRANSLATE_CONST = 100.0
+_CUTOUT_CONST = 40
+
+# Per-layer stateless draw offsets: pipeline map_fn owns offsets < 16.
+_LAYER_STRIDE = 8
+_BASE_OFFSET = 16
+
+
+def _u(tf, seed2, offset, lo=0.0, hi=1.0):
+    return tf.random.stateless_uniform(
+        [], seed=seed2 + tf.constant([offset, 0], tf.int64), minval=lo, maxval=hi
+    )
+
+
+def _blend(tf, image_a, image_b, factor):
+    """PIL.Image.blend: a + factor * (b - a), clipped to uint8 range.
+    factor 0 -> a (degenerate), 1 -> b (original), >1 extrapolates."""
+    a = tf.cast(image_a, tf.float32)
+    b = tf.cast(image_b, tf.float32)
+    return tf.cast(tf.clip_by_value(a + factor * (b - a), 0.0, 255.0), tf.uint8)
+
+
+def _autocontrast(tf, image):
+    def scale_channel(ch):
+        lo = tf.cast(tf.reduce_min(ch), tf.float32)
+        hi = tf.cast(tf.reduce_max(ch), tf.float32)
+
+        def scaled():
+            scale = 255.0 / (hi - lo)
+            return tf.cast(
+                tf.clip_by_value((tf.cast(ch, tf.float32) - lo) * scale, 0.0, 255.0), tf.uint8
+            )
+
+        return tf.cond(hi > lo, scaled, lambda: ch)
+
+    return tf.stack([scale_channel(image[..., c]) for c in range(3)], axis=-1)
+
+
+def _equalize(tf, image):
+    def scale_channel(ch):
+        histo = tf.histogram_fixed_width(tf.cast(ch, tf.int32), [0, 255], nbins=256)
+        nonzero = tf.reshape(tf.gather(histo, tf.where(histo != 0)), [-1])
+        step = (tf.reduce_sum(nonzero) - nonzero[-1]) // 255
+
+        def build_lut():
+            lut = (tf.cumsum(histo) + (step // 2)) // step
+            lut = tf.concat([[0], lut[:-1]], 0)
+            return tf.cast(tf.clip_by_value(lut, 0, 255), tf.uint8)
+
+        return tf.cond(step == 0, lambda: ch, lambda: tf.gather(build_lut(), tf.cast(ch, tf.int32)))
+
+    return tf.stack([scale_channel(image[..., c]) for c in range(3)], axis=-1)
+
+
+def _invert(tf, image):
+    return 255 - image
+
+
+def _posterize(tf, image, bits):
+    # official semantics: keep `bits` high bits. The official formula yields
+    # bits=0 below magnitude 2.5, where uint8 >> 8 is UNDEFINED (hardware
+    # shift-mod); clamp to 1 kept bit instead of inheriting that UB.
+    shift = 8 - max(1, bits)
+    return tf.bitwise.left_shift(tf.bitwise.right_shift(image, shift), shift)
+
+
+def _solarize(tf, image, threshold):
+    # compare in int32: the official threshold reaches 256 at magnitude 10
+    # (PIL solarize(256) == identity), which no uint8 constant can hold
+    return tf.where(tf.cast(image, tf.int32) < threshold, image, 255 - image)
+
+
+def _solarize_add(tf, image, addition, threshold=128):
+    added = tf.cast(
+        tf.clip_by_value(tf.cast(image, tf.int32) + addition, 0, 255), tf.uint8
+    )
+    return tf.where(tf.cast(image, tf.int32) < threshold, added, image)
+
+
+def _gray3(tf, image):
+    g = tf.image.rgb_to_grayscale(image)  # uint8 in, uint8 out
+    return tf.tile(g, [1, 1, 3])
+
+
+def _color(tf, image, factor):
+    return _blend(tf, _gray3(tf, image), image, factor)
+
+
+def _contrast(tf, image, factor):
+    mean = tf.reduce_mean(tf.cast(_gray3(tf, image), tf.float32))
+    degenerate = tf.cast(tf.fill(tf.shape(image), tf.cast(tf.round(mean), tf.uint8)), tf.uint8)
+    return _blend(tf, degenerate, image, factor)
+
+
+def _brightness(tf, image, factor):
+    return _blend(tf, tf.zeros_like(image), image, factor)
+
+
+def _sharpness(tf, image, factor):
+    # degenerate = 3x3 smoothing ([[1,1,1],[1,5,1],[1,1,1]]/13) applied to
+    # the interior (borders keep the original), the PIL SMOOTH kernel
+    img = tf.cast(image, tf.float32)[None]
+    kernel = tf.constant([[1, 1, 1], [1, 5, 1], [1, 1, 1]], tf.float32) / 13.0
+    kernel = tf.tile(kernel[:, :, None, None], [1, 1, 3, 1])
+    smoothed = tf.nn.depthwise_conv2d(img, kernel, [1, 1, 1, 1], padding="VALID")
+    smoothed = tf.cast(tf.clip_by_value(smoothed, 0.0, 255.0), tf.uint8)[0]
+    pad = [[1, 1], [1, 1], [0, 0]]
+    interior = tf.pad(tf.ones_like(smoothed, tf.bool), pad)
+    degenerate = tf.where(interior, tf.pad(smoothed, pad), image)
+    return _blend(tf, degenerate, image, factor)
+
+
+def _transform(tf, image, flat):
+    """8-parameter projective transform, NEAREST + gray fill (official)."""
+    out = tf.raw_ops.ImageProjectiveTransformV3(
+        images=tf.cast(image, tf.float32)[None],
+        transforms=tf.reshape(tf.stack(flat), [1, 8]),
+        output_shape=tf.shape(image)[:2],
+        fill_value=tf.constant(float(_FILL)),
+        interpolation="NEAREST",
+        fill_mode="CONSTANT",
+    )
+    return tf.cast(out[0], tf.uint8)
+
+
+def _rotate(tf, image, degrees):
+    radians = degrees * 3.141592653589793 / 180.0
+    c, s = tf.cos(radians), tf.sin(radians)
+    h = tf.cast(tf.shape(image)[0], tf.float32)
+    w = tf.cast(tf.shape(image)[1], tf.float32)
+    cx, cy = (w - 1.0) / 2.0, (h - 1.0) / 2.0
+    # rotate about the center: translate(c) . rot . translate(-c)
+    return _transform(
+        tf, image,
+        [c, -s, cx - c * cx + s * cy, s, c, cy - s * cx - c * cy, 0.0, 0.0],
+    )
+
+
+def _shear_x(tf, image, level):
+    return _transform(tf, image, [1.0, level, 0.0, 0.0, 1.0, 0.0, 0.0, 0.0])
+
+
+def _shear_y(tf, image, level):
+    return _transform(tf, image, [1.0, 0.0, 0.0, level, 1.0, 0.0, 0.0, 0.0])
+
+
+def _translate_x(tf, image, pixels):
+    return _transform(tf, image, [1.0, 0.0, -pixels, 0.0, 1.0, 0.0, 0.0, 0.0])
+
+
+def _translate_y(tf, image, pixels):
+    return _transform(tf, image, [1.0, 0.0, 0.0, 0.0, 1.0, -pixels, 0.0, 0.0])
+
+
+def _cutout(tf, image, pad_size, seed2, offset):
+    h, w = tf.shape(image)[0], tf.shape(image)[1]
+    cy = tf.random.stateless_uniform(
+        [], seed=seed2 + tf.constant([offset, 0], tf.int64), minval=0, maxval=h, dtype=tf.int32
+    )
+    cx = tf.random.stateless_uniform(
+        [], seed=seed2 + tf.constant([offset + 1, 0], tf.int64), minval=0, maxval=w, dtype=tf.int32
+    )
+    lower, upper = tf.maximum(0, cy - pad_size), tf.minimum(h, cy + pad_size)
+    left, right = tf.maximum(0, cx - pad_size), tf.minimum(w, cx + pad_size)
+    mask = tf.pad(
+        tf.zeros([upper - lower, right - left], tf.uint8),
+        [[lower, h - upper], [left, w - right]],
+        constant_values=1,
+    )[:, :, None]
+    return image * mask + tf.cast(_FILL, tf.uint8) * (1 - mask)
+
+
+def _enhance_factor(magnitude):
+    return (magnitude / _MAX_LEVEL) * 1.8 + 0.1
+
+
+def rand_augment(tf, image, num_layers: int, magnitude: int, seed2):
+    """Apply `num_layers` randomly-selected ops at `magnitude` (0..10).
+
+    `image`: [0,255] float32 HWC (the pipeline's post-crop representation).
+    """
+    m = float(magnitude)
+    img = tf.cast(tf.clip_by_value(tf.round(image), 0.0, 255.0), tf.uint8)
+
+    for layer in range(num_layers):
+        base = _BASE_OFFSET + _LAYER_STRIDE * layer
+        # random sign for the signed (geometric/solarize-add) ops
+        sign = tf.where(_u(tf, seed2, base + 1) < 0.5, -1.0, 1.0)
+        rot = sign * (m / _MAX_LEVEL) * 30.0
+        shear = sign * (m / _MAX_LEVEL) * 0.3
+        trans = sign * (m / _MAX_LEVEL) * _TRANSLATE_CONST
+        enh = _enhance_factor(m)
+
+        def branches(img, base=base, rot=rot, shear=shear, trans=trans, enh=enh):
+            return [
+                lambda: _autocontrast(tf, img),
+                lambda: _equalize(tf, img),
+                lambda: _invert(tf, img),
+                lambda: _rotate(tf, img, rot),
+                lambda: _posterize(tf, img, int((m / _MAX_LEVEL) * 4)),
+                lambda: _solarize(tf, img, int((m / _MAX_LEVEL) * 256)),
+                lambda: _color(tf, img, enh),
+                lambda: _contrast(tf, img, enh),
+                lambda: _brightness(tf, img, enh),
+                lambda: _sharpness(tf, img, enh),
+                lambda: _shear_x(tf, img, shear),
+                lambda: _shear_y(tf, img, shear),
+                lambda: _translate_x(tf, img, trans),
+                lambda: _translate_y(tf, img, trans),
+                lambda: _cutout(tf, img, _CUTOUT_CONST, seed2, base + 4),
+                lambda: _solarize_add(tf, img, int((m / _MAX_LEVEL) * 110)),
+            ]
+
+        op_idx = tf.random.stateless_uniform(
+            [], seed=seed2 + tf.constant([base, 0], tf.int64), minval=0, maxval=16, dtype=tf.int32
+        )
+        augmented = tf.switch_case(op_idx, branches(img))
+        # official behavior: the selected op fires with p ~ U(0.2, 0.8)
+        prob = _u(tf, seed2, base + 2, 0.2, 0.8)
+        img = tf.where(_u(tf, seed2, base + 3) < prob, augmented, img)
+
+    return tf.cast(img, tf.float32)
