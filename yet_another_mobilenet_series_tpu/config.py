@@ -178,6 +178,12 @@ class OptimConfig:
     wd_skip_depthwise: bool = False
     label_smoothing: float = 0.1
     grad_clip_norm: float = 0.0  # 0 = off
+    # Mixup (arXiv:1710.09412) / CutMix (arXiv:1905.04899) — beyond
+    # reference parity, applied IN-STEP on device (train/steps.py
+    # make_batch_mixer): zero host-pipeline cost, decorrelated per replica.
+    # 0 = off; when both are set, each step picks one with p=0.5.
+    mixup_alpha: float = 0.0
+    cutmix_alpha: float = 0.0
 
 
 @dataclass(frozen=True)

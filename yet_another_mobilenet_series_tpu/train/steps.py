@@ -104,6 +104,69 @@ def _input_normalizer(cfg: Config):
     return prep
 
 
+def make_batch_mixer(cfg: Config):
+    """Mixup/CutMix as an IN-STEP device op (beyond reference parity).
+
+    GPU codebases mix on the host dataloader; here the mix lives inside the
+    jitted step — zero host cost, fused by XLA, and under shard_map each
+    replica draws a decorrelated permutation of its LOCAL shard (the step
+    rng already folds in the axis index, parallel/dp.py), which is the
+    standard device-local mixup. Returns None when both alphas are 0, so
+    disabled configs keep the exact pre-mixup program.
+
+    mix(rng, x, labels) -> (x_mixed, labels_b, lam): per-batch lam ~
+    Beta(alpha, alpha); CutMix pastes a (H*sqrt(1-lam), W*sqrt(1-lam)) box
+    from the permuted batch, clipped at the borders, and returns lam
+    ADJUSTED to the actual pasted area (arXiv:1905.04899 §3.1). When both
+    alphas are set, each step picks one with p=0.5 (the timm convention).
+    """
+    m_a, c_a = cfg.optim.mixup_alpha, cfg.optim.cutmix_alpha
+    if m_a < 0 or c_a < 0:
+        raise ValueError(f"mixup/cutmix alphas must be >= 0, got {m_a}/{c_a}")
+    if m_a == 0 and c_a == 0:
+        return None
+
+    def mix(rng, x, labels):
+        r_sel, r_lam_m, r_lam_c, r_perm, r_box = jax.random.split(rng, 5)
+        n, h, w = x.shape[0], x.shape[1], x.shape[2]
+        perm = jax.random.permutation(r_perm, n)
+        x_b, y_b = x[perm], labels[perm]
+
+        use_cutmix = (
+            jax.random.bernoulli(r_sel, 0.5)
+            if (m_a > 0 and c_a > 0)
+            else jnp.asarray(c_a > 0)
+        )
+
+        # mixup half
+        lam_m = jax.random.beta(r_lam_m, m_a, m_a) if m_a > 0 else jnp.float32(1.0)
+        x_mix = lam_m.astype(x.dtype) * x + (1.0 - lam_m).astype(x.dtype) * x_b
+
+        # cutmix half: box centered uniformly, side = dim * sqrt(1 - lam)
+        lam_c = jax.random.beta(r_lam_c, c_a, c_a) if c_a > 0 else jnp.float32(1.0)
+        cut = jnp.sqrt(1.0 - lam_c)
+        rh, rw = jnp.round(h * cut), jnp.round(w * cut)
+        cy = jax.random.randint(r_box, (), 0, h)
+        cx = jax.random.fold_in(r_box, 1)
+        cx = jax.random.randint(cx, (), 0, w)
+        iy = jnp.arange(h)[None, :, None, None]
+        ix = jnp.arange(w)[None, None, :, None]
+        in_box = (
+            (iy >= cy - rh // 2) & (iy < cy + (rh + 1) // 2)
+            & (ix >= cx - rw // 2) & (ix < cx + (rw + 1) // 2)
+        )
+        x_cut = jnp.where(in_box, x_b, x)
+        # actual pasted fraction (border clipping makes it < (1-lam_c))
+        frac = jnp.mean(in_box.astype(jnp.float32))
+        lam_cut = 1.0 - frac
+
+        x_out = jnp.where(use_cutmix, x_cut, x_mix)
+        lam = jnp.where(use_cutmix, lam_cut, lam_m).astype(jnp.float32)
+        return x_out, y_b, lam
+
+    return mix
+
+
 def make_train_step(
     net: Network,
     cfg: Config,
@@ -166,10 +229,21 @@ def make_train_step(
             )
 
     prep_input = _input_normalizer(cfg)
+    mixer = make_batch_mixer(cfg)
 
     def loss_fn(params, state, batch, masks, rho_mult, step, rng):
-        logits, new_state = forward(params, state, prep_input(batch["image"]), masks, rng)
+        x = prep_input(batch["image"])
+        if mixer is not None:
+            # distinct stream from the forward's dropout/drop-path rngs
+            # (blocks fold small indices, classifier uses the raw key)
+            x, label_b, lam = mixer(jax.random.fold_in(rng, 0x6D6978), x, batch["label"])
+        logits, new_state = forward(params, state, x, masks, rng)
         ce = cross_entropy_label_smooth(logits, batch["label"], cfg.optim.label_smoothing)
+        if mixer is not None:
+            # CE is linear in the target distribution, so the convex label
+            # combination IS the convex loss combination (smoothing included)
+            ce = lam * ce + (1.0 - lam) * cross_entropy_label_smooth(
+                logits, label_b, cfg.optim.label_smoothing)
         pen = (
             penalty_fn(params, masks, rho_mult=rho_mult, step=step)
             if penalty_fn is not None
