@@ -16,6 +16,35 @@ import torch.nn as tnn  # noqa: E402
 from yet_another_mobilenet_series_tpu.ops.blocks import InvertedResidual  # noqa: E402
 
 
+def _copy_conv(torch_conv, w_hwio):
+    """HWIO -> OIHW copy of one of OUR conv weights into a torch Conv2d."""
+    torch_conv.weight.copy_(torch.from_numpy(np.asarray(w_hwio, np.float64).transpose(3, 2, 0, 1)))
+
+
+def _copy_bn(bn_t, key, params, state):
+    """gamma/beta from OUR params + non-trivial running stats (stable
+    crc32-seeded so a tolerance failure reproduces across processes) written
+    to BOTH sides."""
+    import zlib
+
+    bn_t.weight.copy_(torch.from_numpy(np.asarray(params[key]["gamma"], np.float64)))
+    bn_t.bias.copy_(torch.from_numpy(np.asarray(params[key]["beta"], np.float64)))
+    seed = zlib.crc32(key.encode()) % 2**31
+    mean = np.random.RandomState(seed).normal(0, 0.3, bn_t.weight.shape[0])
+    var = np.random.RandomState(seed + 1).uniform(0.5, 1.5, bn_t.weight.shape[0])
+    bn_t.running_mean.copy_(torch.from_numpy(mean))
+    bn_t.running_var.copy_(torch.from_numpy(var))
+    state[key] = {"mean": jnp.asarray(mean, jnp.float32), "var": jnp.asarray(var, jnp.float32)}
+
+
+def _copy_se(tm, params):
+    tm.se_reduce.weight.copy_(torch.from_numpy(np.asarray(params["se"]["reduce"]["w"], np.float64).T))
+    tm.se_reduce.bias.copy_(torch.from_numpy(np.asarray(params["se"]["reduce"]["b"], np.float64)))
+    tm.se_expand.weight.copy_(torch.from_numpy(np.asarray(params["se"]["expand"]["w"], np.float64).T))
+    tm.se_expand.bias.copy_(torch.from_numpy(np.asarray(params["se"]["expand"]["b"], np.float64)))
+
+
+
 class TorchMBConv(tnn.Module):
     """Reference-style MBConv: expand 1x1 -> BN -> ReLU6 -> dw kxk -> BN ->
     ReLU6 -> [SE] -> project 1x1 -> BN (+residual)."""
@@ -60,27 +89,86 @@ def test_mbconv_block_matches_torch(cin, cout, exp, k, stride, se):
 
     tm = TorchMBConv(cin, cout, exp, k, stride, se).double().eval()
     with torch.no_grad():
-        # copy OUR params into the torch module (HWIO -> OIHW)
-        tm.expand.weight.copy_(torch.from_numpy(np.asarray(params["expand"]["w"], np.float64).transpose(3, 2, 0, 1)))
-        tm.dw.weight.copy_(torch.from_numpy(np.asarray(params[f"dw0_k{k}"]["w"], np.float64).transpose(3, 2, 0, 1)))
-        tm.project.weight.copy_(torch.from_numpy(np.asarray(params["project"]["w"], np.float64).transpose(3, 2, 0, 1)))
+        _copy_conv(tm.expand, params["expand"]["w"])
+        _copy_conv(tm.dw, params[f"dw0_k{k}"]["w"])
+        _copy_conv(tm.project, params["project"]["w"])
         for bn_t, key in [(tm.bn1, "expand_bn"), (tm.bn2, "dw_bn"), (tm.bn3, "project_bn")]:
-            bn_t.weight.copy_(torch.from_numpy(np.asarray(params[key]["gamma"], np.float64)))
-            bn_t.bias.copy_(torch.from_numpy(np.asarray(params[key]["beta"], np.float64)))
-            # non-trivial running stats so eval mode is a real test
-            mean = np.random.RandomState(hash(key) % 2**31).normal(0, 0.3, bn_t.weight.shape[0])
-            var = np.random.RandomState(hash(key) % 2**31 + 1).uniform(0.5, 1.5, bn_t.weight.shape[0])
-            bn_t.running_mean.copy_(torch.from_numpy(mean))
-            bn_t.running_var.copy_(torch.from_numpy(var))
-            state[key] = {"mean": jnp.asarray(mean, jnp.float32), "var": jnp.asarray(var, jnp.float32)}
+            _copy_bn(bn_t, key, params, state)
         if se:
-            tm.se_reduce.weight.copy_(torch.from_numpy(np.asarray(params["se"]["reduce"]["w"], np.float64).T))
-            tm.se_reduce.bias.copy_(torch.from_numpy(np.asarray(params["se"]["reduce"]["b"], np.float64)))
-            tm.se_expand.weight.copy_(torch.from_numpy(np.asarray(params["se"]["expand"]["w"], np.float64).T))
-            tm.se_expand.bias.copy_(torch.from_numpy(np.asarray(params["se"]["expand"]["b"], np.float64)))
+            _copy_se(tm, params)
 
     x = np.random.RandomState(7).normal(size=(2, 9, 9, cin)).astype(np.float32)
     y_ours, _ = spec.apply(params, state, jnp.asarray(x), train=False)
+    with torch.no_grad():
+        y_torch = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)).double()).numpy().transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y_ours), y_torch, rtol=1e-4, atol=1e-5)
+
+
+class TorchEffMBConv(tnn.Module):
+    """EfficientNet-style MBConv: [expand 1x1 -> BN -> SiLU] (skipped at
+    t=1) -> dw kxk -> BN -> SiLU -> SE(silu inner, sigmoid gate) ->
+    project 1x1 -> BN (+residual). BN eps 1e-3 (the EfficientNet value)."""
+
+    def __init__(self, cin, cout, exp, k, stride, se_ch):
+        super().__init__()
+        self.has_expand = exp != cin
+        if self.has_expand:
+            self.expand = tnn.Conv2d(cin, exp, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(exp, eps=1e-3)
+        self.dw = tnn.Conv2d(exp, exp, k, stride, padding=k // 2, groups=exp, bias=False)
+        self.bn2 = tnn.BatchNorm2d(exp, eps=1e-3)
+        self.se_reduce = tnn.Linear(exp, se_ch)
+        self.se_expand = tnn.Linear(se_ch, exp)
+        self.project = tnn.Conv2d(exp, cout, 1, bias=False)
+        self.bn3 = tnn.BatchNorm2d(cout, eps=1e-3)
+        self.residual = stride == 1 and cin == cout
+
+    def forward(self, x):
+        h = x
+        if self.has_expand:
+            h = tnn.functional.silu(self.bn1(self.expand(h)))
+        h = tnn.functional.silu(self.bn2(self.dw(h)))
+        s = h.mean(dim=(2, 3))
+        s = self.se_expand(tnn.functional.silu(self.se_reduce(s)))
+        h = h * torch.sigmoid(s)[:, :, None, None]
+        h = self.bn3(self.project(h))
+        return h + x if self.residual else h
+
+
+@pytest.mark.parametrize("cin,cout,exp,k,stride,se", [
+    (32, 16, 32, 3, 1, 8),     # B0 stage-1: t=1 expand-skip + SE, no residual
+    (16, 16, 96, 3, 1, 4),     # t=6 + SE + residual
+    (24, 24, 144, 5, 1, 6),    # k=5 + SE + residual
+])
+def test_efficientnet_block_matches_torch(cin, cout, exp, k, stride, se):
+    """The EfficientNet family's block semantics (swish everywhere, SE with
+    swish inner FC and sigmoid gate sized from the block INPUT, t=1 expand
+    skip, BN eps 1e-3) match a torch implementation numerically; drop_path
+    is an exact eval no-op."""
+    spec = InvertedResidual(
+        in_channels=cin, out_channels=cout, expanded_channels=exp, stride=stride,
+        kernel_sizes=(k,), active_fn="swish", se_channels=se, se_gate_fn="sigmoid",
+        se_inner_act="swish", bn_eps=1e-3, drop_path=0.1,
+    )
+    params, state = spec.init(jax.random.PRNGKey(0))
+    tm = TorchEffMBConv(cin, cout, exp, k, stride, se).double().eval()
+    with torch.no_grad():
+        if spec.has_expand:
+            _copy_conv(tm.expand, params["expand"]["w"])
+        _copy_conv(tm.dw, params[f"dw0_k{k}"]["w"])
+        _copy_conv(tm.project, params["project"]["w"])
+        bns = [(tm.bn2, "dw_bn"), (tm.bn3, "project_bn")]
+        if spec.has_expand:
+            bns.append((tm.bn1, "expand_bn"))
+        for bn_t, key in bns:
+            _copy_bn(bn_t, key, params, state)
+        _copy_se(tm, params)
+
+    x = np.random.RandomState(7).normal(size=(2, 9, 9, cin)).astype(np.float32)
+    y_ours, _ = spec.apply(params, state, jnp.asarray(x), train=False)
+    # drop_path must not perturb eval even when an rng is supplied
+    y_rng, _ = spec.apply(params, state, jnp.asarray(x), train=False, rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(y_ours), np.asarray(y_rng))
     with torch.no_grad():
         y_torch = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)).double()).numpy().transpose(0, 2, 3, 1)
     np.testing.assert_allclose(np.asarray(y_ours), y_torch, rtol=1e-4, atol=1e-5)
