@@ -51,6 +51,7 @@ def test_efficientnet_exact_published_params():
     assert get_model(ModelConfig(arch="efficientnet_b0", width_mult=0.5)).head.out_channels == 640
 
 
+@pytest.mark.slow  # ~56 s: eager B0 applies dominate (fast-gate budget, pytest.ini)
 def test_stochastic_depth(tmp_path):
     """EfficientNet drop_connect: linear per-block depth ramp, per-SAMPLE
     Bernoulli residual drop at train time (inverse-scaled), exact no-op at

@@ -223,22 +223,23 @@ def test_check_vma_contract():
                 "revisit ops/layers.py _bn_train_fused_bwd before changing this")
 
 
-def test_grouped_step_equals_single_steps(setup):
-    """steps_per_dispatch semantics: k steps in ONE jit dispatch
-    (dp.make_grouped_train_step) equal k single dispatches — same batches
-    in the same order, same per-step rng fold (via ts.step) — up to XLA
-    fusion-boundary rounding: compiling k steps as one program lets XLA
-    fuse ACROSS steps, so f32 reduction orders differ at ~1e-7 rel
-    (measured; bit-identity is NOT the contract, unlike remat)."""
-    cfg, net, lr_fn, opt, ts0, _ = setup
+
+
+def _assert_single_equals_grouped(cfg, net, lr_fn, opt, ts0, *, batch_seed0,
+                                  n_batches, k, metric_keys):
+    """Run n_batches through k-per-dispatch grouped steps and through single
+    dispatches (same batches/order, same per-step rng fold via ts.step) and
+    assert params + metrics agree at the XLA fusion-boundary tolerance
+    (~1e-7 rel: one k-step program fuses ACROSS steps; bit-identity is NOT
+    the contract, unlike remat). Returns the grouped final state."""
     m = mesh_lib.make_mesh(8)
     rng = jax.random.PRNGKey(9)
     batches = [
         mesh_lib.shard_batch({
-            "image": np.asarray(jax.random.normal(jax.random.PRNGKey(10 + i), (16, 16, 16, 3))),
+            "image": np.asarray(jax.random.normal(jax.random.PRNGKey(batch_seed0 + i), (16, 16, 16, 3))),
             "label": np.asarray((jnp.arange(16) + i) % 8),
         }, m)
-        for i in range(4)
+        for i in range(n_batches)
     ]
     step = dp.make_dp_train_step(net, cfg, opt, lr_fn, m)
 
@@ -251,22 +252,51 @@ def test_grouped_step_equals_single_steps(setup):
         single_metrics.append(met)
     params_single = jax.device_get(ts_single.params)
 
-    grouped = dp.make_grouped_train_step(step, 2)
+    grouped = dp.make_grouped_train_step(step, k)
     ts_grp = mesh_lib.replicate(jax.tree.map(jnp.copy, ts0), m)
     grouped_metrics = []
-    ts_grp, mets = grouped(ts_grp, tuple(batches[:2]), rng)
-    grouped_metrics += mets
-    ts_grp, mets = grouped(ts_grp, tuple(batches[2:]), rng)
-    grouped_metrics += mets
+    for i in range(0, n_batches, k):
+        ts_grp, mets = grouped(ts_grp, tuple(batches[i:i + k]), rng)
+        grouped_metrics += mets
     params_grp = jax.device_get(ts_grp.params)
 
-    assert int(ts_grp.step) == 4
     for a, b in zip(jax.tree.leaves(params_single), jax.tree.leaves(params_grp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
     for i, (ms, mg) in enumerate(zip(single_metrics, grouped_metrics)):
-        for key in ("loss", "grad_norm", "top1", "lr"):
+        for key in metric_keys:
             np.testing.assert_allclose(float(ms[key]), float(mg[key]),
                                        rtol=1e-5, err_msg=f"step {i} {key}")
+    return ts_grp
+
+def test_grouped_step_equals_single_steps(setup):
+    """steps_per_dispatch semantics: k steps in ONE jit dispatch
+    (dp.make_grouped_train_step) equal k single dispatches — same batches
+    in the same order, same per-step rng fold (via ts.step) — up to XLA
+    fusion-boundary rounding: compiling k steps as one program lets XLA
+    fuse ACROSS steps, so f32 reduction orders differ at ~1e-7 rel
+    (measured; bit-identity is NOT the contract, unlike remat)."""
+    cfg, net, lr_fn, opt, ts0, _ = setup
+    ts_grp = _assert_single_equals_grouped(
+        cfg, net, lr_fn, opt, ts0, batch_seed0=10, n_batches=4, k=2,
+        metric_keys=("loss", "grad_norm", "top1", "lr"))
+    assert int(ts_grp.step) == 4
 
     with pytest.raises(ValueError, match="k >= 2"):
-        dp.make_grouped_train_step(step, 1)
+        dp.make_grouped_train_step(lambda ts, b, r: (ts, {}), 1)
+
+
+@pytest.mark.slow  # ~60 s: two 8-device program builds (fast-gate budget)
+def test_grouped_step_equals_single_steps_with_mixup(setup):
+    """Composition pin: in-step Mixup/CutMix adds per-step rng draws inside
+    the loss; grouped dispatch must reproduce the SAME mix decisions as k
+    single dispatches (the mix key folds ts.step, which advances inside the
+    grouped program)."""
+    import dataclasses as dc
+
+    cfg, net, lr_fn, opt, ts0, _ = setup
+    cfg = dc.replace(cfg, optim=dc.replace(cfg.optim, mixup_alpha=0.2, cutmix_alpha=1.0))
+    # loss depends on the drawn lam/permutation: agreement at fusion
+    # tolerance proves the grouped program drew the SAME mixes
+    _assert_single_equals_grouped(
+        cfg, net, lr_fn, opt, ts0, batch_seed0=20, n_batches=2, k=2,
+        metric_keys=("loss", "grad_norm"))

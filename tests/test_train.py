@@ -426,6 +426,7 @@ def test_batch_mixer_semantics():
     assert found
 
 
+@pytest.mark.slow  # ~32 s: two jitted step builds (fast-gate budget, pytest.ini)
 def test_train_step_with_mixup_cutmix_runs_and_differs():
     cfg_mix = _tiny_cfg(optim={"mixup_alpha": 0.2, "cutmix_alpha": 1.0, "weight_decay": 1e-5})
     cfg_off = _tiny_cfg()
