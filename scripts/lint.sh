@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# yamt-lint over the package, JSON report, nonzero exit on any finding.
+#
+# The same check the tier-1 gate runs (tests/test_lint_clean.py), packaged
+# for CI / pre-commit: machine-readable output on stdout, findings count on
+# stderr. Usage: scripts/lint.sh [extra paths...]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+# the analyzer is pure AST — it never executes package code, so no
+# accelerator/platform setup is needed
+out=$(python -m yet_another_mobilenet_series_tpu.analysis --format json \
+    yet_another_mobilenet_series_tpu/ "$@") || rc=$?
+echo "$out"
+if [ "${rc:-0}" -ne 0 ]; then
+    count=$(echo "$out" | python -c 'import json, sys
+try:
+    print(json.load(sys.stdin)["count"])
+except Exception:
+    print("?")')
+    echo "yamt-lint: ${count} finding(s) — see docs/LINT.md" >&2
+    exit "${rc:-1}"
+fi
+echo "yamt-lint: clean" >&2

@@ -1,0 +1,34 @@
+"""YAMT001 must flag: host-side effects inside jit/shard_map-traced fns."""
+
+import time
+
+import jax
+import numpy as np
+
+
+def step(state, x):
+    print("stepping", x)  # trace-time only, never per step
+    t0 = time.time()  # frozen at trace time
+    noise = np.random.rand()  # host RNG baked into the program as a constant
+    loss = float(x)  # host sync / ConcretizationTypeError on a tracer
+    return state + x * noise + t0 + loss
+
+
+def readback(x):
+    return x.mean().item()  # forces a device->host sync inside the program
+
+
+def make_step(optimizer):
+    # the inner fn is returned and jitted in ANOTHER module, but its lax
+    # collective proves it is a traced context — the print must flag
+    from jax import lax
+
+    def step_fn(ts, batch):
+        print("loss", ts)
+        return lax.pmean(ts, "data")
+
+    return step_fn
+
+
+step_jit = jax.jit(step)
+readback_jit = jax.jit(readback)

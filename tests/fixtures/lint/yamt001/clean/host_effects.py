@@ -1,0 +1,37 @@
+"""YAMT001 must stay silent: effects outside trace, jax.debug inside."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def step(state, x):
+    jax.debug.print("stepping {x}", x=x)  # the sanctioned in-trace print
+    return state + jnp.mean(x)
+
+
+step_jit = jax.jit(step)
+
+
+def driver(batches):
+    # host-side timing/printing OUTSIDE the traced function is fine
+    t0 = time.time()
+    state = 0.0
+    for b in batches:
+        state = step_jit(state, b)
+    print("took", time.time() - t0)
+    return float(state)  # host readback after the step is fine
+
+
+def make_step(optimizer):
+    # BUILD-TIME host code in a step factory is host code: the collective
+    # lives in the nested def, which makes its own (clean) root decision
+    from jax import lax
+
+    print("building step with", optimizer)
+
+    def step_fn(ts, batch):
+        return lax.pmean(ts, "data") + jnp.mean(batch)
+
+    return step_fn

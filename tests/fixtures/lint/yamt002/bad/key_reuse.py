@@ -1,0 +1,16 @@
+"""YAMT002 must flag: the same PRNG key consumed by two draws / in a loop."""
+
+import jax
+
+
+def sample(rng):
+    a = jax.random.normal(rng, (4,))
+    b = jax.random.uniform(rng, (4,))  # second draw off the SAME key
+    return a + b
+
+
+def loop_reuse(key, n):
+    total = 0.0
+    for _ in range(n):
+        total = total + jax.random.normal(key)  # same key every iteration
+    return total

@@ -1,0 +1,36 @@
+"""YAMT002 must stay silent: split/fold_in before every draw, branches merge."""
+
+import jax
+
+
+def sample(rng):
+    r_a, r_b = jax.random.split(rng)
+    a = jax.random.normal(r_a, (4,))
+    b = jax.random.uniform(r_b, (4,))
+    return a + b
+
+
+def loop_ok(key, n):
+    total = 0.0
+    for i in range(n):
+        total = total + jax.random.normal(jax.random.fold_in(key, i))
+    return total
+
+
+def branches_ok(rng, flag):
+    # mutually exclusive draws off one key are fine (exactly one executes)
+    if flag:
+        return jax.random.normal(rng, (2,))
+    return jax.random.uniform(rng, (2,))
+
+
+def rebind_ok(rng):
+    x = jax.random.normal(rng, (2,))
+    rng = jax.random.fold_in(rng, 1)
+    y = jax.random.normal(rng, (2,))
+    return x + y
+
+
+def ternary_ok(rng, flag):
+    # a conditional expression's arms are exclusive, like if/else branches
+    return jax.random.normal(rng) if flag else jax.random.uniform(rng)

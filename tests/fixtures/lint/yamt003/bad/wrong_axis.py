@@ -1,0 +1,13 @@
+"""YAMT003 must flag: collectives over an axis name no mesh defines."""
+
+from jax import lax
+
+DATA_AXIS = "data"  # the project's one mesh axis
+
+
+def allreduce(x):
+    return lax.psum(x, "batch")  # no mesh defines 'batch'
+
+
+def rank():
+    return lax.axis_index("model")  # nor 'model'

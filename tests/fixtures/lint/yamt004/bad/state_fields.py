@@ -1,0 +1,17 @@
+"""YAMT004 must flag: FIELDS tuple drifted from its dataclass."""
+
+from typing import Any
+
+import flax.struct
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: int
+    params: Any
+    opt_state: Any
+    ema_params: Any
+
+
+# missing 'ema_params' — a checkpoint built from this tuple silently drops it
+TRAIN_STATE_FIELDS = ("step", "params", "opt_state")

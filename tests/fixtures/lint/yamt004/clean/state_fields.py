@@ -1,0 +1,18 @@
+"""YAMT004 must stay silent: tuple and dataclass agree exactly, in order."""
+
+from typing import Any
+
+import flax.struct
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: int
+    params: Any
+    opt_state: Any
+
+
+TRAIN_STATE_FIELDS = ("step", "params", "opt_state")
+
+# a FIELDS tuple with no matching dataclass anywhere is out of scope
+UNRELATED_FIELDS = ("a", "b")

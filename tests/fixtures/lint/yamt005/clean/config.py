@@ -1,0 +1,16 @@
+"""YAMT005 fixture schema: a miniature config.py (name matters — the rule
+finds the schema by basename)."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    epochs: float = 1.0
+    batch_size: int = 256
+
+
+@dataclass(frozen=True)
+class Config:
+    name: str = "experiment"
+    train: TrainConfig = field(default_factory=TrainConfig)

@@ -1,0 +1,10 @@
+"""YAMT006 must stay silent: version-guarded imports are the sanctioned idiom
+(this is the shape of utils/compat.py)."""
+
+try:  # newer jax: public top-level export
+    from jax import shard_map
+except ImportError:  # jax <= 0.5
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+from jax import lax  # stable public surface is fine
+from jax.experimental import pallas  # experimental-but-present is not flagged

@@ -1,0 +1,33 @@
+"""Tier-1 gate: the package must lint clean under its own analyzer.
+
+This is the enforcement half of the yamt-lint tentpole: every invariant the
+rules encode (no host effects under trace, PRNG discipline, real mesh axes,
+TRAIN_STATE_FIELDS/TrainState agreement, apps/*.yml vs config.py schema,
+version-resilient jax imports — docs/LINT.md) is checked on every PR by this
+sub-second, pure-AST test. A finding here is a real hazard or an undocumented
+suppression — fix the code, don't widen the gate.
+"""
+
+import pathlib
+
+from yet_another_mobilenet_series_tpu.analysis import run_lint
+
+PACKAGE = pathlib.Path(__file__).resolve().parent.parent / "yet_another_mobilenet_series_tpu"
+
+
+def test_package_lints_clean():
+    findings = run_lint([PACKAGE])
+    assert findings == [], (
+        "the package must lint clean (see docs/LINT.md):\n"
+        + "\n".join(f.format() for f in findings)
+    )
+
+
+def test_apps_ymls_are_covered():
+    # guard against the gate silently losing its yml coverage: the collector
+    # must actually pick up the experiment files next to the code
+    from yet_another_mobilenet_series_tpu.analysis.core import collect_paths
+
+    py, yml = collect_paths([PACKAGE])
+    assert any(p.endswith("config.py") for p in py)
+    assert sum(p.endswith((".yml", ".yaml")) for p in yml) >= 10

@@ -260,12 +260,18 @@ def _assert_single_equals_grouped(cfg, net, lr_fn, opt, ts0, *, batch_seed0,
         grouped_metrics += mets
     params_grp = jax.device_get(ts_grp.params)
 
+    # atol: measured 3.2e-6 max abs divergence under jax 0.4.37's CPU XLA
+    # (cross-step fusion reorders f32 reductions, then RMSProp's rsqrt
+    # amplifies); a real bug (wrong batch order / rng fold) shows ~1e-2
     for a, b in zip(jax.tree.leaves(params_single), jax.tree.leaves(params_grp)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=5e-6)
+    # rtol: grad_norm is a global reduction over every param, the most
+    # rounding-sensitive scalar; measured 1.4e-5 rel drift by step 3 under
+    # jax 0.4.37's CPU XLA (a real divergence shows >=1e-2)
     for i, (ms, mg) in enumerate(zip(single_metrics, grouped_metrics)):
         for key in metric_keys:
             np.testing.assert_allclose(float(ms[key]), float(mg[key]),
-                                       rtol=1e-5, err_msg=f"step {i} {key}")
+                                       rtol=1e-4, err_msg=f"step {i} {key}")
     return ts_grp
 
 def test_grouped_step_equals_single_steps(setup):

@@ -329,7 +329,13 @@ def test_bn_variants_converge_identically():
 
 
 def test_train_step_overfits_tiny_batch():
-    cfg = _tiny_cfg()
+    # _tiny_cfg's lr=0.05 is chaotic for TF-RMSProp on this batch-8 toy net
+    # (loss oscillates 0.42 -> 0.98 -> 5.6 over 30-60 steps, measured under
+    # jax 0.4.37 — the step-30 reading was a coin flip). 0.02 converges
+    # monotonically to ~0.25x the first loss; the 0.7 bar keeps real margin.
+    cfg = _tiny_cfg(
+        schedule={"schedule": "constant", "base_lr": 0.02, "scale_by_batch": False, "warmup_epochs": 0.0}
+    )
     net = get_model(cfg.model, image_size=16)
     lr_fn = schedules.make_lr_schedule(cfg.schedule, 8, 1, 100)
     params, _ = net.init(jax.random.PRNGKey(0))

@@ -1,0 +1,28 @@
+"""yamt-lint: AST-based tracing-safety and SPMD-contract analysis.
+
+The invariants that make train/steps.py compile to ONE XLA program over the
+``('data',)`` mesh — no host effects under trace, disciplined PRNG key use,
+collectives over real mesh axes, checkpoint-layout/dataclass agreement,
+yml/config schema agreement, version-resilient jax imports — are all
+detectable from source without importing it. This package detects them:
+rules YAMT001-YAMT006 (see docs/LINT.md), a suppression syntax, text/JSON
+reporters, and a CLI (``python -m yet_another_mobilenet_series_tpu.analysis``).
+
+The tier-1 gate runs the analyzer over this package (tests/test_lint_clean.py),
+so every invariant here is enforced on every PR.
+"""
+
+from .core import Finding, Project, Rule, SourceFile, load_rules, register, run_lint
+from .reporters import render_json, render_text
+
+__all__ = [
+    "Finding",
+    "Project",
+    "Rule",
+    "SourceFile",
+    "load_rules",
+    "register",
+    "render_json",
+    "render_text",
+    "run_lint",
+]

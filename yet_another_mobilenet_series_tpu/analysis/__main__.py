@@ -1,0 +1,7 @@
+"""`python -m yet_another_mobilenet_series_tpu.analysis` -> yamt-lint."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

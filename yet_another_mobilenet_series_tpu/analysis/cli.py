@@ -1,0 +1,53 @@
+"""yamt-lint command line.
+
+Entry points (equivalent):
+
+    python -m yet_another_mobilenet_series_tpu.analysis [paths...]
+    python -m yet_another_mobilenet_series_tpu.cli.lint [paths...]
+
+With no paths, lints the installed package itself. Exit codes: 0 clean,
+1 findings, 2 usage error (argparse). JSON mode feeds scripts/lint.sh and CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import load_rules, run_lint
+from .reporters import render_json, render_text
+
+
+def _default_path() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="yamt-lint",
+        description="JAX/TPU tracing-safety and SPMD-contract static analyzer (docs/LINT.md)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint (default: this package)")
+    p.add_argument("--format", choices=("text", "json"), default="text", help="report format")
+    p.add_argument("--select", default="", metavar="IDS", help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule in load_rules():
+            print(f"{rule.id}  {rule.name}\n    {rule.description}")
+        return 0
+
+    select = {s.strip().upper() for s in args.select.split(",") if s.strip()} or None
+    try:
+        findings = run_lint(args.paths or [_default_path()], select=select)
+    except (OSError, ValueError) as e:
+        print(f"yamt-lint: {e}", file=sys.stderr)
+        return 2
+    print(render_json(findings) if args.format == "json" else render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""yamt-lint core: source model, rule registry, suppressions, runner.
+
+The analyzer is pure AST — it never imports the code under analysis, so it
+runs in milliseconds per file and cannot be broken by the very hazards it
+hunts (a version-fragile jax import crashes ``import``, not ``ast.parse``).
+
+Two rule shapes:
+
+- file rules (``Rule.check_file``): one parsed module at a time, with the
+  whole :class:`Project` available for cross-file context (e.g. the set of
+  known mesh-axis constants);
+- project rules (``Rule.check_project``): whole-tree invariants that have no
+  single home file (dataclass/field-tuple contracts, YAML/config drift).
+
+Suppressions are comment-driven, pylint-style::
+
+    lax.psum(x, "data")  # yamt-lint: disable=YAMT003
+    # yamt-lint: disable-file=YAMT001,YAMT002   (anywhere in the file)
+
+``disable=all`` silences every rule for that line (or file).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Sequence
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One lint hit, orderable into a stable (path, line, col) report."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*yamt-lint:\s*disable(?P<scope>-file)?\s*=\s*(?P<rules>[A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+def _import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local binding -> dotted origin, from every import in the module.
+
+    ``import numpy as np`` -> ``{'np': 'numpy'}``; ``from jax import lax`` ->
+    ``{'lax': 'jax.lax'}``; relative imports keep their leading dots so they
+    can never collide with an absolute ``jax.*``/``numpy.*`` match.
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    aliases[a.asname] = a.name
+                else:
+                    top = a.name.split(".")[0]
+                    aliases[top] = top
+        elif isinstance(node, ast.ImportFrom):
+            base = "." * node.level + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{base}.{a.name}" if base else a.name
+    return aliases
+
+
+def qualified_name(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """Dotted path of a Name/Attribute chain with import aliases resolved.
+
+    ``lax.psum`` under ``from jax import lax`` -> ``'jax.lax.psum'``; returns
+    None when the chain is not rooted in a plain name (call results,
+    subscripts).
+    """
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+class SourceFile:
+    """One .py file: text, parsed tree, suppression table, import aliases."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.aliases = _import_aliases(self.tree) if self.tree is not None else {}
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        for lineno, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip().upper() for r in m.group("rules").split(",")}
+            if m.group("scope"):
+                self.file_suppressions |= rules
+            else:
+                self.line_suppressions.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, finding: Finding) -> bool:
+        for scope in (self.file_suppressions, self.line_suppressions.get(finding.line, ())):
+            if "ALL" in scope or finding.rule.upper() in scope:
+                return True
+        return False
+
+
+class Project:
+    """Every parsed source + data file under the linted paths."""
+
+    def __init__(self, files: Sequence[SourceFile], yml_files: Sequence[str] = ()):
+        self.files = list(files)
+        self.yml_files = list(yml_files)
+        self._axis_constants: dict[str, str] | None = None
+
+    @property
+    def axis_constants(self) -> dict[str, str]:
+        """Module-level ``X_AXIS = "name"`` string constants across the
+        project (``parallel/mesh.py`` ``DATA_AXIS`` in production):
+        constant name -> axis name. Ground truth for YAMT003."""
+        if self._axis_constants is None:
+            consts: dict[str, str] = {}
+            for src in self.files:
+                if src.tree is None:
+                    continue
+                for node in src.tree.body:
+                    if (
+                        isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id.isupper()
+                        and node.targets[0].id.endswith("_AXIS")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)
+                    ):
+                        consts[node.targets[0].id] = node.value.value
+            self._axis_constants = consts
+        return self._axis_constants
+
+
+class Rule:
+    """Base class; subclasses register with :func:`register` and implement
+    ``check_file`` and/or ``check_project``."""
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        return []
+
+    def check_project(self, project: Project) -> list[Finding]:
+        return []
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"{cls.__name__} has no rule id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def load_rules() -> list[Rule]:
+    """Import every rule module (registration side effect) and return the
+    registry sorted by id."""
+    from . import rules_config, rules_imports, rules_spmd, rules_tracing  # noqa: F401
+
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def collect_paths(paths: Iterable[str]) -> tuple[list[str], list[str]]:
+    """Expand files/directories into (.py files, .yml files), stably sorted."""
+    py: list[str] = []
+    yml: list[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in ("__pycache__", ".git", ".pytest_cache"))
+                for n in sorted(names):
+                    full = os.path.join(root, n)
+                    if n.endswith(".py"):
+                        py.append(full)
+                    elif n.endswith((".yml", ".yaml")):
+                        yml.append(full)
+        elif p.endswith(".py"):
+            py.append(p)
+        elif p.endswith((".yml", ".yaml")):
+            yml.append(p)
+        else:
+            raise ValueError(f"not a directory, .py, or .yml path: {p}")
+    return py, yml
+
+
+def run_lint(paths: Iterable[str], select: set[str] | None = None) -> list[Finding]:
+    """Lint ``paths`` (files or directories) and return sorted findings.
+
+    ``select`` restricts to a set of rule ids (upper-case). Suppression
+    comments are honored here, so callers only ever see live findings.
+    """
+    rules = load_rules()
+    if select is not None:
+        rules = [r for r in rules if r.id in select]
+    py_paths, yml_paths = collect_paths(paths)
+    findings: list[Finding] = []
+    files: list[SourceFile] = []
+    for path in py_paths:
+        with open(path, encoding="utf-8") as f:
+            src = SourceFile(path, f.read())
+        if src.parse_error is not None:
+            e = src.parse_error
+            findings.append(
+                Finding(path, e.lineno or 1, max((e.offset or 1) - 1, 0), "YAMT000", f"syntax error: {e.msg}")
+            )
+            continue
+        files.append(src)
+    project = Project(files, yml_paths)
+    by_path = {src.path: src for src in files}
+    for rule in rules:
+        for src in files:
+            findings.extend(f for f in rule.check_file(src, project) if not src.suppressed(f))
+        for f in rule.check_project(project):
+            src = by_path.get(f.path)
+            if src is None or not src.suppressed(f):
+                findings.append(f)
+    return sorted(findings)

@@ -1,0 +1,28 @@
+"""Finding reporters: human text and machine JSON (for scripts/lint.sh, CI)."""
+
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+from .core import Finding
+
+
+def render_text(findings: Sequence[Finding]) -> str:
+    """`path:line:col: RULE message` per finding plus a summary line."""
+    lines = [f.format() for f in findings]
+    n = len(findings)
+    lines.append("clean: no findings" if n == 0 else f"{n} finding{'s' if n != 1 else ''}")
+    return "\n".join(lines)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    """Stable JSON document: {"count": N, "findings": [{...}]}."""
+    doc = {
+        "count": len(findings),
+        "findings": [
+            {"path": f.path, "line": f.line, "col": f.col, "rule": f.rule, "message": f.message}
+            for f in findings
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,167 @@
+"""SPMD-contract rules.
+
+YAMT003 — collective axis names. ``lax.psum``/``pmean``/``axis_index``/...
+over an axis name that no mesh defines fails only at trace time on a real
+mesh (or worse, under a differently-named test mesh). The project's ground
+truth is its module-level ``X_AXIS = "name"`` string constants
+(``parallel/mesh.py`` ``DATA_AXIS``): literal axis strings must be one of
+those values. Runtime-variable axis names (``axis_name=axis_name``
+parameters) are unknowable statically and skipped.
+
+YAMT004 — field-tuple/dataclass drift. A ``FOO_BAR_FIELDS = (...)`` tuple is
+this codebase's idiom for "the checkpoint layout of dataclass FooBar"
+(train/steps.py ``TRAIN_STATE_FIELDS`` <-> ``TrainState``). Adding a
+dataclass field without updating the tuple silently drops state from every
+checkpoint; this rule pins the two together across files.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+
+# collective -> positional index of the axis-name argument
+_COLLECTIVES: dict[str, int] = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0,
+}
+
+
+@register
+class CollectiveAxisName(Rule):
+    id = "YAMT003"
+    name = "collective-axis-name"
+    description = (
+        "lax.psum/pmean/axis_index/... with a literal axis name that no mesh-axis "
+        "constant in the project defines (parallel/mesh.py DATA_AXIS is ground truth)"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        axes = project.axis_constants  # const name -> axis string
+        if not axes:
+            return []  # no ground truth in this project: nothing to validate
+        known = ", ".join(sorted(set(axes.values())))
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualified_name(node.func, src.aliases)
+            if q not in _COLLECTIVES:
+                continue
+            idx = _COLLECTIVES[q]
+            axis_arg = None
+            if len(node.args) > idx:
+                axis_arg = node.args[idx]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_arg = kw.value
+            if axis_arg is None:
+                continue
+            for bad in self._bad_axes(axis_arg, axes):
+                findings.append(
+                    Finding(
+                        src.path, axis_arg.lineno, axis_arg.col_offset, self.id,
+                        f"{q.rsplit('.', 1)[-1]} over unknown mesh axis '{bad}' "
+                        f"(known axes: {known}); use the mesh-axis constant",
+                    )
+                )
+        return findings
+
+    def _bad_axes(self, node: ast.AST, axes: dict[str, str]) -> list[str]:
+        """Literal axis names not defined by any project axis constant.
+        Names/attributes are validated when they look like axis constants and
+        skipped otherwise (runtime values)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return [] if node.value in axes.values() else [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            bad = []
+            for el in node.elts:
+                bad.extend(self._bad_axes(el, axes))
+            return bad
+        return []  # runtime name/attribute: not statically checkable
+
+
+def _camel(upper_snake: str) -> str:
+    return "".join(w.capitalize() for w in upper_snake.split("_"))
+
+
+def _is_dataclass(node: ast.ClassDef, aliases) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        q = qualified_name(target, aliases) or ""
+        if "dataclass" in q.rsplit(".", 1)[-1]:
+            return True
+    return False
+
+
+def _class_fields(node: ast.ClassDef) -> list[str]:
+    return [st.target.id for st in node.body if isinstance(st, ast.AnnAssign) and isinstance(st.target, ast.Name)]
+
+
+@register
+class FieldTupleDrift(Rule):
+    id = "YAMT004"
+    name = "field-tuple-drift"
+    description = (
+        "a FOO_FIELDS tuple (checkpoint layout) that does not exactly match the "
+        "fields of the Foo dataclass it mirrors (train/steps.py TRAIN_STATE_FIELDS contract)"
+    )
+
+    def check_project(self, project: Project) -> list[Finding]:
+        # same-file class wins over a same-named class elsewhere in the tree
+        by_file: dict[str, dict[str, list[str]]] = {}
+        classes: dict[str, list[str]] = {}
+        for src in project.files:
+            local = by_file.setdefault(src.path, {})
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef) and _is_dataclass(node, src.aliases):
+                    local.setdefault(node.name, _class_fields(node))
+                    classes.setdefault(node.name, _class_fields(node))
+
+        findings: list[Finding] = []
+        for src in project.files:
+            for node in src.tree.body:
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id.endswith("_FIELDS")
+                    and isinstance(node.value, (ast.Tuple, ast.List))
+                ):
+                    continue
+                tname = node.targets[0].id
+                elts = node.value.elts
+                if not all(isinstance(e, ast.Constant) and isinstance(e.value, str) for e in elts):
+                    continue
+                listed = [e.value for e in elts]
+                cls_name = _camel(tname[: -len("_FIELDS")])
+                actual = by_file[src.path].get(cls_name, classes.get(cls_name))
+                if actual is None or listed == actual:
+                    continue
+                missing = [f for f in actual if f not in listed]
+                extra = [f for f in listed if f not in actual]
+                detail = []
+                if missing:
+                    detail.append(f"missing {missing}")
+                if extra:
+                    detail.append(f"extra {extra}")
+                if not detail:
+                    detail.append(f"order differs (dataclass order: {actual})")
+                findings.append(
+                    Finding(
+                        src.path, node.lineno, node.col_offset, self.id,
+                        f"{tname} does not match dataclass {cls_name} fields: " + "; ".join(detail),
+                    )
+                )
+        return findings

@@ -1,0 +1,369 @@
+"""Tracing-safety rules.
+
+YAMT001 — host-side effects inside jit/shard_map-traced functions. A
+``print``/``time.time()``/``np.random.*`` call under trace runs ONCE at trace
+time (or forces a host sync via ``.item()``), silently breaking the
+single-XLA-program contract of train/steps.py. Detection is per-module and
+heuristic: a function is "traced" when it is decorated with a tracing
+transform (``@jax.jit``, ``@partial(jax.jit, ...)``, ``@jax.checkpoint``) or
+its name is passed to one in the same module (``jax.jit(f)``,
+``shard_map(f, ...)``, ``jax.grad(f)``, ``lax.scan(f, ...)``, ...); nested
+``def``s inside a traced function are traced too. A function containing a
+mesh collective (``lax.psum``/``pmean``/``axis_index``/...) is also a traced
+context — collectives only execute under trace — which catches step builders
+whose inner ``step_fn`` is returned and jitted in ANOTHER module
+(train/steps.py -> parallel/dp.py).
+
+YAMT002 — PRNG key discipline. A key consumed by two or more ``jax.random``
+draws without an intervening ``split``/``fold_in`` (or reassignment) yields
+CORRELATED randomness — dropout masks equal to augmentation noise, identical
+mixup permutations across uses. Also flags a draw inside a loop whose key was
+bound outside the loop (every iteration reuses the same key). Scans every
+function (and the module body); ``if``/``try`` branches are analyzed
+separately and merged, so mutually-exclusive draws don't false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+
+# tracing entry points: resolved qualified name -> positions of traced
+# callables among the positional args
+_TRACE_ENTRY: dict[str, tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.pmap": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.custom_root": (0, 1, 2),
+    "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,),
+}
+# these two move across modules/wrappers (utils/compat.py, pallas), so they
+# match on the last path component wherever they were imported from
+_TRACE_TAIL = {"shard_map", "pallas_call"}
+
+_HOST_CALL_NAMES = {"print", "input", "breakpoint", "open"}
+_HOST_PREFIXES = ("time.", "numpy.random.", "random.", "datetime.")
+_HOST_METHODS = {"item", "tolist", "to_py"}
+
+
+def _is_trace_entry(q: str) -> bool:
+    return q in _TRACE_ENTRY or q.split(".")[-1] in _TRACE_TAIL
+
+
+def _trace_arg_indices(q: str) -> tuple[int, ...]:
+    if q in _TRACE_ENTRY:
+        return _TRACE_ENTRY[q]
+    if q.split(".")[-1] in _TRACE_TAIL:
+        return (0,)
+    return ()
+
+
+def _arg_names(fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda) -> set[str]:
+    a = fn.args
+    return {x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)} | {
+        x.arg for x in (a.vararg, a.kwarg) if x is not None
+    }
+
+
+def _directly_contains_collective(fn_node, aliases, collectives) -> bool:
+    """A collective in the function's OWN body (nested defs excluded — they
+    make their own root decision; the enclosing factory runs on the host)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call) and qualified_name(n.func, aliases) in collectives:
+            return True
+        stack.extend(ast.iter_child_nodes(n))
+    return False
+
+
+@register
+class HostEffectsUnderTrace(Rule):
+    id = "YAMT001"
+    name = "host-effect-under-trace"
+    description = (
+        "print/time/np.random/.item() inside a jit- or shard_map-traced function: "
+        "runs at trace time only (or forces a host sync), breaking the one-XLA-program step"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        from .rules_spmd import _COLLECTIVES
+
+        tree, aliases = src.tree, src.aliases
+        defs_by_name: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        roots: list[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a body with a mesh collective DIRECTLY in it (not via a
+                # nested def — a factory's build-time code is host code) is a
+                # traced context by construction, however it reaches jit
+                if _directly_contains_collective(node, aliases, _COLLECTIVES):
+                    roots.append(node)
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    q = qualified_name(target, aliases)
+                    if q and _is_trace_entry(q):
+                        roots.append(node)
+                    elif (
+                        isinstance(dec, ast.Call)
+                        and qualified_name(dec.func, aliases) in ("functools.partial", "partial")
+                        and dec.args
+                    ):
+                        q2 = qualified_name(dec.args[0], aliases)
+                        if q2 and _is_trace_entry(q2):
+                            roots.append(node)
+            elif isinstance(node, ast.Call):
+                q = qualified_name(node.func, aliases)
+                if not q:
+                    continue
+                for i in _trace_arg_indices(q):
+                    if i < len(node.args):
+                        arg = node.args[i]
+                        if isinstance(arg, ast.Lambda):
+                            roots.append(arg)
+                        elif isinstance(arg, ast.Name):
+                            roots.extend(defs_by_name.get(arg.id, ()))
+
+        findings: dict[tuple, Finding] = {}
+        # one finding per location; inner defs processed last so the most
+        # specific function name wins when roots nest (factory + inner step)
+        unique = {id(r): r for r in roots}
+        for root in sorted(unique.values(), key=lambda r: r.lineno):
+            fname = getattr(root, "name", "<lambda>")
+            self._scan(root, fname, _arg_names(root), aliases, src.path, findings)
+        return list(findings.values())
+
+    def _scan(self, node, fname, params, aliases, path, out):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            params = params | _arg_names(node)
+        if isinstance(node, ast.Call):
+            self._check_call(node, fname, params, aliases, path, out)
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, fname, params, aliases, path, out)
+
+    def _check_call(self, node: ast.Call, fname, params, aliases, path, out):
+        def flag(msg):
+            out[(node.lineno, node.col_offset)] = Finding(path, node.lineno, node.col_offset, self.id, msg)
+
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in _HOST_CALL_NAMES:
+                alt = " (use jax.debug.print for traced values)" if func.id == "print" else ""
+                flag(f"host call `{func.id}(...)` inside traced function '{fname}'{alt}")
+            elif (
+                func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in params
+            ):
+                flag(
+                    f"`{func.id}({node.args[0].id})` on a traced argument of '{fname}' "
+                    "forces a host sync (ConcretizationTypeError under jit)"
+                )
+        elif isinstance(func, ast.Attribute):
+            if func.attr in _HOST_METHODS:
+                flag(
+                    f"`.{func.attr}()` inside traced function '{fname}' forces a host "
+                    "sync; keep values on device or move the readback outside the step"
+                )
+            q = qualified_name(func, aliases)
+            if q and q.startswith(_HOST_PREFIXES):
+                flag(
+                    f"host-side `{q}(...)` inside traced function '{fname}': executes at "
+                    "trace time only, not per step (use jax primitives or hoist it out)"
+                )
+
+
+_KEY_SAFE = {"split", "fold_in", "PRNGKey", "key", "wrap_key_data", "key_data", "clone"}
+_KEY_PARAM_RE = re.compile(r"(^|_)(rng|key|prng)s?($|_)")
+
+
+class _KeyState:
+    """Per-scope PRNG bookkeeping: name -> [draw_count, binding_loop_depth]."""
+
+    def __init__(self, seed_names=(), depth=0):
+        self.vars: dict[str, list[int]] = {n: [0, depth] for n in seed_names}
+
+    def copy(self):
+        s = _KeyState()
+        s.vars = {k: list(v) for k, v in self.vars.items()}
+        return s
+
+    def merge(self, *branches):
+        names = set(self.vars)
+        for b in branches:
+            names |= set(b.vars)
+        merged = {}
+        for n in names:
+            ents = [b.vars[n] for b in branches if n in b.vars] or [self.vars[n]]
+            merged[n] = [max(e[0] for e in ents), min(e[1] for e in ents)]
+        self.vars = merged
+
+
+@register
+class PRNGKeyReuse(Rule):
+    id = "YAMT002"
+    name = "prng-key-reuse"
+    description = (
+        "a PRNG key consumed by >=2 jax.random draws (or re-drawn inside a loop) "
+        "without an intervening split/fold_in: correlated randomness"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        out: dict[tuple, Finding] = {}
+        scopes: list[tuple[ast.AST, set[str]]] = [(src.tree, set())]
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                seeds = {n for n in _arg_names(node) if _KEY_PARAM_RE.search(n)}
+                scopes.append((node, seeds))
+        for scope, seeds in scopes:
+            state = _KeyState(seeds)
+            self._block(list(getattr(scope, "body", [])), state, 0, src, out)
+        return list(out.values())
+
+    # -- statement walk ----------------------------------------------------
+
+    def _block(self, stmts, state, depth, src, out) -> bool:
+        """Process a statement list; True if it ends control flow (so a
+        terminated `if` branch must not merge into the fall-through state —
+        a draw after `if x: return draw(rng)` is NOT a second consumption)."""
+        for st in stmts:
+            self._stmt(st, state, depth, src, out)
+            if isinstance(st, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+                return True
+        return False
+
+    def _stmt(self, st, state, depth, src, out):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope, driven from check_file
+        if isinstance(st, ast.If):
+            self._consume(st.test, state, depth, src, out)
+            b1, b2 = state.copy(), state.copy()
+            t1 = self._block(st.body, b1, depth, src, out)
+            t2 = self._block(st.orelse, b2, depth, src, out)
+            live = [b for b, t in ((b1, t1), (b2, t2)) if not t]
+            if live:
+                state.merge(*live)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._consume(st.iter, state, depth, src, out)
+            self._reset_targets(st.target, state, depth + 1)
+            body = state.copy()
+            self._block(st.body, body, depth + 1, src, out)
+            els = state.copy()
+            self._block(st.orelse, els, depth, src, out)
+            state.merge(body, els)
+        elif isinstance(st, ast.While):
+            self._consume(st.test, state, depth, src, out)
+            body = state.copy()
+            self._block(st.body, body, depth + 1, src, out)
+            state.merge(body)
+        elif isinstance(st, ast.Try):
+            branches = []
+            for block in (st.body, *[h.body for h in st.handlers], st.orelse):
+                b = state.copy()
+                terminated = self._block(block, b, depth, src, out)
+                if not terminated:
+                    branches.append(b)
+            if branches:
+                state.merge(*branches)
+            self._block(st.finalbody, state, depth, src, out)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._consume(item.context_expr, state, depth, src, out)
+            self._block(st.body, state, depth, src, out)
+        elif isinstance(st, ast.Assign):
+            self._consume(st.value, state, depth, src, out)
+            for t in st.targets:
+                self._reset_targets(t, state, depth)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            if st.value is not None:
+                self._consume(st.value, state, depth, src, out)
+            self._reset_targets(st.target, state, depth)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._reset_targets(t, state, depth)
+        else:
+            for expr in ast.iter_child_nodes(st):
+                if isinstance(expr, ast.expr):
+                    self._consume(expr, state, depth, src, out)
+
+    def _reset_targets(self, target, state, depth):
+        if isinstance(target, ast.Name):
+            state.vars[target.id] = [0, depth]
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._reset_targets(el, state, depth)
+        elif isinstance(target, ast.Starred):
+            self._reset_targets(target.value, state, depth)
+
+    # -- expression consumption --------------------------------------------
+
+    def _consume(self, expr, state, depth, src, out):
+        """Recursive in-evaluation-order walk; a ternary's arms are merged
+        like `if` branches (exactly one executes), lambdas are deferred
+        bodies and skipped."""
+        if expr is None or isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.IfExp):
+            self._consume(expr.test, state, depth, src, out)
+            b1, b2 = state.copy(), state.copy()
+            self._consume(expr.body, b1, depth, src, out)
+            self._consume(expr.orelse, b2, depth, src, out)
+            state.merge(b1, b2)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                self._consume(child if isinstance(child, ast.expr) else child.value, state, depth, src, out)
+        if isinstance(expr, ast.Call):
+            self._check_draw(expr, state, depth, src, out)
+
+    def _check_draw(self, call, state, depth, src, out):
+        q = qualified_name(call.func, src.aliases)
+        if not q or not q.startswith("jax.random."):
+            return
+        fn = q.rsplit(".", 1)[-1]
+        if fn in _KEY_SAFE:
+            return
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return
+        name = call.args[0].id
+        ent = state.vars.get(name)
+        if ent is None:
+            # first sight (closure/implicit binding): bind at current depth
+            state.vars[name] = [1, depth]
+            return
+        if depth > ent[1]:
+            f = Finding(
+                src.path, call.lineno, call.col_offset, self.id,
+                f"PRNG key '{name}' (bound outside this loop) is consumed by "
+                f"jax.random.{fn} every iteration; fold_in the loop index or split first",
+            )
+            out.setdefault((f.line, name), f)
+            return
+        ent[0] += 1
+        if ent[0] == 2:
+            f = Finding(
+                src.path, call.lineno, call.col_offset, self.id,
+                f"PRNG key '{name}' consumed by a second jax.random draw "
+                f"(jax.random.{fn}) without an intervening split/fold_in: "
+                "the two draws are perfectly correlated",
+            )
+            out.setdefault((f.line, name), f)

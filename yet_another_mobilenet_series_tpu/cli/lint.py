@@ -1,0 +1,16 @@
+"""yamt-lint entry point — ``python -m yet_another_mobilenet_series_tpu.cli.lint
+[paths...]``, sibling of cli.train/cli.profile.
+
+Thin wrapper: the implementation lives in analysis/cli.py (also reachable as
+``python -m yet_another_mobilenet_series_tpu.analysis``). Rules and the
+suppression syntax are documented in docs/LINT.md.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

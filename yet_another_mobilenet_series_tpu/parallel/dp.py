@@ -17,10 +17,11 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import Config
+from ..utils.compat import shard_map
 from ..models.specs import Network
 from ..train.steps import TrainState, make_eval_step, make_train_step
 from .mesh import DATA_AXIS

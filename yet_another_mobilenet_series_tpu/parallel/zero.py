@@ -26,9 +26,10 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.compat import shard_map
 from .mesh import DATA_AXIS
 
 

@@ -1,0 +1,42 @@
+"""Version-portability shims for the jax surface this package touches.
+
+The public home of ``shard_map`` has moved across jax releases:
+
+- jax <= 0.5: ``jax.experimental.shard_map.shard_map``, replication-check
+  kwarg spelled ``check_rep``;
+- newer jax: top-level ``jax.shard_map``, the kwarg renamed ``check_vma``.
+
+Every production call site in this package imports ``shard_map`` from HERE
+and uses the modern ``check_vma`` spelling; the shim resolves the import
+across versions and maps ``check_vma`` onto ``check_rep`` when running on the
+older API. Importing shard_map from jax directly is exactly the
+version-fragile import that broke the seed's tier-1 collection under jax
+0.4.37 — yamt-lint rule YAMT006 (analysis/rules_imports.py) now flags it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # newer jax: public top-level export, `check_vma` kwarg
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # jax <= 0.5: experimental home, `check_rep` kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the modern keyword surface on every jax.
+
+    Accepts ``check_vma`` regardless of version (translated to ``check_rep``
+    for old jax). Positional-only ``f`` keeps both underlying signatures
+    happy; everything else must be passed by keyword, which every call site
+    in this package already does.
+    """
+    if "check_vma" in kwargs and _CHECK_KW != "check_vma":
+        kwargs[_CHECK_KW] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
