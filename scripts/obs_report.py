@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Render a run's telemetry (metrics.jsonl + obs_registry.json +
+hang_report.json if present) into a text summary — the post-run half of
+docs/OBSERVABILITY.md. Pure stdlib file reading, no jax/tf import, so it
+runs anywhere (CI after the tier-1 gate, a laptop against rsynced logs).
+
+Usage: python scripts/obs_report.py <log_dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_jsonl(path: str) -> list[dict]:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    return rows
+
+
+def summarize(log_dir: str) -> str:
+    lines = [f"# obs report: {log_dir}"]
+
+    metrics_path = os.path.join(log_dir, "metrics.jsonl")
+    if os.path.exists(metrics_path):
+        rows = _load_jsonl(metrics_path)
+        if rows:
+            lines.append(f"\n## metrics.jsonl ({len(rows)} rows, "
+                         f"steps {rows[0].get('step', '?')}..{rows[-1].get('step', '?')})")
+            train_rows = [r for r in rows if any(k.startswith("train/") for k in r)]
+            eval_rows = [r for r in rows if any(k.startswith("eval/") for k in r)]
+            if train_rows:
+                last = train_rows[-1]
+                for key in ("train/loss", "train/images_per_sec", "train/images_per_sec_per_chip"):
+                    if key in last:
+                        lines.append(f"  last {key} = {last[key]:.6g} (step {last['step']})")
+            if eval_rows:
+                best = max(eval_rows, key=lambda r: r.get("eval/top1", float("-inf")))
+                if "eval/top1" in best:
+                    lines.append(f"  best eval/top1 = {best['eval/top1']:.6g} (step {best['step']})")
+                last = eval_rows[-1]
+                for key in ("eval/top1", "eval/loss"):
+                    if key in last:
+                        lines.append(f"  last {key} = {last[key]:.6g} (step {last['step']})")
+        else:
+            lines.append("\n## metrics.jsonl: empty")
+    else:
+        lines.append("\n## metrics.jsonl: missing")
+
+    reg_path = os.path.join(log_dir, "obs_registry.json")
+    if os.path.exists(reg_path):
+        with open(reg_path) as f:
+            snap = json.load(f)
+        lines.append(f"\n## registry snapshot ({len(snap)} metrics)")
+        for name in sorted(snap):
+            lines.append(f"  {name} = {snap[name]:.6g}")
+    else:
+        lines.append("\n## registry snapshot: missing (run predates obs/ or crashed before flush)")
+
+    hang_path = os.path.join(log_dir, "hang_report.json")
+    if os.path.exists(hang_path):
+        with open(hang_path) as f:
+            hang = json.load(f)
+        lines.append(
+            f"\n## !! HANG REPORT !! (stalled {hang.get('seconds_since_last_beat', 0):.1f}s, "
+            f"deadline {hang.get('deadline_s', 0):.1f}s)"
+        )
+        lines.append(f"  last step {hang.get('last_step')} in phase '{hang.get('last_phase')}'")
+        for span in hang.get("open_spans", []):
+            lines.append(f"  open span: {span.get('name')} [{span.get('cat')}] "
+                         f"open {span.get('open_for_s', 0):.1f}s")
+        lines.append(f"  thread stacks: {len(hang.get('threads', {}))} (see {hang_path})")
+
+    trace_path = os.path.join(log_dir, "obs_trace.json")
+    if os.path.exists(trace_path):
+        with open(trace_path) as f:
+            n_events = len(json.load(f).get("traceEvents", []))
+        lines.append(f"\n## span trace: {trace_path} ({n_events} events) — "
+                     "open in ui.perfetto.dev or chrome://tracing")
+
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log_dir", help="a run's train.log_dir")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.log_dir):
+        print(f"obs_report: not a directory: {args.log_dir}", file=sys.stderr)
+        return 2
+    print(summarize(args.log_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""YAMT002 must flag: comprehension-scoped draws off a key bound outside."""
+
+import jax
+
+
+def list_comp_reuse(key, n):
+    return [jax.random.normal(key) for _ in range(n)]  # same key per element
+
+
+def genexpr_reuse(rng, xs):
+    return sum(jax.random.uniform(rng) for _ in xs)  # same key per element

@@ -1,0 +1,17 @@
+"""YAMT002 must stay silent: per-element keys via split/fold_in."""
+
+import jax
+
+
+def split_comp_ok(key, n):
+    # the comprehension target IS the key: rebound fresh every element
+    return [jax.random.normal(k) for k in jax.random.split(key, n)]
+
+
+def fold_comp_ok(key, n):
+    return [jax.random.normal(jax.random.fold_in(key, i)) for i in range(n)]
+
+
+def iterable_draw_ok(key, n):
+    # a single draw in the ITERABLE evaluates once, outside the loop
+    return [x * 2 for x in jax.random.normal(key, (n,))]

@@ -1,0 +1,9 @@
+"""YAMT007 must flag: bare print() in package code outside sanctioned surfaces."""
+
+print("[data] pipeline starting")  # module-level side-channel output
+
+
+def warn_uneven_shards(total, est):
+    # a runtime warning that bypasses Logger/metrics.jsonl entirely
+    print(f"[data] WARNING: counted {total} records, estimate was {est}", flush=True)
+    return total

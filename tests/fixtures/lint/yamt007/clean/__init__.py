@@ -1,0 +1,1 @@
+"""__init__.py makes this fixture tree PACKAGE code for YAMT007."""

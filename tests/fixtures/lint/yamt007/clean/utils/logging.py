@@ -1,0 +1,5 @@
+"""The sanctioned logging surface (utils/logging.py) may print: it IS the sink."""
+
+
+def emit(msg):
+    print(msg, flush=True)

@@ -1,0 +1,361 @@
+"""obs/ subsystem tests: registry semantics, span tracer (nesting, ring
+eviction, Chrome-trace schema), stall watchdog (fires on an injected stall,
+silent on a healthy loop), Logger integration (TF-less degrade, registry
+snapshots in scalars rows), the fake-data train smoke (trace + snapshot
+artifacts for steps_per_dispatch 1 and >1), and scripts/obs_report.py."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from yet_another_mobilenet_series_tpu.cli import train as cli_train
+from yet_another_mobilenet_series_tpu.config import config_from_dict
+from yet_another_mobilenet_series_tpu.obs.registry import MetricsRegistry, get_registry
+from yet_another_mobilenet_series_tpu.obs.trace import SpanTracer
+from yet_another_mobilenet_series_tpu.obs import trace as obs_trace
+from yet_another_mobilenet_series_tpu.obs.watchdog import StallWatchdog
+from yet_another_mobilenet_series_tpu.utils import logging as logging_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    reg.counter("a.hits").inc()
+    reg.counter("a.hits").inc(2)
+    reg.gauge("a.level").set(7.5)
+    h = reg.histogram("a.wait")
+    h.observe(1.0)
+    h.observe(3.0)
+    snap = reg.snapshot()
+    assert snap["a.hits"] == 3.0
+    assert snap["a.level"] == 7.5
+    assert snap["a.wait.count"] == 2.0
+    assert snap["a.wait.sum"] == 4.0
+    assert snap["a.wait.mean"] == 2.0
+    assert snap["a.wait.max"] == 3.0
+    # get-or-create returns the SAME metric object
+    assert reg.counter("a.hits") is reg.counter("a.hits")
+
+
+def test_registry_type_conflict_and_negative_inc():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x")
+    with pytest.raises(ValueError, match="cannot decrease"):
+        reg.counter("x").inc(-1)
+
+
+def test_registry_gauge_callback_and_fault_isolation():
+    reg = MetricsRegistry()
+    src = {"v": 5}
+    g = reg.gauge("pull")
+    g.set_fn(lambda: src["v"])
+    assert reg.snapshot()["pull"] == 5.0
+    src["v"] = 9
+    assert reg.snapshot()["pull"] == 9.0
+    # a dying producer keeps the last good reading, never raises
+    g.set_fn(lambda: 1 / 0)
+    assert reg.snapshot()["pull"] == 9.0
+
+
+def test_registry_reset():
+    reg = MetricsRegistry()
+    reg.counter("x").inc()
+    reg.reset()
+    assert reg.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+def _x_events(tracer):
+    return [e for e in tracer.to_chrome_trace()["traceEvents"] if e["ph"] == "X"]
+
+
+def test_tracer_span_nesting_and_containment():
+    tr = SpanTracer(ring_size=16)
+    with tr.span("outer", "dispatch", steps=2):
+        with tr.span("inner", "sync"):
+            time.sleep(0.001)
+    evts = _x_events(tr)
+    # completion order: inner closes first
+    assert [e["name"] for e in evts] == ["inner", "outer"]
+    inner, outer = evts
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+    assert outer["args"] == {"steps": 2}
+
+
+def test_tracer_ring_eviction():
+    tr = SpanTracer(ring_size=4)
+    for i in range(10):
+        with tr.span(f"s{i}", "data"):
+            pass
+    evts = _x_events(tr)
+    assert [e["name"] for e in evts] == ["s6", "s7", "s8", "s9"]
+
+
+def test_tracer_chrome_trace_schema(tmp_path):
+    tr = SpanTracer(ring_size=8)
+    with tr.span("a", "data"):
+        pass
+    path = tr.write(str(tmp_path / "trace.json"))
+    doc = json.loads(open(path).read())
+    assert isinstance(doc["traceEvents"], list)
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("X", "M")
+        assert {"name", "ph", "pid", "tid", "ts"} <= set(e)
+        if e["ph"] == "X":
+            assert e["ts"] >= 0 and e["dur"] >= 0
+            assert isinstance(e["cat"], str)
+
+
+def test_tracer_disabled_is_noop():
+    tr = SpanTracer(ring_size=8, enabled=False)
+    s1 = tr.span("a", "data")
+    s2 = tr.span("b", "sync")
+    assert s1 is s2  # the shared null span: zero allocation on the hot path
+    with s1:
+        pass
+    assert _x_events(tr) == []
+
+
+def test_tracer_open_spans_readout():
+    tr = SpanTracer(ring_size=8)
+    with tr.span("outer", "dispatch"):
+        with tr.span("inner", "data"):
+            open_now = tr.open_spans()
+            assert [s["name"] for s in open_now] == ["outer", "inner"]
+            assert all(s["open_for_s"] >= 0 for s in open_now)
+    assert tr.open_spans() == []
+
+
+def test_tracer_module_singleton_configure():
+    prev = obs_trace.get_tracer()
+    try:
+        tr = obs_trace.configure(enabled=True, ring_size=4)
+        assert obs_trace.get_tracer() is tr
+        with obs_trace.get_tracer().span("x", "data"):
+            pass
+        assert [e["name"] for e in _x_events(tr)] == ["x"]
+    finally:
+        obs_trace._TRACER = prev
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_on_injected_stall(tmp_path):
+    """à la test_fault_injection: the loop stops beating mid-span, the
+    watchdog must dump a hang report with open spans + registry snapshot."""
+    tr = SpanTracer(ring_size=8)
+    reg = MetricsRegistry()
+    reg.counter("train.rebuilds").inc(3)
+    wd = StallWatchdog(str(tmp_path), deadline_s=0.25, poll_s=0.05, tracer=tr, registry=reg)
+    wd.start()
+    span = tr.span("dispatch/train_step", "dispatch")
+    span.__enter__()  # a dispatch that never returns
+    wd.arm(step=7)
+    deadline = time.time() + 10
+    report_path = tmp_path / "hang_report.json"
+    while time.time() < deadline and not report_path.exists():
+        time.sleep(0.05)
+    wd.stop()
+    span.__exit__(None, None, None)
+    assert report_path.exists(), "watchdog never fired on a stalled loop"
+    assert wd.fired
+    rep = json.loads(report_path.read_text())
+    assert rep["last_step"] == 7
+    assert rep["last_phase"] == "step"
+    assert rep["seconds_since_last_beat"] >= 0.25
+    assert any(s["name"] == "dispatch/train_step" for s in rep["open_spans"])
+    assert rep["registry"]["train.rebuilds"] == 3.0
+    assert rep["threads"], "thread stacks missing from hang report"
+    assert any("MainThread" in name for name in rep["threads"])
+
+
+def test_watchdog_silent_on_healthy_loop(tmp_path):
+    wd = StallWatchdog(str(tmp_path), deadline_s=0.5, poll_s=0.05)
+    wd.start()
+    for step in range(12):  # ~0.6 s of healthy 50ms steps
+        wd.arm(step)
+        time.sleep(0.05)
+    wd.stop()
+    assert not (tmp_path / "hang_report.json").exists()
+    assert not wd.fired
+
+
+def test_watchdog_rejects_nonpositive_deadline(tmp_path):
+    with pytest.raises(ValueError, match="deadline"):
+        StallWatchdog(str(tmp_path), deadline_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Logger integration
+# ---------------------------------------------------------------------------
+
+
+def test_logger_degrades_without_tensorflow(tmp_path, monkeypatch, capsys):
+    """The satellite fix: tensorboard=True on a TF-less box must warn once
+    and keep jsonl logging, not crash the run."""
+    monkeypatch.setitem(__import__("sys").modules, "tensorflow", None)
+    monkeypatch.setattr(logging_lib, "_TB_WARNED", False)
+    log = logging_lib.Logger(str(tmp_path), enabled=True, tensorboard=True)
+    try:
+        assert log._tb is None
+        out = capsys.readouterr().out
+        assert "tensorboard logging disabled" in out
+        # warn once only
+        log2 = logging_lib.Logger(str(tmp_path), enabled=True, tensorboard=True)
+        log2.close()
+        assert "tensorboard logging disabled" not in capsys.readouterr().out
+        log.scalars(3, {"loss": 1.5}, "train/")
+    finally:
+        log.close()
+    rows = [json.loads(line) for line in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert rows == [{"step": 3, "train/loss": 1.5}]
+
+
+def test_logger_scalars_carry_registry_snapshot(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("data.decode_failures").inc(2)
+    log = logging_lib.Logger(str(tmp_path), enabled=True, tensorboard=False)
+    try:
+        log.set_registry(reg)
+        log.scalars(1, {"loss": 0.5}, "train/")
+    finally:
+        log.close()
+    row = json.loads((tmp_path / "metrics.jsonl").read_text().splitlines()[0])
+    assert row["train/loss"] == 0.5
+    assert row["obs/data.decode_failures"] == 2.0
+
+
+def test_emit_routes_through_active_logger(capsys):
+    log = logging_lib.Logger(None, enabled=True)
+    logging_lib.emit("hello from the pipeline")
+    out = capsys.readouterr().out
+    assert "] hello from the pipeline" in out  # Logger's [HH:MM:SS] prefix
+    log.close()
+    logging_lib.emit("after close")
+    assert capsys.readouterr().out == "after close\n"  # bare fallback
+
+
+# ---------------------------------------------------------------------------
+# fake-data CPU train smoke: trace + snapshot artifacts
+# ---------------------------------------------------------------------------
+
+
+def _smoke_cfg(tmp_path, k_dispatch):
+    return config_from_dict({
+        "name": "obs-smoke",
+        "model": {
+            "arch": "mobilenet_v2", "num_classes": 4, "dropout": 0.0,
+            "block_specs": [{"t": 2, "c": 8, "n": 1, "s": 2}],
+        },
+        "data": {"dataset": "fake", "image_size": 24, "fake_train_size": 64, "fake_eval_size": 16},
+        "optim": {"optimizer": "sgd", "momentum": 0.9, "weight_decay": 1e-5},
+        "schedule": {"schedule": "constant", "base_lr": 0.01, "scale_by_batch": False, "warmup_epochs": 0.0},
+        "ema": {"enable": True, "decay": 0.9, "warmup": False},
+        "train": {
+            "batch_size": 32, "eval_batch_size": 16, "epochs": 1, "log_every": 1,
+            "compute_dtype": "float32", "log_dir": str(tmp_path),
+            "steps_per_dispatch": k_dispatch,
+        },
+        # trace on; generous watchdog deadline proves it stays silent on a
+        # healthy loop even with compiles in the gap
+        "obs": {"trace": True, "watchdog_deadline_s": 300.0},
+        "dist": {"num_devices": 8},
+    })
+
+
+@pytest.mark.parametrize("k_dispatch", [1, 2])
+def test_train_smoke_emits_trace_and_registry_snapshot(tmp_path, k_dispatch):
+    result = cli_train.run(_smoke_cfg(tmp_path, k_dispatch))
+    assert result["epoch"] == pytest.approx(1.0)
+
+    # valid Chrome-trace JSON with spans from all five core categories
+    doc = json.loads((tmp_path / "obs_trace.json").read_text())
+    evts = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert evts, "no spans recorded"
+    for e in evts:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    cats = {e["cat"] for e in evts}
+    assert {"data", "dispatch", "sync", "eval", "ckpt"} <= cats, cats
+    names = {e["name"] for e in evts}
+    if k_dispatch > 1:
+        # spans COMPOSE with grouped dispatch instead of forcing it off
+        assert "dispatch/grouped_step" in names
+        grouped = next(e for e in evts if e["name"] == "dispatch/grouped_step")
+        assert grouped["args"]["steps"] == k_dispatch
+    else:
+        assert "dispatch/train_step" in names
+
+    # registry snapshot written at run end
+    snap = json.loads((tmp_path / "obs_registry.json").read_text())
+    assert snap.get("ckpt.saves", 0) >= 1
+    assert snap.get("eval.passes", 0) >= 1
+    assert "ckpt.wait_seconds.count" in snap
+
+    # every scalars row carries the obs/ snapshot
+    rows = [json.loads(line) for line in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert rows and all(any(k.startswith("obs/") for k in r) for r in rows)
+
+    # healthy loop: armed watchdog stayed silent
+    assert not (tmp_path / "hang_report.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# scripts/obs_report.py
+# ---------------------------------------------------------------------------
+
+
+def _obs_report_mod():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(REPO, "scripts", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_report_renders_summary(tmp_path, capsys):
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "train/loss": 2.0, "train/images_per_sec": 100.0,
+                    "obs/ckpt.saves": 0.0}) + "\n"
+        + json.dumps({"step": 2, "eval/top1": 0.75, "eval/loss": 1.1}) + "\n"
+    )
+    (tmp_path / "obs_registry.json").write_text(
+        json.dumps({"ckpt.saves": 1.0, "train.rebuilds": 2.0}))
+    (tmp_path / "hang_report.json").write_text(json.dumps({
+        "seconds_since_last_beat": 12.5, "deadline_s": 5.0, "last_step": 42,
+        "last_phase": "step",
+        "open_spans": [{"name": "dispatch/train_step", "cat": "dispatch", "open_for_s": 12.0}],
+        "registry": {}, "threads": {"MainThread-1": ["..."]},
+    }))
+    rc = _obs_report_mod().main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "last train/loss = 2" in out
+    assert "best eval/top1 = 0.75" in out
+    assert "ckpt.saves = 1" in out
+    assert "HANG REPORT" in out
+    assert "dispatch/train_step" in out
+
+
+def test_obs_report_missing_dir(capsys):
+    assert _obs_report_mod().main(["/definitely/not/a/dir"]) == 2

@@ -187,7 +187,7 @@ def register(cls: type[Rule]) -> type[Rule]:
 def load_rules() -> list[Rule]:
     """Import every rule module (registration side effect) and return the
     registry sorted by id."""
-    from . import rules_config, rules_imports, rules_spmd, rules_tracing  # noqa: F401
+    from . import rules_config, rules_imports, rules_logging, rules_spmd, rules_tracing  # noqa: F401
 
     return [_REGISTRY[k] for k in sorted(_REGISTRY)]
 

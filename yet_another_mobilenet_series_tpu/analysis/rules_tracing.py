@@ -18,9 +18,12 @@ YAMT002 — PRNG key discipline. A key consumed by two or more ``jax.random``
 draws without an intervening ``split``/``fold_in`` (or reassignment) yields
 CORRELATED randomness — dropout masks equal to augmentation noise, identical
 mixup permutations across uses. Also flags a draw inside a loop whose key was
-bound outside the loop (every iteration reuses the same key). Scans every
-function (and the module body); ``if``/``try`` branches are analyzed
-separately and merged, so mutually-exclusive draws don't false-positive.
+bound outside the loop (every iteration reuses the same key) — including
+comprehension/generator bodies (``[jax.random.normal(key) for ...]``), which
+iterate exactly like a ``for`` but sat outside the loop detection until the
+observability PR closed the ROADMAP-deferred gap. Scans every function (and
+the module body); ``if``/``try`` branches are analyzed separately and merged,
+so mutually-exclusive draws don't false-positive.
 """
 
 from __future__ import annotations
@@ -223,8 +226,8 @@ class PRNGKeyReuse(Rule):
     id = "YAMT002"
     name = "prng-key-reuse"
     description = (
-        "a PRNG key consumed by >=2 jax.random draws (or re-drawn inside a loop) "
-        "without an intervening split/fold_in: correlated randomness"
+        "a PRNG key consumed by >=2 jax.random draws (or re-drawn inside a loop or "
+        "comprehension) without an intervening split/fold_in: correlated randomness"
     )
 
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
@@ -329,6 +332,27 @@ class PRNGKeyReuse(Rule):
             self._consume(expr.orelse, b2, depth, src, out)
             state.merge(b1, b2)
             return
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            # a comprehension is a loop: its element expression evaluates
+            # once per iteration, so a draw there off a key bound OUTSIDE it
+            # reuses that key per element. The first iterable evaluates once
+            # (outer scope); targets rebind at loop depth each iteration.
+            self._consume(expr.generators[0].iter, state, depth, src, out)
+            inner = state.copy()
+            d2 = depth + 1
+            for i, gen in enumerate(expr.generators):
+                self._reset_targets(gen.target, inner, d2)
+                if i > 0:  # nested generators' iterables re-evaluate per outer element
+                    self._consume(gen.iter, inner, d2, src, out)
+                for cond in gen.ifs:
+                    self._consume(cond, inner, d2, src, out)
+            if isinstance(expr, ast.DictComp):
+                self._consume(expr.key, inner, d2, src, out)
+                self._consume(expr.value, inner, d2, src, out)
+            else:
+                self._consume(expr.elt, inner, d2, src, out)
+            state.merge(inner)
+            return
         for child in ast.iter_child_nodes(expr):
             if isinstance(child, (ast.expr, ast.keyword)):
                 self._consume(child if isinstance(child, ast.expr) else child.value, state, depth, src, out)
@@ -353,8 +377,8 @@ class PRNGKeyReuse(Rule):
         if depth > ent[1]:
             f = Finding(
                 src.path, call.lineno, call.col_offset, self.id,
-                f"PRNG key '{name}' (bound outside this loop) is consumed by "
-                f"jax.random.{fn} every iteration; fold_in the loop index or split first",
+                f"PRNG key '{name}' (bound outside this loop/comprehension) is consumed "
+                f"by jax.random.{fn} every iteration; fold_in the loop index or split first",
             )
             out.setdefault((f.line, name), f)
             return

@@ -13,6 +13,7 @@ failure-detection plan) and multi-host coordination for free.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -21,6 +22,8 @@ import orbax.checkpoint as ocp
 
 from ..models.serialize import network_from_dict, network_to_dict
 from ..models.specs import Network
+from ..obs import trace as obs_trace
+from ..obs.registry import get_registry
 
 
 class CheckpointManager:
@@ -54,13 +57,17 @@ class CheckpointManager:
 
         tree = train_state_to_dict(train_state)
         meta = {"network": network_to_dict(net), "extra": extra or {}}
-        self._mgr.save(
-            step,
-            args=ocp.args.Composite(
-                tree=ocp.args.StandardSave(tree),
-                meta=ocp.args.JsonSave(meta),
-            ),
-        )
+        # the span covers only the host-side enqueue of the (async) save;
+        # the barrier cost shows up in ckpt/wait and the wait histogram
+        with obs_trace.get_tracer().span("ckpt/save", "ckpt", step=int(step)):
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    tree=ocp.args.StandardSave(tree),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+            )
+        get_registry().counter("ckpt.saves").inc()
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -74,18 +81,27 @@ class CheckpointManager:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             return None
-        meta = self._mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
+        with obs_trace.get_tracer().span("ckpt/restore_spec", "ckpt", step=int(step)):
+            meta = self._mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
         return step, network_from_dict(meta["network"]), meta["extra"]
 
     def restore_tree(self, step: int, abstract_tree):
         """Phase 2: restore the pytree against an abstract target so optax
         NamedTuple states and dtypes round-trip exactly."""
-        return self._mgr.restore(
-            step, args=ocp.args.Composite(tree=ocp.args.StandardRestore(abstract_tree))
-        )["tree"]
+        with obs_trace.get_tracer().span("ckpt/restore_tree", "ckpt", step=int(step)):
+            tree = self._mgr.restore(
+                step, args=ocp.args.Composite(tree=ocp.args.StandardRestore(abstract_tree))
+            )["tree"]
+        get_registry().counter("ckpt.restores").inc()
+        return tree
 
     def wait(self):
-        self._mgr.wait_until_finished()
+        # the multi-host barrier wait the registry was built to surface: a
+        # slow/contended filesystem shows up here, not in step time
+        t0 = time.perf_counter()
+        with obs_trace.get_tracer().span("ckpt/wait", "ckpt"):
+            self._mgr.wait_until_finished()
+        get_registry().histogram("ckpt.wait_seconds").observe(time.perf_counter() - t0)
 
     def close(self):
         self._mgr.close()

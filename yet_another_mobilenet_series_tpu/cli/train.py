@@ -7,10 +7,18 @@ save/resume (pruned-shape-first), the AtomNAS shrink schedule (in-jit mask
 refresh at fine cadence + physical rematerialization at coarse cadence), and
 throughput/accuracy logging. Everything inside the step is one compiled XLA
 program (train/steps.py + parallel/dp.py).
+
+Runtime telemetry (obs/, docs/OBSERVABILITY.md) wraps the loop without
+touching the compiled step: spans time every host-side phase (data fetch,
+dispatch, syncs, prune, eval, checkpoint, rebuilds), the metrics registry
+rides into every scalars row, and the stall watchdog turns a wedged tunnel
+into a hang_report.json instead of a silent death.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import sys
 import time
 
@@ -23,6 +31,9 @@ from .. import data as data_lib
 from ..models import get_model
 from ..models.specs import Network
 from ..nas import masking, penalty, rematerialize
+from ..obs import registry as obs_registry
+from ..obs import trace as obs_trace
+from ..obs.watchdog import StallWatchdog
 from ..parallel import dp, mesh as mesh_lib
 from ..train import optim, schedules, steps
 from ..utils.cadence import StepCadence
@@ -156,9 +167,16 @@ def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
     return trainer, ts, extra
 
 
-def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=True) -> dict:
+def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=True,
+             watchdog: StallWatchdog | None = None) -> dict:
     """Validation pass on the EMA shadow weights (reference: eval-on-shadow,
-    SURVEY.md §2 #8); falls back to the live weights when EMA is off."""
+    SURVEY.md §2 #8); falls back to the live weights when EMA is off.
+
+    ONE host sync per pass: per-batch metrics accumulate as lazy device
+    arrays (the eval_step outputs stay un-read, so dispatch keeps running
+    ahead) and a single device_get lands at the end — the previous
+    per-batch ``float(m[k])`` forced four host round-trips every step."""
+    tracer = obs_trace.get_tracer()
     params = ts.ema_params if (use_ema and cfg.ema.enable) else ts.params
     state = ts.ema_state if (use_ema and cfg.ema.enable) else ts.state
     # eval_batch_size is GLOBAL (matching train's batch_size semantics):
@@ -169,14 +187,26 @@ def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=Tru
     per_device = -(-cfg.train.eval_batch_size // n_dev)
     local_eval = per_device * (n_dev // jax.process_count())
     batches = data_lib.make_eval_source(cfg.data, local_eval, jax.process_index(), jax.process_count())
-    totals = {"top1": 0.0, "top5": 0.0, "n": 0.0, "loss_sum": 0.0}
-    for batch in batches:
-        b = mesh_lib.shard_batch(batch, trainer.mesh)
-        m = trainer.eval_step(params, state, b, ts.masks)
-        for k in totals:
-            totals[k] += float(m[k])
-    n = max(totals["n"], 1.0)
-    return {"top1": totals["top1"] / n, "top5": totals["top5"] / n, "loss": totals["loss_sum"] / n, "n": int(n)}
+    totals = None
+    with tracer.span("eval/pass", "eval"):
+        for batch in batches:
+            with tracer.span("eval/batch", "eval"):
+                b = mesh_lib.shard_batch(batch, trainer.mesh)
+                m = trainer.eval_step(params, state, b, ts.masks)
+            totals = m if totals is None else jax.tree.map(lambda a, b_: a + b_, totals, m)
+            if watchdog is not None:
+                watchdog.arm(phase="eval")
+        with tracer.span("sync/eval_gather", "sync"):
+            host = (
+                jax.device_get(totals) if totals is not None
+                else {"top1": 0.0, "top5": 0.0, "n": 0.0, "loss_sum": 0.0}
+            )
+    obs_registry.get_registry().counter("eval.passes").inc()
+    n = max(float(host["n"]), 1.0)
+    return {
+        "top1": float(host["top1"]) / n, "top5": float(host["top5"]) / n,
+        "loss": float(host["loss_sum"]) / n, "n": int(float(host["n"])),
+    }
 
 
 def _maybe_rematerialize(trainer: Trainer, ts: steps.TrainState, log: Logger):
@@ -279,10 +309,45 @@ def run(cfg: Config) -> dict:
     for line in tuning_lines:  # provenance of measured-winner overrides
         log.log(line)
 
+    # ---- runtime telemetry (obs/, docs/OBSERVABILITY.md) ----
+    # registry snapshots ride into every scalars row; the span tracer and
+    # stall watchdog are coordinator-only opt-ins (cfg.obs)
+    reg = obs_registry.get_registry()
+    log.set_registry(reg)
+    tracer = obs_trace.configure(
+        enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size
+    )
+    watchdog: StallWatchdog | None = None
+    if cfg.obs.watchdog_deadline_s > 0 and is_coord and cfg.train.log_dir:
+        watchdog = StallWatchdog(
+            cfg.train.log_dir, cfg.obs.watchdog_deadline_s, tracer=tracer, registry=reg,
+            poll_s=cfg.obs.watchdog_poll_s, logger=log,
+        )
+        watchdog.start()
+
+    try:
+        return _run_impl(cfg, log, mesh, is_coord, tracer, watchdog)
+    finally:
+        # flush telemetry on EVERY exit — a crash mid-epoch is exactly when
+        # the trace and counters matter most
+        if watchdog is not None:
+            watchdog.stop()
+        if tracer.enabled and cfg.train.log_dir and is_coord:
+            path = tracer.write(os.path.join(cfg.train.log_dir, "obs_trace.json"))
+            log.log(f"span trace -> {path} (open in ui.perfetto.dev or chrome://tracing)")
+        if is_coord and cfg.train.log_dir:
+            snap_path = os.path.join(cfg.train.log_dir, "obs_registry.json")
+            with open(snap_path, "w") as f:
+                json.dump(reg.snapshot(), f, indent=1, sort_keys=True)
+        log.close()
+
+
+def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) -> dict:
     net = get_model(cfg.model, cfg.data.image_size)
     prof = profile_network(net)
     arch_name = cfg.model.network_spec or f"{cfg.model.arch} x{cfg.model.width_mult}"
     log.log(f"model {arch_name}: {prof.total_params/1e6:.2f}M params, {prof.total_macs/1e6:.1f}M MACs")
+    reg = obs_registry.get_registry()
 
     ckpt = CheckpointManager(
         cfg.train.log_dir + "/ckpt", max_to_keep=cfg.train.max_checkpoints,
@@ -308,7 +373,7 @@ def run(cfg: Config) -> dict:
                 ts = trainer.init_state(jax.random.PRNGKey(cfg.train.seed))
             else:
                 trainer, ts, _ = restored
-        result = evaluate(trainer, ts, cfg)
+        result = evaluate(trainer, ts, cfg, watchdog=watchdog)
         log.log(format_metrics("eval:", result))
         ckpt.close()
         return result
@@ -357,7 +422,9 @@ def run(cfg: Config) -> dict:
     # event runs in-device after every unrolled sub-step (its own step gate
     # keeps the cadence identical to single dispatches). Only the profiler
     # window still needs step-granular host control (start/stop_trace are
-    # host calls at exact step indices) and forces k=1 with a warning.
+    # host calls at exact step indices) and forces k=1 with a warning —
+    # the obs span tracer has no such constraint: its spans time the host
+    # side of each dispatch, grouped or not.
     k_dispatch = max(1, cfg.train.steps_per_dispatch)
     if k_dispatch > 1 and cfg.train.profile_start_step:
         log.log("WARNING: steps_per_dispatch>1 is incompatible with the profiler "
@@ -379,11 +446,15 @@ def run(cfg: Config) -> dict:
             steps_done = 0
             while steps_done < epoch_steps:
                 if grouped_step is not None and epoch_steps - steps_done >= k_dispatch:
-                    bs = tuple(next(train_iter) for _ in range(k_dispatch))
-                    ts, metric_list = grouped_step(ts, bs, rng)
+                    with tracer.span("data/next", "data", batches=k_dispatch):
+                        bs = tuple(next(train_iter) for _ in range(k_dispatch))
+                    with tracer.span("dispatch/grouped_step", "dispatch", steps=k_dispatch):
+                        ts, metric_list = grouped_step(ts, bs, rng)
                 else:
-                    b = next(train_iter)  # already on-mesh (prefetch_to_mesh)
-                    ts, metrics = trainer.train_step(ts, b, rng)
+                    with tracer.span("data/next", "data"):
+                        b = next(train_iter)  # already on-mesh (prefetch_to_mesh)
+                    with tracer.span("dispatch/train_step", "dispatch"):
+                        ts, metrics = trainer.train_step(ts, b, rng)
                     metric_list = [metrics]
                 steps_done += len(metric_list)
                 # per-sub-step host processing: metrics entries are lazy
@@ -394,6 +465,8 @@ def run(cfg: Config) -> dict:
                     host_step += 1
                     step_i = host_step
                     metric_log.update(metrics, batch_images=cfg.train.batch_size)
+                    if watchdog is not None:
+                        watchdog.arm(step_i)
 
                     if cfg.train.profile_start_step and is_coord:
                         if step_i == cfg.train.profile_start_step:
@@ -426,24 +499,28 @@ def run(cfg: Config) -> dict:
                         # an epoch-TAIL step dispatched singly (fewer than k
                         # steps left) has no in-device event and must take
                         # this host path even when grouping is on.
-                        masks, rho_mult = trainer.prune_event(
-                            ts.params, ts.masks, ts.rho_mult, ts.step)
-                        ts = ts.replace(masks=masks, rho_mult=rho_mult)
+                        with tracer.span("prune/mask_event", "prune", step=step_i):
+                            masks, rho_mult = trainer.prune_event(
+                                ts.params, ts.masks, ts.rho_mult, ts.step)
+                            ts = ts.replace(masks=masks, rho_mult=rho_mult)
 
                     if step_i % cfg.train.log_every == 0:
-                        snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
+                        # the log-boundary host sync: snapshot float()s every
+                        # pending metric (blocks on the last dispatched step)
+                        with tracer.span("sync/log_metrics", "sync", step=step_i):
+                            snap = metric_log.snapshot_and_reset(num_chips=trainer.mesh.size)
+                        reg.gauge("train.step").set(step_i)
                         if cfg.prune.enable:
                             snap["effective_macs"] = masking.mask_summary(trainer.net, ts.masks)["effective_macs"]
                             if cfg.prune.rho_schedule == "adaptive":
                                 # adaptation lives on device now; one host
                                 # sync per log boundary, not per event
-                                snap["rho_mult"] = float(jax.device_get(ts.rho_mult))
-                        if cfg.data.loader == "native":
-                            # corrupt inputs must be visible, not silent
-                            # (train path resamples; the counter still climbs)
-                            from ..data import native_loader as _nl
-
-                            snap["decode_failures"] = float(_nl.total_decode_failures())
+                                with tracer.span("sync/rho_mult", "sync"):
+                                    snap["rho_mult"] = float(jax.device_get(ts.rho_mult))
+                                reg.counter("train.forced_host_syncs").inc()
+                        # (decode failures now flow through the registry: the
+                        # native loader registers a data.decode_failures pull
+                        # gauge that every scalars row snapshots)
                         log.log(format_metrics(f"step {step_i}:", snap))
                         log.scalars(step_i, snap, "train/")
                         if snap.get("finite", 1.0) < 1.0:
@@ -451,11 +528,16 @@ def run(cfg: Config) -> dict:
                             raise FloatingPointError("non-finite loss")
                     if cfg.train.check_finite_every and step_i % cfg.train.check_finite_every == 0:
                         # forced host sync — a debug guard, off by default
-                        if float(metrics["finite"]) < 1.0:
+                        with tracer.span("sync/finite_check", "sync", step=step_i):
+                            finite = float(metrics["finite"])
+                        reg.counter("train.forced_host_syncs").inc()
+                        if finite < 1.0:
                             log.error(f"non-finite loss at step {step_i}")
                             raise FloatingPointError("non-finite loss")
                     if cfg.train.param_checksum_every and step_i % cfg.train.param_checksum_every == 0:
-                        div = float(trainer.sync_check(ts.params))
+                        with tracer.span("sync/replica_checksum", "sync", step=step_i):
+                            div = float(trainer.sync_check(ts.params))
+                        reg.counter("train.forced_host_syncs").inc()
                         if div != 0.0:
                             log.error(f"replica divergence {div} at step {step_i}")
                             raise RuntimeError("replica divergence")
@@ -465,19 +547,24 @@ def run(cfg: Config) -> dict:
             # coarse-cadence physical shrink (recompile paid here, not per-step)
             if cfg.prune.enable and remat_cad.due(host_step):
                 old_trainer = trainer
-                trainer, ts = _maybe_rematerialize(trainer, ts, log)
+                with tracer.span("rebuild/rematerialize", "rebuild", step=host_step):
+                    trainer, ts = _maybe_rematerialize(trainer, ts, log)
                 if trainer is not old_trainer:
                     # shapes (and the prune event's cost table) changed —
                     # the grouped program must be rebuilt against the new
                     # trainer; identity check avoids a gratuitous retrace
                     # when nothing died
-                    grouped_step = build_grouped()
+                    reg.counter("train.rebuilds").inc()
+                    with tracer.span("rebuild/grouped_step", "rebuild"):
+                        grouped_step = build_grouped()
+                if watchdog is not None:
+                    watchdog.arm(host_step, phase="rematerialize")
 
             # final eval AND final checkpoint always run, symmetrically, even
             # with the periodic knobs set to 0
             final = epoch >= total_epochs
             if eval_cad.due(host_step) or final:
-                eval_result = evaluate(trainer, ts, cfg)
+                eval_result = evaluate(trainer, ts, cfg, watchdog=watchdog)
                 if eval_result["top1"] > best_top1:  # reference: best-acc tracking
                     best_top1 = eval_result["top1"]
                     if cfg.train.keep_best:
@@ -495,6 +582,8 @@ def run(cfg: Config) -> dict:
                 eval_result["best_top1"] = best_top1
                 log.log(format_metrics(f"eval @ epoch {epoch:.2f}:", eval_result))
                 log.scalars(int(ts.step), eval_result, "eval/")
+                if watchdog is not None:
+                    watchdog.arm(host_step, phase="eval")
 
             if ckpt_cad.due(host_step) or final:
                 # orbax coordinates multi-host saves internally; every process
@@ -505,6 +594,8 @@ def run(cfg: Config) -> dict:
                     int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)),
                     extra={"epoch": epoch, "best_top1": best_top1},
                 )
+                if watchdog is not None:
+                    watchdog.arm(host_step, phase="checkpoint")
 
     finally:
         if trace_active:
@@ -516,14 +607,12 @@ def run(cfg: Config) -> dict:
         # apply any remaining masks physically and emit the searched result
         # as a standalone spec (reference: 'final architecture == surviving
         # channels; emit as block-spec', SURVEY.md §3.2)
-        trainer, ts = _maybe_rematerialize(trainer, ts, log)
+        with tracer.span("rebuild/rematerialize", "rebuild", step=host_step):
+            trainer, ts = _maybe_rematerialize(trainer, ts, log)
         from ..models.serialize import network_to_dict
 
         prof_final = profile_network(trainer.net)
         if is_coord:
-            import json
-            import os
-
             payload = {
                 "network": network_to_dict(trainer.net),
                 "macs": int(prof_final.total_macs),
@@ -545,7 +634,6 @@ def run(cfg: Config) -> dict:
         best_ckpt.close()
     final = {"epoch": epoch, **{f"eval_{k}": v for k, v in eval_result.items()}}
     log.log(format_metrics("done:", final))
-    log.close()
     return final
 
 

@@ -309,6 +309,30 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Runtime telemetry (obs/): span tracing, metrics registry, stall
+    watchdog — docs/OBSERVABILITY.md. The registry is always on (it is just
+    counters); tracing and the watchdog are opt-in knobs."""
+
+    # coordinator-only span tracer; Chrome-trace JSON lands in
+    # log_dir/obs_trace.json at run end (or on crash). Composes with
+    # train.steps_per_dispatch > 1 — spans time the HOST side of dispatches,
+    # unlike the jax.profiler window which forces k=1.
+    trace: bool = False
+    # completed spans kept in the ring buffer (oldest evicted); one span is
+    # a ~100-byte tuple, so the default retains the last few thousand events
+    # of a multi-day run for bounded memory
+    trace_ring_size: int = 4096
+    # no train-loop heartbeat (step / eval / checkpoint / rematerialize
+    # progress) for this long -> hang_report.json in log_dir. 0 = off.
+    # Must exceed the slowest legitimate gap: the first step's compile and
+    # the longest eval/checkpoint phase (docs/OBSERVABILITY.md tuning).
+    watchdog_deadline_s: float = 0.0
+    # watchdog check interval; 0 = auto (deadline/4, clamped to [0.05s, 1s])
+    watchdog_poll_s: float = 0.0
+
+
+@dataclass(frozen=True)
 class DistConfig:
     # number of data-parallel shards; 0 = use all visible devices
     num_devices: int = 0
@@ -331,6 +355,7 @@ class Config:
     prune: PruneConfig = field(default_factory=PruneConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     dist: DistConfig = field(default_factory=DistConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
 
 # ---------------------------------------------------------------------------
@@ -370,6 +395,7 @@ _SECTION_TYPES = {
     "PruneConfig": PruneConfig,
     "TrainConfig": TrainConfig,
     "DistConfig": DistConfig,
+    "ObsConfig": ObsConfig,
     "Config": Config,
 }
 

@@ -18,6 +18,7 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from ..config import DataConfig
+from ..obs.registry import get_registry
 
 _LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native", "libyamt_loader.so")
 _lib = None
@@ -145,6 +146,10 @@ class NativeLoader:
                 raise ValueError("padded eval pass needs at least one sample")
             raise ValueError(f"need at least one full batch of samples ({batch}); got {len(paths)}")
         _live_loaders.add(self)
+        # pull-gauge: the train loop no longer reaches into this module at
+        # log boundaries — the registry snapshot reads the live total
+        # (corrupt inputs stay visible through the one metrics path)
+        get_registry().gauge("data.decode_failures").set_fn(total_decode_failures)
 
     @property
     def num_samples(self) -> int:

@@ -23,6 +23,8 @@ from typing import Iterator
 import numpy as np
 
 from ..config import DataConfig
+from ..obs.registry import get_registry
+from ..utils.logging import emit
 
 # tf is imported lazily: the heavy import (and its thread pools) should only
 # exist in processes that actually build an input pipeline.
@@ -233,10 +235,13 @@ def _host_records_per_epoch(cfg: DataConfig, host_files: list[str], files: list[
             total += n
     except (OSError, ValueError) as e:
         est = max(-(-cfg.num_train_examples * len(host_files) // len(files)), 1)
-        print(f"[data] WARNING: could not count TFRecord shards ({e}); resume "
-              f"arithmetic falls back to the equal-shards estimate "
-              f"({est} records/epoch) — exact resume is NOT guaranteed if "
-              f"shards are uneven", flush=True)
+        # counted, not just printed: a fallback here silently weakens the
+        # exact-resume guarantee, so it must survive into metrics.jsonl
+        get_registry().counter("data.record_count_fallbacks").inc()
+        emit(f"[data] WARNING: could not count TFRecord shards ({e}); resume "
+             f"arithmetic falls back to the equal-shards estimate "
+             f"({est} records/epoch) — exact resume is NOT guaranteed if "
+             f"shards are uneven")
         return est
     if dirty:
         tmp = sidecar + f".tmp.{os.getpid()}"
@@ -251,9 +256,10 @@ def _host_records_per_epoch(cfg: DataConfig, host_files: list[str], files: list[
             except OSError:
                 pass
     est = -(-cfg.num_train_examples * len(host_files) // len(files))
+    get_registry().gauge("data.host_records_per_epoch").set(max(total, 1))
     if total != est:
-        print(f"[data] host shard records/epoch = {total} (counted; equal-shards "
-              f"estimate was {est}) — using the exact count", flush=True)
+        emit(f"[data] host shard records/epoch = {total} (counted; equal-shards "
+             f"estimate was {est}) — using the exact count")
     return max(total, 1)
 
 

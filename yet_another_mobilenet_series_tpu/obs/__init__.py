@@ -1,0 +1,37 @@
+"""Runtime telemetry: a process-wide metrics registry, a coordinator-only
+span tracer, and a stall watchdog (docs/OBSERVABILITY.md).
+
+Three independent layers, composable and individually cheap enough to leave
+on in production:
+
+- ``registry``: typed counters/gauges/histograms unifying every ad-hoc
+  runtime signal (decode failures, checkpoint barrier waits, rebuilds after
+  rematerialization, forced host syncs); snapshots ride into every
+  ``Logger.scalars`` row under an ``obs/`` prefix.
+- ``trace``: a ring-buffered span tracer (context-manager API, monotonic
+  clocks, no host<->device syncs on the hot path) emitting
+  Chrome-trace/Perfetto JSON. Unlike the ``jax.profiler`` window it composes
+  with ``train.steps_per_dispatch > 1``: spans measure HOST time around
+  dispatches, so grouping stays on.
+- ``watchdog``: a heartbeat thread armed per train step; if no step (or
+  eval/checkpoint progress event) lands within a configurable deadline it
+  dumps ``hang_report.json`` — open spans, last completed step, registry
+  snapshot, all thread stacks — before the job dies silently (PROFILE.md's
+  dead-tunnel rounds are the motivating failure mode).
+"""
+
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .trace import SpanTracer, configure, get_tracer
+from .watchdog import StallWatchdog
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanTracer",
+    "StallWatchdog",
+    "configure",
+    "get_registry",
+    "get_tracer",
+]

@@ -1,0 +1,165 @@
+"""Process-wide typed metrics registry (counters, gauges, histograms).
+
+Unifies the runtime signals that previously lived as ad-hoc module state
+(native-loader decode failures reached into from the train loop, bare
+``print`` warnings in the data pipeline, checkpoint barrier waits and
+post-rematerialize rebuilds that were invisible outside one-off benches).
+Producers anywhere in the process register/update metrics by name;
+``Logger.scalars`` snapshots the whole registry into every metrics row, so
+one ``metrics.jsonl`` stream carries every signal.
+
+Thread-safety: metric updates are single bytecode-level mutations guarded by
+a lock only where a read-modify-write races (counter inc, histogram
+observe); ``snapshot()`` may be called from the watchdog thread at any time.
+Gauges may be backed by a pull callback (``set_fn``) so sources that already
+keep their own total (the native loader's C-side failure count) are read
+lazily at snapshot time instead of being pushed per batch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+
+class Counter:
+    """Monotonic count. ``inc`` is the only mutator."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease (inc {n})")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-written value, or a pull callback (``set_fn``) read at snapshot
+    time. A callback that raises falls back to the last good reading — a
+    dying producer (e.g. a closed ctypes loader) must not take the metrics
+    stream down with it."""
+
+    __slots__ = ("name", "_value", "_fn")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._fn: Callable[[], float] | None = None
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                self._value = float(self._fn())
+            except Exception:
+                pass  # keep the last good reading
+        return self._value
+
+
+class Histogram:
+    """Streaming summary stats (count/sum/min/max) — enough to read "how
+    many, how long, worst case" for durations like checkpoint barrier waits
+    without keeping samples."""
+
+    __slots__ = ("name", "count", "total", "vmin", "vmax", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            self.vmin = min(self.vmin, v)
+            self.vmax = max(self.vmax, v)
+
+    def summary(self) -> dict[str, float]:
+        if not self.count:
+            return {"count": 0.0, "sum": 0.0, "mean": 0.0, "max": 0.0}
+        return {
+            "count": float(self.count),
+            "sum": self.total,
+            "mean": self.total / self.count,
+            "max": self.vmax,
+        }
+
+
+class MetricsRegistry:
+    """Name -> typed metric, get-or-create semantics. Re-requesting a name
+    with a different type is a programming error and fails loudly."""
+
+    def __init__(self):
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {type(m).__name__}, "
+                    f"requested as {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> dict[str, float]:
+        """Flat {name: float} view of every metric; histograms expand to
+        ``name.count/.sum/.mean/.max``. Safe to call from any thread."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out: dict[str, float] = {}
+        for name in sorted(metrics):
+            m = metrics[name]
+            if isinstance(m, Histogram):
+                for k, v in m.summary().items():
+                    out[f"{name}.{k}"] = v
+            else:
+                out[name] = float(m.value)
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric (tests; never called by production code — the
+        registry is process-lifetime by design)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every producer and consumer shares."""
+    return _REGISTRY

@@ -1,0 +1,174 @@
+"""Ring-buffered span tracer emitting Chrome-trace/Perfetto JSON.
+
+Coordinator-only, host-side, and deliberately dumber than ``jax.profiler``:
+spans measure HOST wall time (monotonic ``perf_counter_ns``) around the
+things the profiler window cannot see without forcing
+``steps_per_dispatch=1`` — data fetch, step dispatch, the log-boundary
+``float()`` sync, prune events, eval, checkpoint saves, Trainer rebuilds.
+Because a dispatch span closes when the host call RETURNS (async dispatch,
+no device sync), tracing adds no host<->device round trips: an input-bound
+step shows a fat ``data/next`` span, a dispatch-bound one a fat
+``dispatch/*`` span, and a wedged tunnel an open span in the hang report.
+
+The buffer is a fixed-size ring (``collections.deque(maxlen=...)``): a
+multi-day run keeps the last N spans, never unbounded memory. Completed
+spans are plain tuples; JSON rendering happens only at ``write()``.
+
+Categories are load-bearing (docs/OBSERVABILITY.md span taxonomy): ``data``,
+``dispatch``, ``sync``, ``prune``, ``eval``, ``ckpt``, ``rebuild``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+
+class _NullSpan:
+    """Shared do-nothing context manager returned by a disabled tracer —
+    the hot path pays one method call and an attribute test, nothing else."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "args", "t0_ns")
+
+    def __init__(self, tracer: "SpanTracer", name: str, cat: str, args: dict | None):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self.t0_ns = time.perf_counter_ns()
+        self._tracer._push(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer._pop(self, time.perf_counter_ns())
+        return False
+
+
+class SpanTracer:
+    def __init__(self, ring_size: int = 4096, enabled: bool = True):
+        self.enabled = enabled
+        self.ring_size = ring_size
+        # completed spans: (name, cat, t0_ns, dur_ns, tid, args)
+        self._events: collections.deque = collections.deque(maxlen=max(ring_size, 1))
+        # open-span stacks keyed by thread id; each thread pushes/pops only
+        # its own stack (GIL-atomic list ops), the watchdog reads copies
+        self._open: dict[int, list[_Span]] = {}
+        self._origin_ns = time.perf_counter_ns()
+        self._pid = os.getpid()
+
+    # -- hot path -----------------------------------------------------------
+
+    def span(self, name: str, cat: str = "misc", **args):
+        """Context manager timing one host-side region. ``args`` land in the
+        Chrome-trace event's ``args`` block (keep them tiny and constant —
+        NEVER pass a device array: stringifying it would force the very sync
+        this tracer exists to avoid)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args or None)
+
+    def _push(self, span: _Span) -> None:
+        tid = threading.get_ident()
+        stack = self._open.get(tid)
+        if stack is None:
+            stack = self._open[tid] = []
+        stack.append(span)
+
+    def _pop(self, span: _Span, t1_ns: int) -> None:
+        stack = self._open.get(threading.get_ident())
+        if stack and stack[-1] is span:
+            stack.pop()
+        self._events.append(
+            (span.name, span.cat, span.t0_ns, t1_ns - span.t0_ns, threading.get_ident(), span.args)
+        )
+
+    # -- readout ------------------------------------------------------------
+
+    def open_spans(self) -> list[dict]:
+        """Currently-open spans across all threads (outermost first) — the
+        "where was it stuck" section of the watchdog's hang report."""
+        now = time.perf_counter_ns()
+        out = []
+        for tid, stack in list(self._open.items()):
+            for span in list(stack):
+                out.append(
+                    {
+                        "name": span.name,
+                        "cat": span.cat,
+                        "tid": tid,
+                        "open_for_s": (now - span.t0_ns) / 1e9,
+                        "args": span.args,
+                    }
+                )
+        return out
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (load via chrome://tracing or
+        https://ui.perfetto.dev). Complete ("X") events, ts/dur in µs."""
+        events: list[dict] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self._pid,
+                "tid": 0,
+                "ts": 0,
+                "args": {"name": "yamt coordinator"},
+            }
+        ]
+        for name, cat, t0_ns, dur_ns, tid, args in list(self._events):
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": (t0_ns - self._origin_ns) / 1e3,
+                "dur": dur_ns / 1e3,
+                "pid": self._pid,
+                "tid": tid,
+            }
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write(self, path: str) -> str:
+        """Atomically write the Chrome-trace JSON next to the run's logs."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        os.replace(tmp, path)
+        return path
+
+
+# Module singleton: producers deep in the stack (prefetch_to_mesh, the
+# checkpoint manager) fetch the tracer by call, so cli/train.py can configure
+# it once without threading a tracer handle through every signature.
+_TRACER = SpanTracer(ring_size=1, enabled=False)
+
+
+def get_tracer() -> SpanTracer:
+    return _TRACER
+
+
+def configure(enabled: bool, ring_size: int = 4096) -> SpanTracer:
+    """Install the process tracer (cli/train.py, coordinator only)."""
+    global _TRACER
+    _TRACER = SpanTracer(ring_size=ring_size, enabled=enabled)
+    return _TRACER

@@ -1,0 +1,129 @@
+"""Stall watchdog: a heartbeat thread that turns a silent hang into a
+post-mortem file.
+
+PROFILE.md's dead-tunnel rounds are the motivating failure: the train loop
+blocks forever inside a dispatch (or ``next(train_iter)``), nothing is
+logged, and the job dies only when the scheduler reaps it. The watchdog is
+armed by the train loop at every completed step (and at eval/checkpoint/
+rematerialize progress events, whose host time legitimately dwarfs a step);
+when no heartbeat lands within the configured deadline it writes
+``hang_report.json`` to the log dir — open spans from the tracer, the last
+completed step and phase, a full registry snapshot, and every thread's stack
+— then keeps the process untouched (the job still dies; now it dies loud).
+
+The report is written at most once per process: a hang is a terminal state,
+and re-dumping every poll interval would only shred the first, most accurate
+stack capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+
+from .registry import MetricsRegistry
+from .trace import SpanTracer
+
+REPORT_NAME = "hang_report.json"
+
+
+class StallWatchdog:
+    def __init__(
+        self,
+        log_dir: str,
+        deadline_s: float,
+        *,
+        tracer: SpanTracer | None = None,
+        registry: MetricsRegistry | None = None,
+        poll_s: float = 0.0,
+        logger=None,
+    ):
+        if deadline_s <= 0:
+            raise ValueError(f"watchdog deadline must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self.poll_s = float(poll_s) if poll_s > 0 else max(min(deadline_s / 4.0, 1.0), 0.05)
+        self.report_path = os.path.join(log_dir, REPORT_NAME)
+        self._tracer = tracer
+        self._registry = registry
+        self._logger = logger
+        self._beat_ns: int | None = None
+        self._step: int | None = None
+        self._phase = "startup"
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="yamt-obs-watchdog", daemon=True)
+
+    # -- train-loop surface --------------------------------------------------
+
+    def start(self) -> None:
+        # arm immediately: a tunnel that wedges before step 1 completes is
+        # exactly the hang this exists for (deadline must therefore exceed
+        # the first step's compile time — docs/OBSERVABILITY.md tuning)
+        self.arm(step=None, phase="startup")
+        self._thread.start()
+
+    def arm(self, step: int | None = None, phase: str = "step") -> None:
+        """Heartbeat: "the loop made progress". Called per completed train
+        step and at eval/checkpoint/rematerialize boundaries."""
+        if step is not None:
+            self._step = step
+        self._phase = phase
+        self._beat_ns = time.monotonic_ns()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=max(self.poll_s * 4, 1.0))
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    # -- watchdog thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            beat = self._beat_ns
+            if beat is None or self._fired:
+                continue
+            elapsed = (time.monotonic_ns() - beat) / 1e9
+            if elapsed <= self.deadline_s:
+                continue
+            self._fired = True
+            try:
+                self._dump(elapsed)
+                msg = (
+                    f"WATCHDOG: no progress for {elapsed:.1f}s "
+                    f"(deadline {self.deadline_s:.1f}s, last phase "
+                    f"'{self._phase}', last step {self._step}); wrote {self.report_path}"
+                )
+                if self._logger is not None:
+                    self._logger.error(msg)
+                else:
+                    sys.stderr.write(msg + "\n")
+            except Exception:
+                sys.stderr.write("WATCHDOG: failed to write hang report:\n" + traceback.format_exc())
+
+    def _dump(self, elapsed_s: float) -> None:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        threads = {
+            f"{names.get(tid, 'thread')}-{tid}": traceback.format_stack(frame)
+            for tid, frame in sys._current_frames().items()
+        }
+        report = {
+            "seconds_since_last_beat": elapsed_s,
+            "deadline_s": self.deadline_s,
+            "last_step": self._step,
+            "last_phase": self._phase,
+            "open_spans": self._tracer.open_spans() if self._tracer is not None else [],
+            "registry": self._registry.snapshot() if self._registry is not None else {},
+            "threads": threads,
+        }
+        tmp = f"{self.report_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1)
+        os.replace(tmp, self.report_path)

@@ -13,6 +13,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs import trace as obs_trace
+
 DATA_AXIS = "data"
 
 
@@ -71,8 +73,12 @@ def prefetch_to_mesh(batch_iter, mesh: Mesh, depth: int = 2):
     buf = collections.deque()
 
     def fill():
+        # the span times host-side batch production + the async device_put
+        # enqueue; a fat data/prefetch_fill next to a thin data/next means
+        # the pipeline keeps up only because the prefetch depth hides it
         try:
-            buf.append(shard_batch(next(batch_iter), mesh))
+            with obs_trace.get_tracer().span("data/prefetch_fill", "data"):
+                buf.append(shard_batch(next(batch_iter), mesh))
             return True
         except StopIteration:
             return False

@@ -1,10 +1,35 @@
-"""Structured stdout + TensorBoard logging on the coordinator only
-(reference: master-only logging + TB scalars, SURVEY.md §5 observability)."""
+"""Structured stdout + jsonl + TensorBoard logging on the coordinator only
+(reference: master-only logging + TB scalars, SURVEY.md §5 observability).
+
+This module is THE sanctioned print surface of the package (yamt-lint
+YAMT007): everything else routes messages through a :class:`Logger` or the
+module-level :func:`emit` — so "the run went quiet" always means the run
+went quiet, not that a warning raced past on a worker's stdout.
+
+TensorBoard is best-effort: TPU hosts run TF for tf.data, but lean eval
+boxes and CI images may not ship it — a missing/broken tensorflow degrades
+to jsonl-only with a single warning instead of crashing the run
+(cli/train.py enables tensorboard for every run with a log dir).
+"""
 
 from __future__ import annotations
 
 import sys
 import time
+
+# the active coordinator Logger, so code without a Logger handle (the data
+# pipeline's host warnings) can still route through one via emit()
+_CURRENT: "Logger | None" = None
+_TB_WARNED = False
+
+
+def emit(msg: str) -> None:
+    """Route a message through the active coordinator Logger when one
+    exists; plain stdout otherwise (workers, bare library use)."""
+    if _CURRENT is not None and _CURRENT.enabled:
+        _CURRENT.log(msg)
+    else:
+        print(msg, flush=True)
 
 
 class Logger:
@@ -14,6 +39,7 @@ class Logger:
         self._jsonl = None
         self._jsonl_path = None
         self._append = True
+        self._registry = None
         if enabled and log_dir:
             import os
 
@@ -23,9 +49,29 @@ class Logger:
             # decision — can truncate it and keep step rows monotonic
             self._jsonl_path = os.path.join(log_dir, "metrics.jsonl")
             if tensorboard:
-                import tensorflow as tf
+                try:
+                    import tensorflow as tf
+                except Exception as e:  # TF missing or broken: degrade, once
+                    global _TB_WARNED
+                    if not _TB_WARNED:
+                        _TB_WARNED = True
+                        print(
+                            "WARNING: tensorboard logging disabled "
+                            f"(tensorflow import failed: {type(e).__name__}: {e}); "
+                            "metrics continue in metrics.jsonl",
+                            flush=True,
+                        )
+                else:
+                    self._tb = tf.summary.create_file_writer(log_dir)
+        if enabled:
+            global _CURRENT
+            _CURRENT = self
 
-                self._tb = tf.summary.create_file_writer(log_dir)
+    def set_registry(self, registry) -> None:
+        """Attach an obs.MetricsRegistry: every scalars() row carries its
+        snapshot under an ``obs/`` prefix — counters, gauges, histogram
+        summaries all land in the same metrics.jsonl/TensorBoard stream."""
+        self._registry = registry
 
     def mark_fresh_run(self):
         """No checkpoint was restored: truncate the metrics stream instead of
@@ -38,28 +84,32 @@ class Logger:
             print(f"[{ts}] {msg}", flush=True)
 
     def scalars(self, step: int, metrics: dict, prefix: str = ""):
+        row = {f"{prefix}{k}": float(v) for k, v in metrics.items()}
+        if self._registry is not None:
+            row.update({f"obs/{k}": float(v) for k, v in self._registry.snapshot().items()})
         if self._jsonl is None and self._jsonl_path is not None:
             self._jsonl = open(self._jsonl_path, "a" if self._append else "w")
             self._jsonl_path = None
         if self._jsonl is not None:
             import json
 
-            row = {"step": int(step)}
-            row.update({f"{prefix}{k}": float(v) for k, v in metrics.items()})
-            self._jsonl.write(json.dumps(row) + "\n")
+            self._jsonl.write(json.dumps({"step": int(step), **row}) + "\n")
             self._jsonl.flush()
         if self._tb is None:
             return
         import tensorflow as tf
 
         with self._tb.as_default():
-            for k, v in metrics.items():
-                tf.summary.scalar(f"{prefix}{k}", float(v), step=step)
+            for k, v in row.items():
+                tf.summary.scalar(k, v, step=step)
 
     def error(self, msg: str):
         print(f"ERROR: {msg}", file=sys.stderr, flush=True)
 
     def close(self):
+        global _CURRENT
+        if _CURRENT is self:
+            _CURRENT = None
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
