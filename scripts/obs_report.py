@@ -61,6 +61,31 @@ def summarize(log_dir: str) -> str:
         lines.append(f"\n## registry snapshot ({len(snap)} metrics)")
         for name in sorted(snap):
             lines.append(f"  {name} = {snap[name]:.6g}")
+        if any(k.startswith("serve.") for k in snap):
+            # serving run (docs/SERVING.md): derive the headline numbers from
+            # the histograms the engine/batcher populate
+            lines.append("\n## serving")
+            lines.append(
+                "  requests = {:.0f}, completed = {:.0f}, shed = {:.0f}, "
+                "rejected = {:.0f}".format(
+                    snap.get("serve.requests", 0), snap.get("serve.completed", 0),
+                    snap.get("serve.shed_deadline", 0), snap.get("serve.rejected_full", 0))
+            )
+            for h, label in (("serve.queue_wait_seconds", "queue wait"),
+                             ("serve.run_seconds", "run latency")):
+                if snap.get(f"{h}.count"):
+                    lines.append(
+                        f"  {label}: mean {snap[f'{h}.mean'] * 1e3:.2f} ms, "
+                        f"max {snap[f'{h}.max'] * 1e3:.2f} ms over {snap[f'{h}.count']:.0f}"
+                    )
+            if snap.get("serve.batch_size.count"):
+                lines.append(
+                    f"  batch size: mean {snap['serve.batch_size.mean']:.2f}, "
+                    f"max {snap['serve.batch_size.max']:.0f}"
+                )
+            hits = {k.rsplit(".", 1)[-1]: v for k, v in snap.items() if k.startswith("serve.bucket_hits.")}
+            if hits:
+                lines.append("  bucket hits: " + ", ".join(f"{b}: {v:.0f}" for b, v in sorted(hits.items(), key=lambda kv: int(kv[0]))))
     else:
         lines.append("\n## registry snapshot: missing (run predates obs/ or crashed before flush)")
 

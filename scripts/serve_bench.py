@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Serving benchmark: folded-model inference latency/QPS per batch bucket.
+
+Prints exactly ONE JSON line on stdout in the bench.py artifact shape
+(tests/test_bench_contract.py contract: exit 0 always; a failed run emits
+``value: null`` with an ``error`` field, never a stack trace) and optionally
+writes it to a BENCH_SERVE_*.json via --out:
+
+  {"metric": "<arch>_serve_images_per_sec", "value": <peak qps>,
+   "unit": "images/sec", "vs_baseline": null, "platform": ...,
+   "buckets": [{"batch": B, "p50_ms": ..., "p99_ms": ..., "qps": ...}, ...]}
+
+The model is random-init + synthetic BN stats, folded through the real
+serve/export transform and dispatched through the real AOT engine — the
+numbers measure the serving path (compile, pad, dispatch, device_get), which
+does not depend on trained weight values.
+
+Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
+           [--image-size 224] [--buckets 1,8,32] [--iters 20] [--out f.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(round(q * (len(sorted_vals) - 1))), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def measure(arch: str, image_size: int, buckets: tuple[int, ...], iters: int) -> dict:
+    import jax
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+    from yet_another_mobilenet_series_tpu.serve.export import InferenceBundle, fold_network
+
+    if arch == "tiny":  # contract-test preset: 2 blocks, compiles in seconds
+        mc = ModelConfig(arch="mobilenet_v2", num_classes=16, dropout=0.0,
+                         block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}, {"t": 2, "c": 16, "n": 1, "s": 2}])
+    else:
+        mc = ModelConfig(arch=arch)
+    net = get_model(mc, image_size)
+    params, state = net.init(jax.random.PRNGKey(0))
+    bundle = InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
+    engine = InferenceEngine(bundle, buckets=buckets, image_size=image_size)
+
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    rng = np.random.RandomState(0)
+    rows = []
+    for b in engine.buckets:
+        x = rng.normal(0, 1, (b, image_size, image_size, 3)).astype(np.float32)
+        engine.predict(x)  # one untimed call: page in the executable
+        lat = []
+        for _ in range(iters):
+            t1 = time.perf_counter()
+            engine.predict(x)
+            lat.append(time.perf_counter() - t1)
+        lat.sort()
+        mean = sum(lat) / len(lat)
+        rows.append({
+            "batch": b,
+            "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            "qps": round(b / mean, 2),
+        })
+    dev = jax.devices()[0]
+    return {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "n_chips": len(jax.devices()),
+        "warmup_compile_s": round(warmup_s, 2),
+        "buckets": rows,
+        "peak_qps": max(r["qps"] for r in rows),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--arch", default="mobilenet_v3_large")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--buckets", default="1,8,32")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--out", default="", help="also write the JSON artifact here")
+    args = ap.parse_args(argv)
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+
+    out = {
+        "metric": f"{args.arch}_serve_images_per_sec",
+        "value": None,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "vs_baseline_note": "no serving reference measurement exists yet",
+        "image_size": args.image_size,
+        "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    try:
+        m = measure(args.arch, args.image_size, buckets, max(1, args.iters))
+        out.update(m)
+        out["value"] = m["peak_qps"]
+    except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0
+        out["error"] = f"{type(e).__name__}: {e}"
+    line = json.dumps(out)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,13 @@
 """YAMT003 must flag: collectives over an axis name no mesh defines."""
 
 from jax import lax
+from jax.sharding import Mesh
 
 DATA_AXIS = "data"  # the project's one mesh axis
+
+
+def make_mesh(devices):
+    return Mesh(devices, ("data", "fsdp"))  # a 2-D mesh adds 'fsdp'
 
 
 def allreduce(x):
@@ -11,3 +16,7 @@ def allreduce(x):
 
 def rank():
     return lax.axis_index("model")  # nor 'model'
+
+
+def scatter(x):
+    return lax.psum_scatter(x, "fsdp2")  # near-miss of the Mesh tuple's 'fsdp'

@@ -1,0 +1,27 @@
+"""YAMT008 must flag: reads of a buffer after jit donation deleted it."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+multi = jax.pmap(lambda s, m, b: s + m + b, donate_argnums=(0, 1))
+
+
+def train(state, batches):
+    total = 0.0
+    for b in batches:
+        state_new = step(state, b)  # donates `state`...
+        total = total + jnp.sum(state)  # ...then reads the deleted buffer
+        state = state_new
+    return state, total
+
+
+def double_dispatch(state, b):
+    a = step(state, b)
+    c = step(state, b)  # the donated buffer passed to a second dispatch
+    return a, c
+
+
+def pmap_reuse(state, momentum, b):
+    out = multi(state, momentum, b)
+    return out, momentum  # momentum was donated at position 1

@@ -1,0 +1,34 @@
+"""YAMT008 must stay silent: rebind-before-read, and non-donating jits."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+plain = jax.jit(lambda s: s * 2)
+
+
+def train(state, batches):
+    for b in batches:
+        # the canonical idiom: the donated var is rebound by the SAME
+        # statement that donates it (cli/train.py's dispatch loop)
+        state = step(state, b)
+    return state
+
+
+def rebound_before_read(state, b):
+    new = step(state, b)
+    state = new
+    return step(state, b)
+
+
+def branches(state, b, flag):
+    if flag:
+        state = step(state, b)
+    else:
+        state = state + 1.0
+    return jnp.sum(state)  # every path rebound state
+
+
+def no_donation(state):
+    y = plain(state)
+    return y + state  # plain does not donate: reads stay legal

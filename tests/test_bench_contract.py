@@ -1,9 +1,16 @@
-"""The driver-artifact contract for bench.py (VERDICT r2 #1): against a
-dead/absent TPU tunnel it must exit 0 with ONE parsed JSON line on stdout —
-a CPU fallback carrying fallback_from/tpu_error — inside a driver-sized
-window. Rounds 1 and 2 shipped rc=1 and rc=124 artifacts; this pins the fix
-(the fast liveness probe) as a regression test rather than a one-off
-certification (PROFILE.md 'Round 3')."""
+"""The driver-artifact contract for the benchmark entry points: exit 0 with
+ONE parsed JSON line on stdout, structured error fields instead of stack
+traces.
+
+- bench.py (VERDICT r2 #1): against a dead/absent TPU tunnel it must emit a
+  CPU fallback carrying fallback_from/tpu_error inside a driver-sized
+  window. Rounds 1 and 2 shipped rc=1 and rc=124 artifacts; this pins the
+  fix (the fast liveness probe) as a regression test rather than a one-off
+  certification (PROFILE.md 'Round 3'). Slow (simulated probe timeout).
+- scripts/serve_bench.py: the serving benchmark emits the same artifact
+  shape (BENCH_SERVE_*.json — p50/p99 latency + QPS per batch bucket) and
+  is fast enough to stay in the tier-1 gate via its tiny preset.
+"""
 
 import json
 import os
@@ -14,9 +21,8 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-pytestmark = pytest.mark.slow
 
-
+@pytest.mark.slow
 def test_bench_dead_tunnel_emits_parsed_cpu_fallback():
     # clean env: conftest.py mutates JAX_PLATFORMS/XLA_FLAGS for the pytest
     # process (8 fake CPU devices), which must NOT leak into bench.py — it
@@ -60,3 +66,32 @@ def test_bench_dead_tunnel_emits_parsed_cpu_fallback():
     last = out["last_tpu"]
     assert last["value"] > 0 and last["device_kind"]
     assert last["source"].startswith("BENCH_TPU_r") and last["measured_date"]
+
+
+def test_serve_bench_emits_parsed_artifact(tmp_path):
+    """scripts/serve_bench.py: exactly one JSON line, bench.py artifact
+    shape, p50/p99/QPS per bucket — the BENCH_SERVE_* contract."""
+    out_path = tmp_path / "BENCH_SERVE_test.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--arch", "tiny", "--image-size", "24", "--buckets", "2,4", "--iters", "3",
+         "--out", str(out_path)],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "tiny_serve_images_per_sec"
+    assert "error" not in out, out.get("error")
+    assert out["value"] is not None and out["value"] > 0
+    assert out["unit"] == "images/sec"
+    assert out["vs_baseline"] is None  # no serving reference divisor exists
+    assert out["platform"]
+    # QPS vs batch size: one row per bucket, latency quantiles ordered
+    assert [r["batch"] for r in out["buckets"]] == [2, 4]
+    for r in out["buckets"]:
+        assert r["qps"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0
+    assert out["value"] == max(r["qps"] for r in out["buckets"])
+    # --out writes the same artifact for the driver to collect
+    assert json.loads(out_path.read_text()) == out

@@ -1,0 +1,422 @@
+"""serve/ subsystem tests (docs/SERVING.md).
+
+The three load-bearing claims, each pinned:
+
+- **BN-fold parity**: the exported folded forward matches the masked
+  eval-mode BN forward within the documented fp32 tolerance (atol 1e-4 on
+  logits; measured ~1e-9..1e-6 — the fold only re-associates a per-channel
+  multiply into the conv accumulation).
+- **bucket-padding correctness**: padded rows change NOTHING — the real
+  rows' logits are bitwise identical to an exact-bucket run of the same
+  compiled executable (the forward is row-independent once BN is folded
+  away).
+- **batcher semantics under concurrency**: coalescing routes every request
+  to its own logits row; bounded-queue backpressure and deadline shedding
+  fire when provoked; a dying engine fails futures instead of hanging
+  clients.
+
+Plus the full round trip: train smoke -> checkpoint -> cli.serve export ->
+bundle -> engine under concurrent load, with serve histograms visible in the
+obs registry snapshot (the acceptance criterion).
+"""
+
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from yet_another_mobilenet_series_tpu.config import ModelConfig, config_from_dict
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.models.serialize import (
+    network_from_dict,
+    network_to_dict,
+    spec_is_inference,
+)
+from yet_another_mobilenet_series_tpu.nas import masking
+from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+from yet_another_mobilenet_series_tpu.parallel import mesh as mesh_lib
+from yet_another_mobilenet_series_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
+from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.export import (
+    InferenceBundle,
+    apply_folded,
+    export_bundle,
+    flatten_tree,
+    fold_network,
+    load_bundle,
+    unflatten_tree,
+)
+
+# the documented BN-fold tolerance (docs/SERVING.md): fp32 re-association only
+FOLD_ATOL = 1e-4
+
+
+def _small_net(num_classes=10, image_size=24, atom=False):
+    specs = [
+        {"t": 2, "c": 8, "n": 1, "s": 2, "k": [3, 5] if atom else 3, "se": 0.25 if atom else 0},
+        {"t": 3, "c": 16, "n": 2, "s": 2},
+    ]
+    return get_model(
+        ModelConfig(arch="mobilenet_v2", num_classes=num_classes, block_specs=specs, dropout=0.0),
+        image_size=image_size,
+    )
+
+
+def _init_with_stats(net, seed=0):
+    """Params + NON-trivial BN running stats (fresh init has mean=0/var=1,
+    which would let a broken fold hide behind the identity affine)."""
+    params, state = net.init(jax.random.PRNGKey(seed))
+    k = jax.random.PRNGKey(seed + 1)
+    leaves, treedef = jax.tree.flatten(state)
+    keys = jax.random.split(k, len(leaves))
+    state = jax.tree.unflatten(
+        treedef,
+        [l + 0.1 * jnp.abs(jax.random.normal(kk, l.shape)) + 0.01 for l, kk in zip(leaves, keys)],
+    )
+    return params, state
+
+
+# ---------------------------------------------------------------------------
+# export: fold + bundle
+# ---------------------------------------------------------------------------
+
+
+def test_bn_fold_parity():
+    net = _small_net(atom=True)
+    params, state = _init_with_stats(net)
+    x = jnp.asarray(np.random.RandomState(0).normal(0, 1, (4, 24, 24, 3)).astype(np.float32))
+    ref, _ = net.apply(params, state, x, train=False)
+    folded = fold_network(net, params, state)
+    got = apply_folded(net, folded, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=FOLD_ATOL, rtol=0)
+    # the folded tree really has no BN left
+    flat = flatten_tree(folded)
+    assert not any("bn" in k for k in flat)
+    assert any(k.endswith("/b") for k in flat)  # folded shifts became biases
+
+
+def test_export_hard_applies_masks(tmp_path):
+    """Bundle of a masked supernet == masked eval forward (remat is bit-exact
+    vs masking; the fold adds only fp32 re-association)."""
+    net = _small_net(atom=True)
+    params, state = _init_with_stats(net, seed=3)
+    masks = masking.init_masks(net)
+    k0 = next(iter(masks))
+    m = np.array(masks[k0])  # np.asarray of a jax array is read-only
+    m[::3] = 0.0  # kill a third of the first prunable block's atoms
+    masks[k0] = jnp.asarray(m)
+    x = jnp.asarray(np.random.RandomState(1).normal(0, 1, (2, 24, 24, 3)).astype(np.float32))
+    ref, _ = net.apply(params, state, x, train=False, masks={int(k): v for k, v in masks.items()})
+    out = export_bundle(net, params, state, str(tmp_path / "b"), masks=masks)
+    bundle = load_bundle(out)
+    # the dead atoms are physically gone from the artifact
+    assert sum(b.expanded_channels for b in bundle.net.blocks) < sum(
+        b.expanded_channels for b in net.blocks
+    )
+    assert bundle.meta["prune"]["atoms_after"] < bundle.meta["prune"]["atoms_before"]
+    got = apply_folded(bundle.net, jax.tree.map(jnp.asarray, bundle.params), x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=FOLD_ATOL, rtol=0)
+
+
+def test_bundle_round_trip_and_inference_marker(tmp_path):
+    net = _small_net()
+    params, state = _init_with_stats(net)
+    out = export_bundle(net, params, state, str(tmp_path / "b"), extra_meta={"note": "t"})
+    spec = json.loads((tmp_path / "b" / "spec.json").read_text())
+    assert spec["schema"] == 2 and spec_is_inference(spec)
+    bundle = load_bundle(out)
+    assert bundle.net == net
+    assert bundle.meta["note"] == "t"
+    # flatten/unflatten is exact
+    flat = flatten_tree(bundle.params)
+    re = unflatten_tree(flat)
+    assert jax.tree.structure(re) == jax.tree.structure(bundle.params)
+
+
+def test_load_bundle_rejects_training_spec(tmp_path):
+    net = _small_net()
+    (tmp_path / "spec.json").write_text(json.dumps(network_to_dict(net)))  # inference=False
+    np.savez(tmp_path / "weights.npz")
+    with pytest.raises(ValueError, match="not an inference bundle"):
+        load_bundle(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# serialize schema v2 / v1 compat
+# ---------------------------------------------------------------------------
+
+
+def test_serialize_v2_round_trip_and_v1_compat():
+    net = _small_net(atom=True)
+    d = network_to_dict(net)
+    assert d["schema"] == 2 and d["inference"] is False
+    assert network_from_dict(json.loads(json.dumps(d))) == net
+    assert network_to_dict(net, inference=True)["inference"] is True
+    # a v1 payload (pre-serving checkpoint sidecar / searched_arch.json):
+    # no "inference" key, schema 1 — must still load
+    v1 = dict(d)
+    v1["schema"] = 1
+    del v1["inference"]
+    assert network_from_dict(json.loads(json.dumps(v1))) == net
+    assert not spec_is_inference(v1)
+    with pytest.raises(ValueError, match="unsupported network schema"):
+        network_from_dict({**d, "schema": 99})
+
+
+# ---------------------------------------------------------------------------
+# engine: buckets, padding, AOT warmup, sharding
+# ---------------------------------------------------------------------------
+
+
+def _bundle(tmp_path, **kw):
+    net = _small_net(**kw)
+    params, state = _init_with_stats(net)
+    export_bundle(net, params, state, str(tmp_path / "eng"))
+    return load_bundle(str(tmp_path / "eng"))
+
+
+def test_engine_bucket_padding_bitwise(tmp_path):
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24)
+    eng.warmup()
+    assert set(eng._compiled) == {2, 4}  # warmup precompiled every bucket
+    x = np.random.RandomState(0).normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
+    full = eng.predict(x)  # exact bucket, no padding
+    part = eng.predict(x[:3])  # 3 -> padded to 4
+    np.testing.assert_array_equal(part, full[:3])
+    one = eng.predict(x[:1])  # 1 -> padded to 2
+    two = eng.predict(x[:2])
+    np.testing.assert_array_equal(one, two[:1])
+    # > max bucket chunks through the biggest bucket
+    seven = eng.predict(np.concatenate([x, x[:3]]))
+    assert seven.shape == (7, 10)
+    np.testing.assert_array_equal(seven[:4], full)
+    snap = get_registry().snapshot()
+    assert snap["serve.bucket_hits.2"] >= 2 and snap["serve.bucket_hits.4"] >= 2
+    assert snap["serve.run_seconds.count"] >= 5
+    assert snap["serve.padded_rows"] >= 3
+
+
+def test_engine_data_parallel_matches_single_device(tmp_path):
+    bundle = _bundle(tmp_path)
+    x = np.random.RandomState(2).normal(0, 1, (8, 24, 24, 3)).astype(np.float32)
+    solo = InferenceEngine(bundle, buckets=(8,), donate_input=False, image_size=24)
+    ref = solo.predict(x)
+    mesh = mesh_lib.make_mesh()
+    dp = InferenceEngine(bundle, buckets=(8, 16), mesh=mesh, donate_input=False, image_size=24)
+    dp.warmup()
+    got = dp.predict(x)
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="not divisible"):
+        InferenceEngine(bundle, buckets=(4,), mesh=mesh)
+
+
+def test_engine_input_validation(tmp_path):
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2,), donate_input=False, image_size=24)
+    with pytest.raises(ValueError, match="empty"):
+        eng.predict(np.zeros((0, 24, 24, 3), np.float32))
+    with pytest.raises(ValueError, match="expects"):
+        eng.predict(np.zeros((24, 24, 3), np.float32))
+    with pytest.raises(ValueError, match="bucket"):
+        InferenceEngine(bundle, buckets=())
+
+
+# ---------------------------------------------------------------------------
+# batcher: coalescing, backpressure, shedding
+# ---------------------------------------------------------------------------
+
+
+def _row_id_predict(images):
+    # each request's image is a constant plane carrying its id; the "logits"
+    # echo it so row routing is verifiable per request
+    return images[:, 0, 0, :1]
+
+
+def test_batcher_concurrent_clients_route_rows():
+    batch_sizes = []
+
+    def predict(images):
+        batch_sizes.append(images.shape[0])
+        return _row_id_predict(images)
+
+    b = MicroBatcher(predict, max_batch=8, max_wait_ms=20.0, queue_depth=64).start()
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def client(i):
+            img = np.full((4, 4, 3), float(i), np.float32)
+            val = b.submit(img).result(timeout=10)
+            with lock:
+                results[i] = float(val[0])
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        b.stop()
+    assert results == {i: float(i) for i in range(24)}
+    assert max(batch_sizes) > 1, "no coalescing happened under 24 concurrent clients"
+    assert sum(batch_sizes) == 24
+    snap = get_registry().snapshot()
+    assert snap["serve.queue_wait_seconds.count"] >= 24
+    assert snap["serve.batch_size.max"] > 1
+
+
+def test_batcher_backpressure_queue_full():
+    hold = threading.Event()
+
+    def predict(images):
+        hold.wait(5)
+        return _row_id_predict(images)
+
+    b = MicroBatcher(predict, max_batch=1, max_wait_ms=0.0, queue_depth=2).start()
+    img = np.zeros((2, 2, 3), np.float32)
+    try:
+        futs = [b.submit(img)]
+        time.sleep(0.1)  # let the worker pull one into the (blocked) engine
+        with pytest.raises(QueueFull):
+            for _ in range(8):
+                futs.append(b.submit(img))
+        hold.set()
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        hold.set()
+        b.stop()
+    assert get_registry().snapshot()["serve.rejected_full"] >= 1
+
+
+def test_batcher_deadline_shedding():
+    release = threading.Event()
+
+    def predict(images):
+        release.wait(5)
+        return _row_id_predict(images)
+
+    b = MicroBatcher(predict, max_batch=1, max_wait_ms=0.0, queue_depth=16).start()
+    img = np.zeros((2, 2, 3), np.float32)
+    try:
+        first = b.submit(img)  # occupies the engine
+        time.sleep(0.05)
+        doomed = b.submit(img, deadline_ms=10.0)  # expires while queued
+        time.sleep(0.1)
+        release.set()
+        first.result(timeout=10)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+    finally:
+        release.set()
+        b.stop()
+    assert get_registry().snapshot()["serve.shed_deadline"] >= 1
+
+
+def test_batcher_engine_failure_fails_futures_not_hangs():
+    def predict(images):
+        raise RuntimeError("engine died")
+
+    b = MicroBatcher(predict, max_batch=4, max_wait_ms=1.0).start()
+    try:
+        fut = b.submit(np.zeros((2, 2, 3), np.float32))
+        with pytest.raises(RuntimeError, match="engine died"):
+            fut.result(timeout=10)
+        # the worker survived the exception and keeps serving
+        fut2 = b.submit(np.zeros((2, 2, 3), np.float32))
+        with pytest.raises(RuntimeError, match="engine died"):
+            fut2.result(timeout=10)
+    finally:
+        b.stop()
+
+
+def test_batcher_lifecycle_errors():
+    b = MicroBatcher(_row_id_predict)
+    with pytest.raises(RuntimeError, match="not started"):
+        b.submit(np.zeros((2, 2, 3), np.float32))
+    b.start()
+    with pytest.raises(RuntimeError, match="already started"):
+        b.start()
+    b.stop()
+    with pytest.raises(ValueError, match="max_batch"):
+        MicroBatcher(_row_id_predict, max_batch=0)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance round trip: train -> ckpt -> cli.serve export -> serve
+# ---------------------------------------------------------------------------
+
+
+def test_train_export_serve_round_trip(tmp_path):
+    from yet_another_mobilenet_series_tpu.cli import serve as cli_serve
+    from yet_another_mobilenet_series_tpu.cli import train as cli_train
+
+    train_dir = tmp_path / "run"
+    cfg = config_from_dict({
+        "name": "serve-smoke",
+        "model": {
+            "arch": "mobilenet_v2", "num_classes": 4, "dropout": 0.0,
+            "block_specs": [{"t": 2, "c": 8, "n": 1, "s": 2}],
+        },
+        "data": {"dataset": "fake", "image_size": 24, "fake_train_size": 64, "fake_eval_size": 16},
+        "optim": {"optimizer": "sgd", "momentum": 0.9, "weight_decay": 1e-5},
+        "schedule": {"schedule": "constant", "base_lr": 0.01, "scale_by_batch": False, "warmup_epochs": 0.0},
+        "ema": {"enable": True, "decay": 0.9, "warmup": False},
+        "train": {
+            "batch_size": 32, "eval_batch_size": 16, "epochs": 1, "log_every": 10,
+            "compute_dtype": "float32", "log_dir": str(train_dir),
+        },
+        "dist": {"num_devices": 8},
+    })
+    cli_train.run(cfg)
+
+    serve_dir = tmp_path / "serving"
+    serve_cfg = config_from_dict({
+        "data": {"image_size": 24},
+        "train": {"log_dir": str(serve_dir)},
+        "serve": {
+            "export_from": str(train_dir / "ckpt"),
+            "bundle": str(tmp_path / "bundle"),
+            "buckets": [2, 8],
+            "max_batch": 8,
+            "max_wait_ms": 5.0,
+            "requests": 24,
+            "clients": 6,
+        },
+    })
+    result = cli_serve.run(serve_cfg)
+    assert result["bundle"] == str(tmp_path / "bundle")
+    assert result["completed"] == 24 and result["shed"] == 0
+    assert result["p99_ms"] >= result["p50_ms"] > 0
+    assert result["qps"] > 0
+
+    # the bundle is a valid folded artifact of the TRAINED (EMA) weights
+    bundle = load_bundle(str(tmp_path / "bundle"))
+    assert bundle.meta["ema"] is True and bundle.meta["step"] > 0
+    assert spec_is_inference(json.loads((tmp_path / "bundle" / "spec.json").read_text()))
+
+    # acceptance: queue-wait + run-latency histograms visible in the snapshot
+    snap = json.loads((serve_dir / "obs_registry.json").read_text())
+    assert snap["serve.queue_wait_seconds.count"] >= 24
+    assert snap["serve.run_seconds.count"] >= 1
+    assert snap["serve.exports"] >= 1
+    assert snap["serve.completed"] >= 24
+    # ≥ 2 buckets compiled (warmup) — both hit across the suite's traffic
+    assert snap["serve.compile_seconds.count"] >= 2
+
+    # scripts/obs_report.py renders serving runs too
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", os.path.join(os.path.dirname(os.path.dirname(__file__)), "scripts", "obs_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.summarize(str(serve_dir))
+    assert "## serving" in report
+    assert "queue wait" in report and "run latency" in report

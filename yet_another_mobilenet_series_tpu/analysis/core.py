@@ -133,9 +133,16 @@ class Project:
 
     @property
     def axis_constants(self) -> dict[str, str]:
-        """Module-level ``X_AXIS = "name"`` string constants across the
-        project (``parallel/mesh.py`` ``DATA_AXIS`` in production):
-        constant name -> axis name. Ground truth for YAMT003."""
+        """Known mesh axes across the project: constant name (or a synthetic
+        ``Mesh axis '...'`` key) -> axis name. Ground truth for YAMT003, from
+        two sources:
+
+        - module-level ``X_AXIS = "name"`` string constants
+          (``parallel/mesh.py`` ``DATA_AXIS`` in production);
+        - axis-name literals in ``Mesh(devices, ('a', 'b'))`` construction
+          calls (incl. the ``axis_names=`` keyword) — so a 2-D mesh whose
+          second axis never gets its own constant still validates.
+        """
         if self._axis_constants is None:
             consts: dict[str, str] = {}
             for src in self.files:
@@ -152,6 +159,21 @@ class Project:
                         and isinstance(node.value.value, str)
                     ):
                         consts[node.targets[0].id] = node.value.value
+                for node in ast.walk(src.tree):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    q = qualified_name(node.func, src.aliases) or ""
+                    if q.rsplit(".", 1)[-1] != "Mesh":
+                        continue
+                    axis_arg = node.args[1] if len(node.args) > 1 else next(
+                        (kw.value for kw in node.keywords if kw.arg == "axis_names"), None
+                    )
+                    if axis_arg is None:
+                        continue
+                    elts = axis_arg.elts if isinstance(axis_arg, (ast.Tuple, ast.List)) else [axis_arg]
+                    for el in elts:
+                        if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                            consts.setdefault(f"Mesh axis {el.value!r}", el.value)
             self._axis_constants = consts
         return self._axis_constants
 
@@ -187,7 +209,7 @@ def register(cls: type[Rule]) -> type[Rule]:
 def load_rules() -> list[Rule]:
     """Import every rule module (registration side effect) and return the
     registry sorted by id."""
-    from . import rules_config, rules_imports, rules_logging, rules_spmd, rules_tracing  # noqa: F401
+    from . import rules_config, rules_donation, rules_imports, rules_logging, rules_spmd, rules_tracing  # noqa: F401
 
     return [_REGISTRY[k] for k in sorted(_REGISTRY)]
 

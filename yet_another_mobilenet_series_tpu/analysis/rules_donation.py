@@ -1,0 +1,183 @@
+"""YAMT008 — donated-buffer reuse (the top ROADMAP-deferred lint rule).
+
+``jax.jit(f, donate_argnums=(0,))`` lets XLA overwrite the donated argument's
+buffer in place — after the call that buffer is DELETED, and any later read
+of the variable dies at runtime with "Array has been deleted" (or worse,
+only on the hardware where donation is actually implemented, so CPU tests
+pass and the TPU run dies). The live hazards this rule guards are
+cli/train.py's donated TrainState (``ts`` must be rebound by every dispatch)
+and the serving engine's donated input batch (serve/engine.py).
+
+Detection is intra-module and linear-flow, like the other rules: a name
+bound to ``jax.jit(...)``/``jax.pmap(...)`` with ``donate_argnums`` is a
+*donating function*; after a call ``f(a, b)`` passes variable ``a`` at a
+donated position, any read of ``a`` before a rebinding is flagged. The
+rebind-in-the-same-statement idiom (``ts, m = step(ts, batch)``) is clean by
+construction — the call marks the donation, the assignment targets clear it.
+Loop bodies are walked twice so a donation at the bottom of an iteration
+flags a read at the top of the next. Calls through attributes
+(``trainer.train_step``) and cross-module donating functions are not
+resolvable statically and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+
+_DONATING_WRAPPERS = {"jax.jit", "jax.pmap"}
+
+
+def _donated_indices(call: ast.Call) -> tuple[int, ...] | None:
+    """Static donate_argnums of a jax.jit/pmap call, or None if absent/dynamic."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int) for e in v.elts
+        ):
+            return tuple(e.value for e in v.elts)
+        return None  # computed donate_argnums: not statically checkable
+    return None
+
+
+@register
+class DonatedBufferReuse(Rule):
+    id = "YAMT008"
+    name = "donated-buffer-reuse"
+    description = (
+        "a variable read after being passed at a donated position of a "
+        "jit(..., donate_argnums=...) call: the buffer is deleted after dispatch "
+        "(runtime 'Array has been deleted', possibly only on hardware with real donation)"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        donors: dict[str, tuple[int, ...]] = {}
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and qualified_name(node.value.func, src.aliases) in _DONATING_WRAPPERS
+            ):
+                idx = _donated_indices(node.value)
+                if idx:
+                    donors[node.targets[0].id] = idx
+        if not donors:
+            return []
+        out: dict[tuple, Finding] = {}
+        scopes: list[ast.AST] = [src.tree]
+        scopes += [
+            n for n in ast.walk(src.tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            self._block(list(scope.body), {}, donors, src, out)
+        return list(out.values())
+
+    # -- statement walk (linear flow; branches merged by union) --------------
+
+    def _block(self, stmts, donated: dict[str, tuple[str, int]], donors, src, out):
+        for st in stmts:
+            self._stmt(st, donated, donors, src, out)
+
+    def _stmt(self, st, donated, donors, src, out):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope (closures over donated vars are out of scope)
+        if isinstance(st, ast.If):
+            self._expr(st.test, donated, donors, src, out)
+            b1, b2 = dict(donated), dict(donated)
+            self._block(st.body, b1, donors, src, out)
+            self._block(st.orelse, b2, donors, src, out)
+            donated.clear()
+            donated.update({**b1, **b2})  # union: donated on ANY path is a hazard
+        elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(st, ast.While):
+                self._expr(st.test, donated, donors, src, out)
+            else:
+                self._expr(st.iter, donated, donors, src, out)
+                self._clear_targets(st.target, donated)
+            # two passes: a donation at the bottom of the body reaches a read
+            # at the top of the next iteration (findings dedupe by location)
+            for _ in range(2):
+                self._block(st.body, donated, donors, src, out)
+            self._block(st.orelse, donated, donors, src, out)
+        elif isinstance(st, ast.Try):
+            branches = []
+            for block in (st.body, *[h.body for h in st.handlers], st.orelse):
+                b = dict(donated)
+                self._block(block, b, donors, src, out)
+                branches.append(b)
+            donated.clear()
+            for b in branches:
+                donated.update(b)
+            self._block(st.finalbody, donated, donors, src, out)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr, donated, donors, src, out)
+                if item.optional_vars is not None:
+                    self._clear_targets(item.optional_vars, donated)
+            self._block(st.body, donated, donors, src, out)
+        elif isinstance(st, ast.Assign):
+            self._expr(st.value, donated, donors, src, out)
+            for t in st.targets:
+                self._clear_targets(t, donated)
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            if st.value is not None:
+                self._expr(st.value, donated, donors, src, out)
+            if isinstance(st, ast.AugAssign):
+                # x += ... both reads and writes x
+                self._expr(st.target, donated, donors, src, out)
+            self._clear_targets(st.target, donated)
+        elif isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._clear_targets(t, donated)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, donated, donors, src, out)
+
+    def _clear_targets(self, target, donated):
+        if isinstance(target, ast.Name):
+            donated.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._clear_targets(el, donated)
+        elif isinstance(target, ast.Starred):
+            self._clear_targets(target.value, donated)
+
+    # -- expression walk -----------------------------------------------------
+
+    def _expr(self, expr, donated, donors, src, out):
+        if expr is None or isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Name) and isinstance(expr.ctx, ast.Load):
+            hit = donated.get(expr.id)
+            if hit is not None:
+                fn, line = hit
+                f = Finding(
+                    src.path, expr.lineno, expr.col_offset, self.id,
+                    f"'{expr.id}' read after being donated to '{fn}' (line {line}, "
+                    "jit donate_argnums): the buffer is deleted after dispatch — "
+                    "rebind the variable to the call's result or drop the donation",
+                )
+                out.setdefault((f.line, f.col, expr.id), f)
+            return
+        # children in evaluation order; a donating call marks its donated
+        # args only AFTER its own arguments were read (passing x twice in the
+        # same call is simultaneous, not read-after-donate)
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._expr(child, donated, donors, src, out)
+            elif isinstance(child, ast.keyword):
+                self._expr(child.value, donated, donors, src, out)
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+            idx = donors.get(expr.func.id)
+            if idx:
+                for i in idx:
+                    if i < len(expr.args) and isinstance(expr.args[i], ast.Name):
+                        donated[expr.args[i].id] = (expr.func.id, expr.lineno)

@@ -85,12 +85,16 @@ class CheckpointManager:
             meta = self._mgr.restore(step, args=ocp.args.Composite(meta=ocp.args.JsonRestore()))["meta"]
         return step, network_from_dict(meta["network"]), meta["extra"]
 
-    def restore_tree(self, step: int, abstract_tree):
+    def restore_tree(self, step: int, abstract_tree=None):
         """Phase 2: restore the pytree against an abstract target so optax
-        NamedTuple states and dtypes round-trip exactly."""
+        NamedTuple states and dtypes round-trip exactly. ``None`` restores
+        as-saved (plain nested dicts of host arrays) — the serving export
+        path (serve/export.py) reads weights without rebuilding an optimizer
+        skeleton."""
         with obs_trace.get_tracer().span("ckpt/restore_tree", "ckpt", step=int(step)):
+            restore_args = ocp.args.StandardRestore(abstract_tree) if abstract_tree is not None else ocp.args.StandardRestore()
             tree = self._mgr.restore(
-                step, args=ocp.args.Composite(tree=ocp.args.StandardRestore(abstract_tree))
+                step, args=ocp.args.Composite(tree=restore_args)
             )["tree"]
         get_registry().counter("ckpt.restores").inc()
         return tree

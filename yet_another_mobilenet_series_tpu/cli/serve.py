@@ -1,0 +1,172 @@
+"""Serving entry point — ``python -m yet_another_mobilenet_series_tpu.cli.serve
+app:<yaml> [key=value ...]`` (sibling of cli.train / cli.profile).
+
+Two phases, both optional, driven by the ``serve:`` config block:
+
+1. **export** (``serve.export_from`` set): checkpoint -> InferenceBundle at
+   ``serve.bundle`` — prune masks hard-applied, EMA weights selected, BN
+   folded into conv weights (serve/export.py).
+2. **serve** (``serve.requests`` > 0): load the bundle, AOT-warm the engine's
+   batch buckets, and drive a synthetic closed-loop load of
+   ``serve.requests`` single-image requests from ``serve.clients`` client
+   threads through the micro-batcher — the in-process stand-in for an RPC
+   front door, exercising the exact queue/coalesce/dispatch path one would
+   sit behind one. Prints p50/p99 end-to-end latency and QPS; with a
+   log_dir, metrics + obs_registry.json land where scripts/obs_report.py
+   reads them.
+
+``serve.requests=0`` with a bundle still warms up every bucket — a
+deploy-time smoke that the artifact compiles and serves shape-correctly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from ..config import Config, parse_cli
+from ..obs import registry as obs_registry
+from ..obs import trace as obs_trace
+from ..parallel import mesh as mesh_lib
+from ..serve.batcher import MicroBatcher, QueueFull
+from ..serve.engine import InferenceEngine
+from ..serve.export import export_checkpoint, load_bundle
+from ..utils.logging import Logger
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(round(q * (len(sorted_vals) - 1))), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def _drive_load(cfg: Config, batcher: MicroBatcher, image_size: int, log: Logger) -> dict:
+    """Closed-loop synthetic clients: each thread submits one request, waits
+    for its logits, repeats. Returns the latency/QPS summary."""
+    import threading
+
+    n_total = cfg.serve.requests
+    n_clients = max(1, cfg.serve.clients)
+    rng = np.random.RandomState(0)
+    image = rng.normal(0, 1, (image_size, image_size, 3)).astype(np.float32)
+    latencies: list[float] = []
+    errors = {"shed": 0, "rejected": 0}
+    lock = threading.Lock()
+    counter = {"left": n_total}
+
+    def client():
+        while True:
+            with lock:
+                if counter["left"] <= 0:
+                    return
+                counter["left"] -= 1
+            t0 = time.perf_counter()
+            try:
+                fut = batcher.submit(image, deadline_ms=cfg.serve.deadline_ms or None)
+                fut.result(timeout=60)
+            except QueueFull:
+                with lock:
+                    errors["rejected"] += 1
+                time.sleep(0.001)  # back off, as a real client would
+                continue
+            except Exception:  # noqa: BLE001 — shed/engine failure: count, keep driving
+                with lock:
+                    errors["shed"] += 1
+                continue
+            with lock:
+                latencies.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, daemon=True) for _ in range(n_clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    latencies.sort()
+    summary = {
+        "requests": n_total,
+        "completed": len(latencies),
+        "shed": errors["shed"],
+        "rejected_full": errors["rejected"],
+        "wall_s": wall,
+        "qps": len(latencies) / wall if wall > 0 else 0.0,
+        "p50_ms": _percentile(latencies, 0.50) * 1e3,
+        "p99_ms": _percentile(latencies, 0.99) * 1e3,
+    }
+    log.log(
+        f"load: {summary['completed']}/{n_total} ok ({summary['shed']} shed, "
+        f"{summary['rejected_full']} rejected), {summary['qps']:.1f} qps, "
+        f"p50 {summary['p50_ms']:.2f} ms, p99 {summary['p99_ms']:.2f} ms"
+    )
+    return summary
+
+
+def run(cfg: Config) -> dict:
+    is_coord = mesh_lib.is_coordinator()
+    log = Logger(cfg.train.log_dir, enabled=is_coord, tensorboard=False)
+    reg = obs_registry.get_registry()
+    log.set_registry(reg)
+    tracer = obs_trace.configure(enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size)
+    result: dict = {}
+    try:
+        bundle_dir = cfg.serve.bundle
+        if cfg.serve.export_from:
+            if not bundle_dir:
+                bundle_dir = os.path.join(cfg.train.log_dir, "bundle")
+            export_checkpoint(cfg.serve.export_from, bundle_dir, use_ema=cfg.serve.use_ema)
+            log.log(f"exported {cfg.serve.export_from} -> {bundle_dir}")
+            result["bundle"] = bundle_dir
+        if not bundle_dir:
+            raise ValueError("serve: needs serve.bundle and/or serve.export_from")
+
+        bundle = load_bundle(bundle_dir)
+        mesh = mesh_lib.make_mesh(cfg.dist.num_devices) if cfg.serve.data_parallel else None
+        engine = InferenceEngine(
+            bundle,
+            buckets=cfg.serve.buckets,
+            compute_dtype=cfg.serve.compute_dtype,
+            mesh=mesh,
+            donate_input=cfg.serve.donate_input,
+            image_size=cfg.data.image_size,
+        )
+        if cfg.serve.warmup:
+            t0 = time.perf_counter()
+            engine.warmup()
+            log.log(f"warmup: compiled buckets {engine.buckets} in {time.perf_counter() - t0:.1f}s")
+        if cfg.serve.requests > 0:
+            batcher = MicroBatcher(
+                engine.predict,
+                max_batch=cfg.serve.max_batch,
+                max_wait_ms=cfg.serve.max_wait_ms,
+                queue_depth=cfg.serve.queue_depth,
+                default_deadline_ms=cfg.serve.deadline_ms,
+            ).start()
+            try:
+                result.update(_drive_load(cfg, batcher, cfg.data.image_size, log))
+            finally:
+                batcher.stop()
+        return result
+    finally:
+        if tracer.enabled and cfg.train.log_dir and is_coord:
+            path = tracer.write(os.path.join(cfg.train.log_dir, "obs_trace.json"))
+            log.log(f"span trace -> {path}")
+        if is_coord and cfg.train.log_dir:
+            os.makedirs(cfg.train.log_dir, exist_ok=True)
+            with open(os.path.join(cfg.train.log_dir, "obs_registry.json"), "w") as f:
+                json.dump(reg.snapshot(), f, indent=1, sort_keys=True)
+        log.close()
+
+
+def main(argv=None):
+    cfg = parse_cli(sys.argv[1:] if argv is None else argv)
+    return run(cfg)
+
+
+if __name__ == "__main__":
+    main()

@@ -333,6 +333,43 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Inference serving (serve/, docs/SERVING.md): export a checkpoint to a
+    folded InferenceBundle and/or serve a bundle through the AOT-batched
+    engine + micro-batcher via cli/serve.py."""
+
+    # checkpoint directory to export (e.g. <log_dir>/ckpt); "" = serve only
+    export_from: str = ""
+    # bundle directory: export target and/or serving source
+    bundle: str = ""
+    # export the EMA shadow weights when the checkpoint has them (eval-on-
+    # shadow semantics); falls back to live weights when EMA was off
+    use_ema: bool = True
+    # batch-shape ladder: each request batch pads up to the smallest bucket
+    # that fits; every bucket is AOT-compiled at startup (engine warmup)
+    buckets: Sequence[int] = (1, 8, 32)
+    # micro-batcher: coalesce up to max_batch images or max_wait_ms linger
+    max_batch: int = 32
+    max_wait_ms: float = 2.0
+    # bounded request queue (backpressure: submit rejects when full)
+    queue_depth: int = 256
+    # per-request deadline; queued-past-deadline requests are shed. 0 = none
+    deadline_ms: float = 0.0
+    # AOT-precompile every bucket before accepting traffic
+    warmup: bool = True
+    # shard each bucket over the data mesh (buckets must divide device count)
+    data_parallel: bool = False
+    # donate the padded input buffer to the compiled program (serve/engine.py)
+    donate_input: bool = True
+    # conv/matmul compute dtype for the serving forward
+    compute_dtype: str = "float32"
+    # cli/serve.py synthetic load: total requests (0 = export/warmup only)
+    # and the number of concurrent client threads driving them
+    requests: int = 0
+    clients: int = 4
+
+
+@dataclass(frozen=True)
 class DistConfig:
     # number of data-parallel shards; 0 = use all visible devices
     num_devices: int = 0
@@ -356,6 +393,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     dist: DistConfig = field(default_factory=DistConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
 
 # ---------------------------------------------------------------------------
@@ -396,6 +434,7 @@ _SECTION_TYPES = {
     "TrainConfig": TrainConfig,
     "DistConfig": DistConfig,
     "ObsConfig": ObsConfig,
+    "ServeConfig": ServeConfig,
     "Config": Config,
 }
 

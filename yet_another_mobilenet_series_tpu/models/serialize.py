@@ -16,7 +16,18 @@ from ..ops.blocks import ConvBNAct, InvertedResidual
 from ..ops.layers import Dense
 from .specs import Network
 
-_SCHEMA_VERSION = 1
+# v2 adds the ``inference`` marker: True means the weight tree next to the
+# spec is a FOLDED serving artifact (BN running stats + affine baked into the
+# adjacent conv weights, serve/export.py) and must never be resumed into
+# training. v1 dicts (no marker) keep loading — every pre-serving checkpoint
+# sidecar and searched_arch.json in the wild is v1.
+_SCHEMA_VERSION = 2
+
+
+def spec_is_inference(d: dict[str, Any]) -> bool:
+    """True when ``d`` (a network_to_dict payload) marks a folded serving
+    bundle. v1 payloads predate serving and are always training-shaped."""
+    return bool(d.get("inference", False))
 
 
 def _conv_bn_act_to_dict(s: ConvBNAct) -> dict:
@@ -57,9 +68,10 @@ def _dense_to_dict(d: Dense) -> dict:
     return {"in_features": d.in_features, "out_features": d.out_features, "use_bias": d.use_bias, "init_std": d.init_std}
 
 
-def network_to_dict(net: Network) -> dict[str, Any]:
+def network_to_dict(net: Network, *, inference: bool = False) -> dict[str, Any]:
     return {
         "schema": _SCHEMA_VERSION,
+        "inference": inference,
         "stem": _conv_bn_act_to_dict(net.stem),
         "blocks": [_block_to_dict(b) for b in net.blocks],
         "head": _conv_bn_act_to_dict(net.head) if net.head is not None else None,
@@ -72,7 +84,9 @@ def network_to_dict(net: Network) -> dict[str, Any]:
 
 
 def network_from_dict(d: dict[str, Any]) -> Network:
-    if d.get("schema") != _SCHEMA_VERSION:
+    # v1 payloads are a strict subset of v2 (no "inference" marker): the spec
+    # fields are identical, so the read path accepts both.
+    if d.get("schema") not in (1, _SCHEMA_VERSION):
         raise ValueError(f"unsupported network schema {d.get('schema')!r}")
 
     def _blk(bd):

@@ -4,6 +4,9 @@ The reference rebuilds conv/BN tensors with fewer channels mid-training
 (SURVEY.md §3.2 "this CHANGES PARAMETER SHAPES mid-training"); here the same
 surgery happens at a coarse cadence (cfg.prune.remat_epochs), paying one
 re-jit to convert masked (effective) FLOPs into real FLOPs and step time.
+The serving export (serve/export.py) reuses the same surgery to hard-apply a
+checkpoint's live masks before folding BN — a deployed bundle never pays
+masked-supernet FLOPs.
 
 Surgery per block, given its keep-set of expanded channels:
 - expand conv columns, expand/dw BN rows, per-branch depthwise kernels,

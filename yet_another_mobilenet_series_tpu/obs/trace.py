@@ -15,7 +15,8 @@ multi-day run keeps the last N spans, never unbounded memory. Completed
 spans are plain tuples; JSON rendering happens only at ``write()``.
 
 Categories are load-bearing (docs/OBSERVABILITY.md span taxonomy): ``data``,
-``dispatch``, ``sync``, ``prune``, ``eval``, ``ckpt``, ``rebuild``.
+``dispatch``, ``sync``, ``prune``, ``eval``, ``ckpt``, ``rebuild``,
+``serve`` (docs/SERVING.md).
 """
 
 from __future__ import annotations

@@ -409,6 +409,15 @@ class Dense:
 # ---------------------------------------------------------------------------
 
 
+def bn_scale_shift(gamma, beta, mean, var, eps: float = 1e-5):
+    """Eval-mode BN collapsed to a per-channel affine: scale = gamma *
+    rsqrt(var + eps), shift = beta - mean * scale — the single source of the
+    fold used by the Pallas eval kernel (ops/pallas_kernels.fold_bn) and the
+    serving weight transform (serve/export.py), so the two can never drift."""
+    scale = gamma * lax.rsqrt(var + eps)
+    return scale, beta - mean * scale
+
+
 def global_avg_pool(x: Array, keepdims: bool = False) -> Array:
     """Mean over H,W. Computed in float32 (bf16 accumulation over 49+ terms
     loses precision that measurably hurts SE gates and the head)."""

@@ -192,5 +192,6 @@ fused_depthwise_inference.defvjp(_vjp_fwd, _vjp_bwd)
 
 def fold_bn(gamma, beta, mean, var, eps: float = 1e-5):
     """BN eval affine folded to (scale, shift) for the fused kernel."""
-    scale = gamma * jax.lax.rsqrt(var + eps)
-    return scale, beta - mean * scale
+    from .layers import bn_scale_shift
+
+    return bn_scale_shift(gamma, beta, mean, var, eps)

@@ -5,6 +5,8 @@ The reference's NCCL process-group world becomes a single SPMD program over a
 1-D ``('data',)`` mesh: gradient allreduce and SyncBN moments ride ICI inside
 the compiled step (SURVEY.md §5 "distributed communication backend"); DCN is
 only involved across slices, handled transparently by the same collectives.
+The serving engine (serve/engine.py) rides the same mesh for data-parallel
+inference: params replicated, batch buckets sharded on 'data'.
 """
 
 from __future__ import annotations

@@ -1,0 +1,28 @@
+"""Serving subsystem: pruned-model export + AOT-batched inference engine.
+
+The training stack ends at a checkpoint; this package turns that checkpoint
+into a deployable artifact and serves it (the "serves heavy traffic" half of
+the ROADMAP north star, and the LANA/Kernel-Looping argument from PAPERS.md:
+peak inference wants a dedicated representation + dispatch layer, not the
+training graph re-run with train=False):
+
+- :mod:`.export` — hard-apply prune masks (nas/rematerialize surgery),
+  select EMA weights, FOLD BatchNorm running stats + affine into the
+  adjacent conv weights (a real weight transform), and emit an
+  ``InferenceBundle`` (spec JSON via models/serialize schema v2 + npz
+  weights) — plus the folded forward pass the engine runs.
+- :mod:`.engine` — bucketed batch shapes with pad-and-slice dispatch to an
+  AOT-compiled per-bucket executable cache, warmup precompile, input-buffer
+  donation, optional data-parallel sharding over parallel/mesh.
+- :mod:`.batcher` — thread-based micro-batching request queue: coalesce up
+  to ``max_batch`` or ``max_wait_ms``, bounded queue for backpressure,
+  per-request deadlines with timeout shedding.
+
+Everything is instrumented through obs/ (``serve/*`` spans, queue-wait and
+run-latency histograms, request/shed counters), so scripts/obs_report.py
+renders serving runs exactly like training runs. docs/SERVING.md is the
+operator guide; ``cli/serve.py`` + the ``serve:`` config block are the entry
+point.
+"""
+
+from .export import InferenceBundle, apply_folded, export_bundle, fold_network, load_bundle  # noqa: F401

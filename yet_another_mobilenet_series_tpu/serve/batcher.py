@@ -1,0 +1,178 @@
+"""Thread-based micro-batching request queue in front of the engine.
+
+Single-image requests are latency-cheap but throughput-poisonous: the chip
+is happiest at the biggest bucket. The batcher coalesces concurrent
+requests into engine batches — up to ``max_batch`` images or ``max_wait_ms``
+of linger, whichever first — on a dedicated dispatch thread, so clients see
+a Future and the engine sees full buckets.
+
+Overload behavior is explicit, not emergent:
+
+- **backpressure**: the queue is bounded (``queue_depth``); a full queue
+  rejects ``submit`` with :class:`QueueFull` immediately instead of growing
+  an unbounded latency tail.
+- **timeout shedding**: a request carrying a deadline that expires while
+  still queued is dropped with :class:`DeadlineExceeded` set on its Future —
+  the engine never burns a bucket slot on an answer nobody is waiting for.
+
+Instrumentation (obs/): ``serve.queue_wait_seconds`` (enqueue -> dispatch),
+``serve.batch_size`` histograms, ``serve.requests`` / ``serve.completed`` /
+``serve.shed_deadline`` / ``serve.rejected_full`` counters — all in the same
+registry every scalars row and obs_registry.json snapshot carries.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable
+
+import numpy as np
+
+from ..obs.registry import get_registry
+
+
+class QueueFull(RuntimeError):
+    """submit() rejected: the bounded request queue is at queue_depth."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired while it was still queued."""
+
+
+class _Request:
+    __slots__ = ("image", "future", "t_enqueue", "t_deadline")
+
+    def __init__(self, image: np.ndarray, deadline_s: float | None):
+        self.image = image
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+        self.t_deadline = None if deadline_s is None else self.t_enqueue + deadline_s
+
+
+class MicroBatcher:
+    """Coalesces submit()ted images into predict_fn batches on a worker
+    thread. ``predict_fn(images) -> logits`` is typically
+    :meth:`serve.engine.InferenceEngine.predict`."""
+
+    def __init__(
+        self,
+        predict_fn: Callable[[np.ndarray], np.ndarray],
+        *,
+        max_batch: int = 32,
+        max_wait_ms: float = 2.0,
+        queue_depth: int = 256,
+        default_deadline_ms: float = 0.0,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_wait_ms < 0:
+            raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self._predict = predict_fn
+        self._max_batch = max_batch
+        self._max_wait_s = max_wait_ms / 1e3
+        self._default_deadline_s = default_deadline_ms / 1e3 if default_deadline_ms > 0 else None
+        self._q: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._reg = get_registry()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            raise RuntimeError("batcher already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, name="serve-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the dispatch thread. ``drain=True`` serves what is already
+        queued first; False fails pending requests immediately."""
+        if self._thread is None:
+            return
+        if not drain:
+            self._fail_queued(RuntimeError("batcher stopped"))
+        self._stop.set()
+        self._thread.join()
+        self._thread = None
+        self._fail_queued(RuntimeError("batcher stopped"))
+
+    def _fail_queued(self, exc: Exception) -> None:
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            req.future.set_exception(exc)
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, image: np.ndarray, *, deadline_ms: float | None = None) -> Future:
+        """Enqueue one (H, W, 3) image; returns a Future resolving to its
+        logits row. Raises :class:`QueueFull` when the bounded queue is at
+        capacity (the caller's backpressure signal)."""
+        if self._thread is None:
+            raise RuntimeError("batcher not started")
+        deadline_s = deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
+        req = _Request(np.asarray(image, np.float32), deadline_s)
+        self._reg.counter("serve.requests").inc()
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            self._reg.counter("serve.rejected_full").inc()
+            raise QueueFull(f"request queue at capacity ({self._q.maxsize})") from None
+        return req.future
+
+    # -- dispatch thread ----------------------------------------------------
+
+    def _collect(self) -> list[_Request]:
+        """Block for the first request, then linger up to max_wait_s (or
+        until max_batch) for companions."""
+        try:
+            first = self._q.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        t_close = time.perf_counter() + self._max_wait_s
+        while len(batch) < self._max_batch:
+            remaining = t_close - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                batch.append(self._q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
+    def _loop(self) -> None:
+        while not (self._stop.is_set() and self._q.empty()):
+            batch = self._collect()
+            if not batch:
+                continue
+            now = time.perf_counter()
+            live: list[_Request] = []
+            for req in batch:
+                if req.t_deadline is not None and now > req.t_deadline:
+                    self._reg.counter("serve.shed_deadline").inc()
+                    req.future.set_exception(
+                        DeadlineExceeded(f"queued {now - req.t_enqueue:.3f}s past deadline")
+                    )
+                else:
+                    self._reg.histogram("serve.queue_wait_seconds").observe(now - req.t_enqueue)
+                    live.append(req)
+            if not live:
+                continue
+            self._reg.histogram("serve.batch_size").observe(len(live))
+            try:
+                logits = self._predict(np.stack([r.image for r in live]))
+            except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
+                for req in live:
+                    req.future.set_exception(e)
+                continue
+            for req, row in zip(live, logits):
+                req.future.set_result(row)
+            self._reg.counter("serve.completed").inc(len(live))
