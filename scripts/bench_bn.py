@@ -124,7 +124,12 @@ def run_sweep(args) -> None:
             continue
         cmd = [sys.executable, os.path.abspath(__file__), "--variants", token,
                "--batch", str(args.batch), "--iters", str(args.iters),
-               "--image-size", str(args.image_size), "--out", tmp_out]
+               "--image-size", str(args.image_size), "--out", tmp_out,
+               # label the child artifact as what it IS: a flag-set child of
+               # the XLA sweep, not a variant A/B — tooling that globs
+               # BENCH_*.json must not misparse a leftover intermediate
+               # (ADVICE r5 low)
+               "--bench-label", "xla_flags_sweep_child"]
         if args.cpu:
             cmd.append("--cpu")
         log(f"sweep: flags {fs!r} starting")
@@ -192,6 +197,10 @@ def main():
                          "'' (the no-flags baseline) is always run first")
     ap.add_argument("--child-timeout", type=int, default=1500,
                     help="per-flag-set child budget in --xla-flags-sweep")
+    ap.add_argument("--bench-label", default="bn_mode_train_step_ab",
+                    help="'bench' field written into the artifact; the sweep "
+                         "supervisor sets xla_flags_sweep_child on its children "
+                         "so intermediates can't be mistaken for a variant A/B")
     ap.add_argument(
         "--variants",
         default="exact:0,folded:0,compute:0,fused_vjp:0,sdot:0,compute_sdot:0,exact:full,exact:save_conv,compute:save_conv,exact:0:dot,sdot:0:dot",
@@ -259,7 +268,7 @@ def main():
             if base:
                 r["vs_exact"] = round(base["ms_per_step"] / r["ms_per_step"], 3)
         out = {
-            "bench": "bn_mode_train_step_ab", "platform": platform, "device_kind": kind,
+            "bench": args.bench_label, "platform": platform, "device_kind": kind,
             "batch": args.batch, "image_size": args.image_size, "iters": args.iters,
             "dtype": "bfloat16",
             "variants_completed": len(rows),

@@ -1,25 +1,46 @@
 #!/usr/bin/env bash
-# yamt-lint over the package, JSON report, nonzero exit on any finding.
+# yamt-lint over the package (all rules) and scripts/ (curated subset),
+# nonzero exit on any finding.
 #
-# The same check the tier-1 gate runs (tests/test_lint_clean.py), packaged
+# The same checks the tier-1 gate runs (tests/test_lint_clean.py), packaged
 # for CI / pre-commit: machine-readable output on stdout, findings count on
-# stderr. Usage: scripts/lint.sh [extra paths...]
+# stderr. Usage:
+#   scripts/lint.sh [--format json|text|github] [extra paths...]
+# --format github emits ::error workflow annotations so a GitHub Actions run
+# marks the offending lines in the PR diff (analysis/reporters.py).
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
+FORMAT=json
+if [ "${1:-}" = "--format" ]; then
+    FORMAT="$2"
+    shift 2
+fi
+
+# the curated scripts/ subset mirrors tests/test_lint_clean.py SCRIPT_RULES:
+# PRNG discipline + version-fragile imports apply to standalone scripts,
+# package-convention rules do not
+SCRIPT_RULES="YAMT002,YAMT006"
+
 # the analyzer is pure AST — it never executes package code, so no
 # accelerator/platform setup is needed
-out=$(python -m yet_another_mobilenet_series_tpu.analysis --format json \
+rc=0
+out=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
     yet_another_mobilenet_series_tpu/ "$@") || rc=$?
 echo "$out"
-if [ "${rc:-0}" -ne 0 ]; then
-    count=$(echo "$out" | python -c 'import json, sys
-try:
-    print(json.load(sys.stdin)["count"])
-except Exception:
-    print("?")')
+rc2=0
+out2=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
+    --select "$SCRIPT_RULES" scripts/) || rc2=$?
+echo "$out2"
+if [ "$rc" -ne 0 ] || [ "$rc2" -ne 0 ]; then
+    if [ "$FORMAT" = json ]; then
+        count=$(printf '%s\n%s\n' "$out" "$out2" \
+            | grep -o '"count": [0-9]*' | awk '{s+=$2} END {print s}')
+    else
+        count="?"
+    fi
     echo "yamt-lint: ${count} finding(s) — see docs/LINT.md" >&2
-    exit "${rc:-1}"
+    exit 1
 fi
 echo "yamt-lint: clean" >&2

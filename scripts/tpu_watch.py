@@ -201,6 +201,21 @@ def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
     negative, not silence. Pure host-side JSON work — safe to re-run."""
     with open(ab_path) as f:
         ab = json.load(f)
+    if ab.get("contention_invalidated"):
+        # ADVICE r5: an A/B measured under host contention (e.g. the r5
+        # 623ms-vs-36ms baseline skew) must never steer an adoption — its
+        # ratios compare incomparable regimes. Record the refusal.
+        decision = {
+            "rule": "contention_invalidated artifact: adoption refused",
+            "ab_source": os.path.basename(ab_path),
+            "contention_note": ab.get("contention_note"),
+            "baseline": None, "winner": None, "adopted": False,
+        }
+        with open(decision_path, "w") as f:
+            json.dump(decision, f, indent=1)
+            f.write("\n")
+        _drop_stale_ab_tuning("A/B artifact is contention-invalidated")
+        return
     rows = ab.get("rows", [])
     base = next((r for r in rows if r["bn_mode"] == "exact" and r["remat"] == "off"
                  and not r["conv1x1_dot"]), None)
@@ -245,6 +260,10 @@ def decide(ab_path: str, decision_path: str, allow_compute: bool) -> None:
                 decision["provisional"] = provisional
             tuning = _read_tuning()  # preserve sweep-owned flags keys
             tuning.pop("provisional", None)  # stale marker from an earlier win
+            # a fresh clean-window adoption supersedes an earlier
+            # contention-invalidated one: drop the stale warning keys
+            tuning.pop("contention_invalidated", None)
+            tuning.pop("contention_note", None)
             tuning.update({
                 "bn_mode": best["bn_mode"],
                 "remat": best["remat"] != "off",

@@ -1,0 +1,1 @@
+"""Fixture package for call-graph resolution tests (tests/test_lint_callgraph.py)."""

@@ -1,0 +1,33 @@
+"""Call shapes the graph must pin: direct, aliased, instance-method,
+self-attr, factory-result, and an unresolvable dynamic call."""
+
+from . import core as eng
+from .core import helper as h2
+
+
+def direct(x):
+    return h2(x)
+
+
+def via_module(x):
+    return eng.helper(x)
+
+
+def via_instance(x):
+    trainer = eng.Trainer()
+    return trainer.train_step(x)
+
+
+def via_self_attr(x):
+    trainer = eng.Trainer()
+    return trainer._fn(x)
+
+
+def via_factory(x):
+    step = eng.make_step(2)
+    return step(x)
+
+
+def dynamic(x, name):
+    fn = getattr(eng, name)
+    return fn(x)

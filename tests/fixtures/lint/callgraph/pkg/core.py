@@ -1,0 +1,21 @@
+"""Callees for the resolution fixtures: a function, a class with a method
+and a self-attr callable, and a factory returning a local def."""
+
+
+def helper(x):
+    return x + 1
+
+
+class Trainer:
+    def __init__(self):
+        self._fn = helper
+
+    def train_step(self, ts):
+        return helper(ts)
+
+
+def make_step(scale):
+    def step(x):
+        return x * scale
+
+    return step

@@ -1,0 +1,29 @@
+"""YAMT001 must flag: a host effect reached THROUGH a resolved call.
+
+Pre-interprocedural, the rule stopped at the call boundary: `helper` is not
+itself decorated or registered, so its `time.time()` was invisible even
+though `stepfn` executes it under trace every compile.
+"""
+
+import time
+
+import jax
+
+
+def helper(x):
+    t = time.time()  # runs at trace time only, baked in as a constant
+    return x * t
+
+
+@jax.jit
+def stepfn(x):
+    return helper(x)
+
+
+class Stepper:
+    def run(self, x):
+        return print("step", x)  # host print, reached via jax.jit(obj.method)
+
+
+def build(stepper: Stepper):
+    return jax.jit(stepper.run)  # attribute-call registration, now resolved

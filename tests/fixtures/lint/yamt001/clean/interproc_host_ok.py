@@ -1,0 +1,30 @@
+"""YAMT001 must stay silent: host effects only on host-side paths.
+
+A helper that prints is fine when nothing traced ever calls it — the
+interprocedural follow must not smear traced-ness onto build-time code.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def report(label, value):
+    print(label, value)  # host-side logging, never reached under trace
+
+
+def pure_helper(x):
+    return jnp.tanh(x)
+
+
+@jax.jit
+def stepfn(x):
+    return pure_helper(x)  # followed, and clean
+
+
+def main(xs):
+    t0 = time.time()
+    out = stepfn(xs)
+    report("elapsed", time.time() - t0)
+    return out

@@ -1,0 +1,38 @@
+"""YAMT008 must flag: donation through shapes only the call graph can see.
+
+The `trainer.train_step` attribute call was the documented blind spot of the
+intra-module rule ("attribute calls remain out of static reach" —
+ROADMAP.md); the factory-result donor is the live cli/train.py shape
+(`make_dp_train_step` returns `jax.jit(fn, donate_argnums=(0,))`).
+"""
+
+import jax
+
+
+def _step(s, b):
+    return s + b
+
+
+class Trainer:
+    def __init__(self):
+        self.train_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(state, batches):
+    trainer = Trainer()
+    total = None
+    for b in batches:
+        new_state = trainer.train_step(state, b)  # donates `state`...
+        total = state if total is None else total + state  # ...then reads it
+        state = new_state
+    return state, total
+
+
+def make_step():
+    return jax.jit(_step, donate_argnums=(0,))
+
+
+def factory_result_donor(state, b):
+    step = make_step()  # the summary records the returned jit's donation
+    out = step(state, b)
+    return out + state  # read after the donated dispatch

@@ -1,0 +1,41 @@
+"""YAMT008 must stay silent: resolved attribute calls with the rebind idiom,
+and OPAQUE attribute calls (an unannotated parameter) that must never be
+guessed into a donation."""
+
+import jax
+
+
+def _step(s, b):
+    return s + b
+
+
+class Trainer:
+    def __init__(self):
+        self.train_step = jax.jit(_step, donate_argnums=(0,))
+        self.eval_step = jax.jit(_step)  # no donation
+
+
+def train(state, batches):
+    trainer = Trainer()
+    for b in batches:
+        state = trainer.train_step(state, b)  # rebound by the same statement
+    return state
+
+
+def evals(state, batches):
+    trainer = Trainer()
+    total = 0.0
+    for b in batches:
+        m = trainer.eval_step(state, b)
+        total = total + m + 0 * state  # eval_step does not donate: reads stay legal
+    return total
+
+
+def opaque_loop(runner, state, batches):
+    # `runner` is an unannotated parameter: the call graph degrades to
+    # opaque and the rule must not invent a donation
+    out = None
+    for b in batches:
+        out = runner.train_step(state, b)
+        out = out + state
+    return out

@@ -1,0 +1,52 @@
+"""YAMT009 must flag: static-position hazards and per-call-varying closures."""
+
+import functools
+
+import jax
+
+
+class Cfg:
+    def __init__(self, depth):
+        self.depth = depth
+
+
+def f(x, y, opts):
+    return x + y
+
+
+step = jax.jit(f, static_argnums=(2,))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def g(x, *, cfg):
+    return x * 2
+
+
+def run(x, y):
+    a = step(x, y, [1, 2])  # unhashable literal at a static position
+    b = g(x, cfg=Cfg(3))  # fresh object identity every call: recompiles per step
+    c = step(x, y, dict(mode=1))  # dict() builder: unhashable, fresh each call
+    return a + b + c
+
+
+def loop(xs):
+    total = 0.0
+    for scale in range(3):
+        @jax.jit
+        def scaled(v):
+            return v * scale  # closure over the loop variable: re-jit per iteration
+
+        total = total + scaled(xs)
+    return total
+
+
+def stale(xs):
+    counter = 0
+
+    @jax.jit
+    def stepper(v):
+        return v + counter  # baked at trace time...
+
+    out = stepper(xs)
+    counter = counter + 1  # ...then varied per call: stale constant / recompile
+    return out, stepper(xs)

@@ -1,0 +1,54 @@
+"""YAMT009 must stay silent: hashable statics, build-time-only rebinds."""
+
+import functools
+
+import jax
+
+
+def f(x, y, opts):
+    return x + y
+
+
+step = jax.jit(f, static_argnums=(2,))
+
+
+@functools.partial(jax.jit, static_argnames=("act",))
+def g(x, *, act):
+    return x * 2
+
+
+def run(x, y, mode):
+    a = step(x, y, 4)  # int: hashable, cache-stable
+    b = g(x, act="relu")  # string static: fine
+    c = step(x, y, mode)  # a runtime name: hashability is the caller's contract
+    d = step(x, y, tuple(range(3)))  # tuple() hashes by value
+    return a + b + c + d
+
+
+def make_step(cfg, use_remat):
+    def fwd(v):
+        return v * cfg
+
+    if use_remat:
+        # rebinding BEFORE the jit exists is build-time setup (the
+        # forward = jax.checkpoint(forward) idiom in train/steps.py)
+        fwd = jax.checkpoint(fwd)
+
+    @jax.jit
+    def stepper(v):
+        return fwd(v)
+
+    return stepper
+
+
+def loop_without_capture(xs):
+    # building a jitted fn inside a loop is fine when it does NOT read the
+    # loop variable (the value rides in as a traced argument)
+    total = 0.0
+    for scale in range(3):
+        @jax.jit
+        def scaled(v, s):
+            return v * s
+
+        total = total + scaled(xs, scale)
+    return total

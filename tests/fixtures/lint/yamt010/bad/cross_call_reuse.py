@@ -1,0 +1,35 @@
+"""YAMT010 must flag: one key passed whole to two key-consuming callees."""
+
+import jax
+
+
+def init_params(rng):
+    return jax.random.normal(rng, (4,))
+
+
+def sample_noise(rng):
+    return jax.random.uniform(rng, (2,))
+
+
+def derive(rng):
+    # split/fold_in consumption counts too: two callees splitting the SAME
+    # key derive the same subkey streams
+    return jax.random.split(rng, 2)
+
+
+class Net:
+    def init(self, rng):
+        return jax.random.normal(rng, (4,))
+
+
+def build(rng):
+    params = init_params(rng)
+    noise = sample_noise(rng)  # same key, second consuming callee
+    return params, noise
+
+
+def build_via_method(rng):
+    net = Net()
+    w = net.init(rng)  # method on a locally-constructed instance consumes...
+    keys = derive(rng)  # ...and the same key is then split by another callee
+    return w, keys

@@ -1,0 +1,139 @@
+"""Resolution tests for the interprocedural layer (analysis/symbols.py,
+analysis/callgraph.py, analysis/summaries.py).
+
+Pins the call shapes the graph must resolve — direct calls, aliased imports,
+method calls on locally-constructed instances, self-attr callables, factory
+results — and the one it must NOT: a dynamic ``getattr`` call degrades to
+opaque (None), never to a crash or a guess. The summary fixpoint is pinned
+on the same fixture package plus a synthetic PRNG/donation module.
+"""
+
+import ast
+import pathlib
+
+import pytest
+
+from yet_another_mobilenet_series_tpu.analysis.core import Project, SourceFile, collect_paths
+from yet_another_mobilenet_series_tpu.analysis.summaries import summary_for_target
+
+FIXTURE = pathlib.Path(__file__).parent / "fixtures" / "lint" / "callgraph"
+
+
+def _project(paths):
+    py, yml = collect_paths([str(p) for p in paths])
+    files = []
+    for p in py:
+        with open(p, encoding="utf-8") as f:
+            files.append(SourceFile(p, f.read()))
+    return Project(files, yml)
+
+
+@pytest.fixture(scope="module")
+def project():
+    return _project([FIXTURE])
+
+
+def _app_src(project):
+    return next(s for s in project.files if s.path.endswith("app.py"))
+
+
+def _call_in(project, src, fn_name):
+    """The single Call expression in the fixture function's return statement."""
+    fn = next(
+        n for n in ast.walk(src.tree) if isinstance(n, ast.FunctionDef) and n.name == fn_name
+    )
+    calls = [n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+    # the LAST call lexically is the one under test (constructors come first)
+    call = calls[-1]
+    return call, fn
+
+
+@pytest.mark.parametrize(
+    "fn_name, expect_qualname, expect_bound",
+    [
+        ("direct", "pkg.core.helper", False),  # from .core import helper as h2
+        ("via_module", "pkg.core.helper", False),  # from . import core as eng
+        ("via_instance", "pkg.core.Trainer.train_step", True),  # local Trainer()
+        ("via_self_attr", "pkg.core.helper", False),  # self._fn = helper
+        ("via_factory", "pkg.core.make_step.step", False),  # returned local def
+    ],
+)
+def test_resolves(project, fn_name, expect_qualname, expect_bound):
+    src = _app_src(project)
+    call, fn = _call_in(project, src, fn_name)
+    target = project.callgraph.resolve_call(src, call, fn)
+    assert target is not None, f"{fn_name}: expected a resolution, got opaque"
+    assert target.kind == "function"
+    assert target.func.qualname == expect_qualname
+    assert target.bound == expect_bound
+
+
+def test_dynamic_call_degrades_to_opaque(project):
+    src = _app_src(project)
+    call, fn = _call_in(project, src, "dynamic")
+    assert project.callgraph.resolve_call(src, call, fn) is None
+
+
+def test_fixture_package_lints_clean(project):
+    # resolution over the fixture package must neither crash nor flag
+    from yet_another_mobilenet_series_tpu import analysis
+
+    assert analysis.run_lint([FIXTURE]) == []
+
+
+def test_symbol_table_module_names(project):
+    names = set(project.symbols.modules)
+    assert {"pkg", "pkg.core", "pkg.app"} <= names
+
+
+# -- dataflow summaries -----------------------------------------------------
+
+
+def test_summaries_key_and_donation(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import jax\n"
+        "\n"
+        "def consume(rng):\n"
+        "    return jax.random.normal(rng, (2,))\n"
+        "\n"
+        "def forwards(k):\n"
+        "    return consume(k)\n"  # transitive key consumption
+        "\n"
+        "def make_step():\n"
+        "    return jax.jit(lambda s, b: s + b, donate_argnums=(0,))\n"
+        "\n"
+        "def wrapper(ts, b):\n"
+        "    step = make_step()\n"
+        "    return step(ts, b)\n"  # ts donated through the factory result
+    )
+    project = _project([tmp_path])
+    s = project.summaries
+    names = {q.rsplit(".", 1)[-1]: q for q in s}
+    assert s[names["consume"]].key_params == {"rng"}
+    assert s[names["forwards"]].key_params == {"k"}
+    ret = s[names["make_step"]].returns
+    assert ret is not None and ret.kind == "jit" and ret.donate == (0,)
+    assert s[names["wrapper"]].donated_params == {0}
+
+
+def test_summary_for_bound_method_shifts_self(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import jax\n"
+        "\n"
+        "class Net:\n"
+        "    def init(self, rng):\n"
+        "        return jax.random.normal(rng, (2,))\n"
+        "\n"
+        "def use(rng):\n"
+        "    net = Net()\n"
+        "    return net.init(rng)\n"
+    )
+    project = _project([tmp_path])
+    src = project.files[0]
+    call, fn = _call_in(project, src, "use")
+    target = project.callgraph.resolve_call(src, call, fn)
+    assert target is not None and target.bound
+    summary = summary_for_target(project, target)
+    # caller position 0 maps to the method's `rng` (self already bound)
+    assert summary.param_at(0, bound=True) == "rng"
+    assert "rng" in summary.key_params

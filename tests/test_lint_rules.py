@@ -16,7 +16,7 @@ from yet_another_mobilenet_series_tpu import analysis
 from yet_another_mobilenet_series_tpu.analysis import cli
 
 FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
-RULE_IDS = [f"YAMT00{i}" for i in range(1, 9)]
+RULE_IDS = [f"YAMT{i:03d}" for i in range(1, 11)]
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
@@ -94,6 +94,24 @@ def test_reporters():
     doc = json.loads(analysis.render_json(findings))
     assert doc["count"] == len(doc["findings"]) == len(findings)
     assert {"path", "line", "col", "rule", "message"} <= set(doc["findings"][0])
+
+
+def test_github_reporter():
+    findings = analysis.run_lint([FIXTURES / "yamt006" / "bad"])
+    gh = analysis.render_github(findings)
+    first = findings[0]
+    lines = gh.splitlines()
+    assert lines[0].startswith(
+        f"::error file={first.path},line={first.line},col={first.col + 1},title={first.rule}::"
+    )
+    assert sum(ln.startswith("::error ") for ln in lines) == len(findings)
+    assert analysis.render_github([]) == "clean: no findings"
+
+
+def test_cli_github_format(capsys):
+    rc = cli.main([str(FIXTURES / "yamt006" / "bad"), "--format", "github"])
+    out = capsys.readouterr().out
+    assert rc == 1 and out.startswith("::error file=")
 
 
 # -- CLI --------------------------------------------------------------------

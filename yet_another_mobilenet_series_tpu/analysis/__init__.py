@@ -5,15 +5,18 @@ The invariants that make train/steps.py compile to ONE XLA program over the
 collectives over real mesh axes, checkpoint-layout/dataclass agreement,
 yml/config schema agreement, version-resilient jax imports — are all
 detectable from source without importing it. This package detects them:
-rules YAMT001-YAMT006 (see docs/LINT.md), a suppression syntax, text/JSON
-reporters, and a CLI (``python -m yet_another_mobilenet_series_tpu.analysis``).
+rules YAMT001-YAMT010 (see docs/LINT.md) over an interprocedural layer
+(symbols.py project symbol table, callgraph.py call resolution, summaries.py
+per-function dataflow summaries — all pure AST), a suppression syntax,
+text/JSON/GitHub reporters, and a CLI
+(``python -m yet_another_mobilenet_series_tpu.analysis``).
 
 The tier-1 gate runs the analyzer over this package (tests/test_lint_clean.py),
 so every invariant here is enforced on every PR.
 """
 
 from .core import Finding, Project, Rule, SourceFile, load_rules, register, run_lint
-from .reporters import render_json, render_text
+from .reporters import render_github, render_json, render_text
 
 __all__ = [
     "Finding",
@@ -22,6 +25,7 @@ __all__ = [
     "SourceFile",
     "load_rules",
     "register",
+    "render_github",
     "render_json",
     "render_text",
     "run_lint",

@@ -16,7 +16,7 @@ import os
 import sys
 
 from .core import load_rules, run_lint
-from .reporters import render_json, render_text
+from .reporters import render_github, render_json, render_text
 
 
 def _default_path() -> str:
@@ -29,7 +29,10 @@ def main(argv=None) -> int:
         description="JAX/TPU tracing-safety and SPMD-contract static analyzer (docs/LINT.md)",
     )
     p.add_argument("paths", nargs="*", help="files or directories to lint (default: this package)")
-    p.add_argument("--format", choices=("text", "json"), default="text", help="report format")
+    p.add_argument(
+        "--format", choices=("text", "json", "github"), default="text",
+        help="report format ('github' emits ::error workflow annotations for CI)",
+    )
     p.add_argument("--select", default="", metavar="IDS", help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
     args = p.parse_args(argv)
@@ -45,7 +48,8 @@ def main(argv=None) -> int:
     except (OSError, ValueError) as e:
         print(f"yamt-lint: {e}", file=sys.stderr)
         return 2
-    print(render_json(findings) if args.format == "json" else render_text(findings))
+    renderer = {"json": render_json, "github": render_github, "text": render_text}[args.format]
+    print(renderer(findings))
     return 1 if findings else 0
 
 

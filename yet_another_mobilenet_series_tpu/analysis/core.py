@@ -130,6 +130,39 @@ class Project:
         self.files = list(files)
         self.yml_files = list(yml_files)
         self._axis_constants: dict[str, str] | None = None
+        self._symbols = None
+        self._callgraph = None
+        self._summaries = None
+
+    @property
+    def symbols(self):
+        """Project-wide symbol table (symbols.py), built once per Project."""
+        if self._symbols is None:
+            from .symbols import SymbolTable
+
+            self._symbols = SymbolTable(self)
+        return self._symbols
+
+    @property
+    def callgraph(self):
+        """Intra-package call resolution (callgraph.py), built once."""
+        if self._callgraph is None:
+            from .callgraph import CallGraph
+
+            self._callgraph = CallGraph(self)
+        return self._callgraph
+
+    @property
+    def summaries(self):
+        """qualname -> FunctionSummary (summaries.py). The dict is installed
+        BEFORE the fixpoint runs so the call graph's returns-resolution can
+        read partial results while they converge."""
+        if self._summaries is None:
+            from . import summaries as summaries_mod
+
+            self._summaries = {}
+            summaries_mod.compute(self, self._summaries)
+        return self._summaries
 
     @property
     def axis_constants(self) -> dict[str, str]:
@@ -209,7 +242,16 @@ def register(cls: type[Rule]) -> type[Rule]:
 def load_rules() -> list[Rule]:
     """Import every rule module (registration side effect) and return the
     registry sorted by id."""
-    from . import rules_config, rules_donation, rules_imports, rules_logging, rules_spmd, rules_tracing  # noqa: F401
+    from . import (  # noqa: F401
+        rules_config,
+        rules_donation,
+        rules_imports,
+        rules_logging,
+        rules_prng_flow,
+        rules_recompile,
+        rules_spmd,
+        rules_tracing,
+    )
 
     return [_REGISTRY[k] for k in sorted(_REGISTRY)]
 
@@ -262,11 +304,17 @@ def run_lint(paths: Iterable[str], select: set[str] | None = None) -> list[Findi
         files.append(src)
     project = Project(files, yml_paths)
     by_path = {src.path: src for src in files}
+
+    def live(f: Finding) -> bool:
+        # interprocedural rules may attribute a finding to a DIFFERENT file
+        # than the one being checked (a traced helper in another module);
+        # suppressions must be honored where the finding lands
+        owner = by_path.get(f.path)
+        return owner is None or not owner.suppressed(f)
+
     for rule in rules:
         for src in files:
-            findings.extend(f for f in rule.check_file(src, project) if not src.suppressed(f))
-        for f in rule.check_project(project):
-            src = by_path.get(f.path)
-            if src is None or not src.suppressed(f):
-                findings.append(f)
-    return sorted(findings)
+            findings.extend(f for f in rule.check_file(src, project) if live(f))
+        findings.extend(f for f in rule.check_project(project) if live(f))
+    # two roots reaching the same traced helper must not report it twice
+    return sorted(set(findings))

@@ -1,4 +1,5 @@
-"""Finding reporters: human text and machine JSON (for scripts/lint.sh, CI)."""
+"""Finding reporters: human text, machine JSON, and GitHub workflow
+annotations (scripts/lint.sh --format github in CI)."""
 
 from __future__ import annotations
 
@@ -26,3 +27,21 @@ def render_json(findings: Sequence[Finding]) -> str:
         ],
     }
     return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def render_github(findings: Sequence[Finding]) -> str:
+    """One ``::error`` workflow command per finding, so a GitHub Actions run
+    annotates the offending line in the PR diff. Newlines inside messages
+    are %-escaped per the workflow-command spec; a trailing plain summary
+    line keeps the raw log readable."""
+
+    def esc(s: str) -> str:
+        return s.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+
+    lines = [
+        f"::error file={f.path},line={f.line},col={f.col + 1},title={f.rule}::{esc(f.message)}"
+        for f in findings
+    ]
+    n = len(findings)
+    lines.append("clean: no findings" if n == 0 else f"{n} finding{'s' if n != 1 else ''}")
+    return "\n".join(lines)

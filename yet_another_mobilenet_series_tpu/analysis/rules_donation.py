@@ -8,16 +8,23 @@ pass and the TPU run dies). The live hazards this rule guards are
 cli/train.py's donated TrainState (``ts`` must be rebound by every dispatch)
 and the serving engine's donated input batch (serve/engine.py).
 
-Detection is intra-module and linear-flow, like the other rules: a name
-bound to ``jax.jit(...)``/``jax.pmap(...)`` with ``donate_argnums`` is a
-*donating function*; after a call ``f(a, b)`` passes variable ``a`` at a
-donated position, any read of ``a`` before a rebinding is flagged. The
-rebind-in-the-same-statement idiom (``ts, m = step(ts, batch)``) is clean by
-construction — the call marks the donation, the assignment targets clear it.
-Loop bodies are walked twice so a donation at the bottom of an iteration
-flags a read at the top of the next. Calls through attributes
-(``trainer.train_step``) and cross-module donating functions are not
-resolvable statically and are skipped.
+Detection is linear-flow: a name bound to ``jax.jit(...)``/``jax.pmap(...)``
+with ``donate_argnums`` is a *donating function*; after a call ``f(a, b)``
+passes variable ``a`` at a donated position, any read of ``a`` before a
+rebinding is flagged. The rebind-in-the-same-statement idiom
+(``ts, m = step(ts, batch)``) is clean by construction — the call marks the
+donation, the assignment targets clear it. Loop bodies are walked twice so a
+donation at the bottom of an iteration flags a read at the top of the next.
+
+Since the interprocedural PR, donors also resolve through the call graph
+(callgraph.py) and the per-function summaries (summaries.py): attribute
+calls on locally-constructed or annotated instances
+(``trainer.train_step(ts, b)`` where ``Trainer.__init__`` binds a donating
+jit), names bound to factory RESULTS (``step = make_dp_train_step(...)``
+whose summary returns ``jit(..., donate_argnums=(0,))`` — the live
+cli/train.py shape), and calls to project functions whose summaries donate a
+parameter transitively. Opaque calls are still skipped — a donation is never
+guessed.
 """
 
 from __future__ import annotations
@@ -27,6 +34,17 @@ import ast
 from .core import Finding, Project, Rule, SourceFile, qualified_name, register
 
 _DONATING_WRAPPERS = {"jax.jit", "jax.pmap"}
+
+
+def _call_label(func: ast.expr) -> str:
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)) or "<call>"
 
 
 def _donated_indices(call: ast.Call) -> tuple[int, ...] | None:
@@ -56,26 +74,42 @@ class DonatedBufferReuse(Rule):
     )
 
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        self._project = project
+        self._cg = project.callgraph
         donors: dict[str, tuple[int, ...]] = {}
         for node in ast.walk(src.tree):
-            if (
+            if not (
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
                 and isinstance(node.targets[0], ast.Name)
                 and isinstance(node.value, ast.Call)
-                and qualified_name(node.value.func, src.aliases) in _DONATING_WRAPPERS
             ):
+                continue
+            if qualified_name(node.value.func, src.aliases) in _DONATING_WRAPPERS:
                 idx = _donated_indices(node.value)
                 if idx:
                     donors[node.targets[0].id] = idx
-        if not donors:
-            return []
+            else:
+                # interprocedural donors: a name bound to the RESULT of a
+                # step factory (`step = make_dp_train_step(...)` returns
+                # jit(..., donate_argnums=(0,))) donates at that factory's
+                # recorded positions — the live cli/train.py shape
+                from .summaries import donated_caller_positions
+
+                scope = self._cg.enclosing_scope(src, node)
+                t = self._cg.resolve_expr(src, node.value, scope)
+                if t is not None and t.kind == "jit":
+                    idx = donated_caller_positions(project, t)
+                    if idx:
+                        donors[node.targets[0].id] = idx
         out: dict[tuple, Finding] = {}
         scopes: list[ast.AST] = [src.tree]
         scopes += [
             n for n in ast.walk(src.tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:
+            self._scope = scope if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+            self._src = src
             self._block(list(scope.body), {}, donors, src, out)
         return list(out.values())
 
@@ -175,9 +209,22 @@ class DonatedBufferReuse(Rule):
                 self._expr(child, donated, donors, src, out)
             elif isinstance(child, ast.keyword):
                 self._expr(child.value, donated, donors, src, out)
-        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
-            idx = donors.get(expr.func.id)
-            if idx:
-                for i in idx:
-                    if i < len(expr.args) and isinstance(expr.args[i], ast.Name):
-                        donated[expr.args[i].id] = (expr.func.id, expr.lineno)
+        if isinstance(expr, ast.Call):
+            idx: tuple[int, ...] = ()
+            label = ""
+            if isinstance(expr.func, ast.Name):
+                idx = donors.get(expr.func.id, ())
+                label = expr.func.id
+            if not idx:
+                # attribute calls (`trainer.train_step(ts, b)`) and calls to
+                # functions whose SUMMARY donates (a wrapper forwarding to a
+                # donating jit) resolve through the call graph; opaque calls
+                # stay skipped — never guess a donation
+                from .summaries import donated_caller_positions
+
+                t = self._cg.resolve_call(self._src, expr, self._scope)
+                idx = donated_caller_positions(self._project, t)
+                label = _call_label(expr.func)
+            for i in idx:
+                if i < len(expr.args) and isinstance(expr.args[i], ast.Name):
+                    donated[expr.args[i].id] = (label, expr.lineno)

@@ -1,0 +1,97 @@
+"""YAMT010 — cross-call PRNG key reuse (YAMT002's call-graph gap).
+
+YAMT002 tracks DIRECT ``jax.random`` draws, so ``net.init(rng)`` followed by
+``sample(rng)`` was invisible: each callee consumes the key behind its own
+``def``. With the interprocedural layer, every function's dataflow summary
+(summaries.py) records which parameters it consumes as PRNG keys — including
+transitively, and including ``split``/``fold_in`` (two callees splitting the
+SAME key derive the SAME subkey streams). This rule replays YAMT002's
+branch-aware linear flow, but a "consumption" is *passing the key whole to a
+resolved callee whose matching parameter is key-consuming*: the second such
+pass without an intervening rebind is correlated randomness across calls.
+
+Deliberately NOT flagged:
+
+- passing the same key to the SAME consuming callee across loop iterations —
+  that is the sanctioned training-loop idiom (the step folds in ``ts.step``
+  / the device axis index; cli/train.py), and unlike YAMT002's loop rule the
+  callee is expected to derive its own per-call stream;
+- passes to opaque callees (unresolvable targets never count — soundness
+  over recall);
+- one direct draw plus one callee pass (the direct half is YAMT002's beat;
+  recorded as a known gap in docs/LINT.md).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, Rule, SourceFile, register
+from .rules_tracing import PRNGKeyReuse
+from .summaries import summary_for_target
+
+
+@register
+class CrossCallKeyReuse(PRNGKeyReuse, Rule):
+    id = "YAMT010"
+    name = "cross-call-prng-key-reuse"
+    description = (
+        "a PRNG key passed whole to two or more callees whose dataflow summaries "
+        "consume it (jax.random.*/split/fold_in, directly or transitively) without "
+        "an intervening split/rebind: the callees derive correlated randomness"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        self._project = project
+        self._first_sites: dict[str, str] = {}
+        return super().check_file(src, project)
+
+    # consumption = a whole-key pass to a resolved key-consuming callee;
+    # overrides YAMT002's direct-draw counting (and drops its loop-depth
+    # rule: same-callee-per-iteration is the sanctioned step idiom)
+    def _check_draw(self, call, state, depth, src, out):
+        cg = self._project.callgraph
+        target = cg.resolve_call(src, call, self._scope)
+        summary = summary_for_target(self._project, target)
+        if summary is None or not summary.key_params:
+            return
+        bound = target.kind == "function" and target.bound
+        label = _call_label(call.func)
+        consumed: list[str] = []
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Name):
+                pname = summary.param_at(i, bound)
+                if pname is not None and pname in summary.key_params:
+                    consumed.append(arg.id)
+        for kw in call.keywords:
+            if kw.arg in summary.key_params and isinstance(kw.value, ast.Name):
+                consumed.append(kw.value.id)
+        for name in consumed:
+            ent = state.vars.get(name)
+            if ent is None:
+                state.vars[name] = [1, depth]
+                self._first_sites.setdefault(name, f"'{label}' (line {call.lineno})")
+                continue
+            if ent[0] == 0:
+                self._first_sites[name] = f"'{label}' (line {call.lineno})"
+            ent[0] += 1
+            if ent[0] == 2:
+                first = self._first_sites.get(name, "an earlier callee")
+                f = Finding(
+                    src.path, call.lineno, call.col_offset, self.id,
+                    f"PRNG key '{name}' passed whole to '{label}' after already being "
+                    f"consumed whole by {first}: both callees derive the same random "
+                    "streams — split the key (or fold_in a tag) per callee",
+                )
+                out.setdefault((f.line, name, self.id), f)
+
+
+def _call_label(func: ast.expr) -> str:
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)) or "<call>"

@@ -1,0 +1,306 @@
+"""YAMT009 — recompilation hazards (the first ROADMAP rule unblocked by the
+interprocedural layer).
+
+``jax.jit`` caches compiled programs by the HASH of every static argument and
+by the values baked in at trace time. Two AST-visible ways to silently defeat
+that cache, each costing a full recompile per training step (the exact
+failure mode the per-epoch AtomNAS rebuild loop is most exposed to — there
+the re-jit is intentional and paid at epoch cadence, not per step):
+
+1. **Static-argument hazards at call sites.** A call to a jit-wrapped
+   callable with ``static_argnums``/``static_argnames`` (resolved through
+   the call graph: direct names, attribute calls, factory results) passing
+   at a static position either a non-hashable literal (``[1, 2]`` — every
+   call raises) or a freshly-constructed object (``Cfg(...)``,
+   ``dict(...)``, ``np.array(...)``, a ``lambda`` — a new identity every
+   call, so the cache NEVER hits and every step recompiles). The live
+   contract this pins is ops/pallas_kernels.py's
+   ``static_argnames=("stride", "act", "interpret")`` entry point: its
+   callers must pass plain hashable values.
+
+2. **Closure-captured values that vary per call.** A jitted function that
+   reads a free variable which its enclosing scope rebinds AFTER the jit
+   was created — or which is the loop variable of an enclosing loop
+   containing the jitted def — bakes the trace-time value into the program:
+   later calls silently keep the stale constant, and the "fix" of
+   re-wrapping in the loop recompiles every iteration. (Rebinding BEFORE
+   the jit exists — the ``forward = jax.checkpoint(forward)`` factory
+   idiom in train/steps.py — is build-time setup and stays clean.)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+
+_JIT_Q = {"jax.jit", "jax.pmap"}
+_PARTIAL_Q = {"functools.partial", "partial"}
+
+# constructors whose results hash by VALUE: passing them static is fine
+_HASHABLE_BUILDERS = {"tuple", "frozenset", "str", "int", "float", "bool", "bytes", "complex", "range", "len"}
+# builders that are fresh-per-call by construction (identity hash or unhashable)
+_FRESH_NAMES = {"dict", "list", "set", "bytearray", "object"}
+_FRESH_QUALIFIED = {
+    "numpy.array",
+    "numpy.asarray",
+    "jax.numpy.array",
+    "jax.numpy.asarray",
+    "functools.partial",
+}
+
+_UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+@register
+class RecompilationHazard(Rule):
+    id = "YAMT009"
+    name = "recompilation-hazard"
+    description = (
+        "non-hashable or freshly-constructed values at static_argnums/static_argnames "
+        "positions, or a jitted closure over a variable that varies per call: "
+        "each silently recompiles (or stales) the program every step"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        out: dict[tuple, Finding] = {}
+        self._check_static_call_sites(src, project, out)
+        self._check_varying_closures(src, project, out)
+        return list(out.values())
+
+    # -- 1: static positions at resolved call sites -------------------------
+
+    def _check_static_call_sites(self, src, project, out):
+        cg = project.callgraph
+        for call, scope, target in cg.resolved_calls(src):
+            if target is None or target.kind != "jit" or not (target.static_nums or target.static_names):
+                continue
+            label = _call_label(call.func)
+            inner_pos = (
+                target.inner.func.pos_params
+                if target.inner is not None and target.inner.kind == "function" and target.inner.func is not None
+                else None
+            )
+            for i, arg in enumerate(call.args):
+                is_static = i in target.static_nums or (
+                    inner_pos is not None and i < len(inner_pos) and inner_pos[i] in target.static_names
+                )
+                if is_static:
+                    self._flag_static_value(src, project, scope, arg, label, out)
+            for kw in call.keywords:
+                if kw.arg is not None and kw.arg in target.static_names:
+                    self._flag_static_value(src, project, scope, kw.value, label, out)
+
+    def _flag_static_value(self, src, project, scope, arg, label, out):
+        def flag(msg):
+            f = Finding(src.path, arg.lineno, arg.col_offset, self.id, msg)
+            out.setdefault((f.path, f.line, f.col), f)
+
+        if isinstance(arg, _UNHASHABLE_LITERALS):
+            flag(
+                f"non-hashable literal at a static position of '{label}': jit hashes "
+                "static arguments, so every call fails (or falls back to retracing); "
+                "pass a tuple/scalar or drop the static marking"
+            )
+        elif isinstance(arg, ast.Lambda):
+            flag(
+                f"lambda at a static position of '{label}': a fresh function object "
+                "every call hashes by identity, so the jit cache never hits and every "
+                "step recompiles; hoist it to a module-level def"
+            )
+        elif isinstance(arg, ast.Call):
+            q = qualified_name(arg.func, src.aliases) or ""
+            name = q.rsplit(".", 1)[-1]
+            if q in _FRESH_QUALIFIED or (isinstance(arg.func, ast.Name) and arg.func.id in _FRESH_NAMES):
+                fresh = True
+            elif name in _HASHABLE_BUILDERS:
+                fresh = False
+            else:
+                t = project.callgraph.resolve_expr(src, arg.func, scope)
+                fresh = t is not None and t.kind == "class"
+            if fresh:
+                flag(
+                    f"freshly-constructed object at a static position of '{label}': a new "
+                    "object identity every call means a jit cache miss and a silent "
+                    "recompile per step; construct it once outside the call"
+                )
+
+    # -- 2: closures over per-call-varying values ---------------------------
+
+    def _check_varying_closures(self, src, project, out):
+        symbols = project.symbols
+        registrations: dict[int, tuple] = {}  # id(def node) -> (node, earliest jit line)
+
+        def note(node, line):
+            prev = registrations.get(id(node))
+            registrations[id(node)] = (node, line if prev is None else min(prev[1], line))
+
+        defs_by_name: dict[str, list] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    q = qualified_name(dec.func if isinstance(dec, ast.Call) else dec, src.aliases)
+                    if q in _JIT_Q:
+                        note(node, dec.lineno)
+                    elif isinstance(dec, ast.Call) and q in _PARTIAL_Q and dec.args:
+                        if qualified_name(dec.args[0], src.aliases) in _JIT_Q:
+                            note(node, dec.lineno)
+        for node in ast.walk(src.tree):
+            if (
+                isinstance(node, ast.Call)
+                and qualified_name(node.func, src.aliases) in _JIT_Q
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                for d in defs_by_name.get(node.args[0].id, ()):
+                    note(d, node.lineno)
+
+        for fn_id, (root, reg_line) in registrations.items():
+            fi = symbols.by_node.get(fn_id)
+            if fi is None or fi.parent is None:
+                continue  # module-level jit: globals are out of static reach
+            for name in sorted(self._free_reads(root)):
+                self._check_free_name(src, root, fi, name, reg_line, out)
+
+    @staticmethod
+    def _free_reads(root) -> set[str]:
+        bound: set[str] = set()
+        reads: set[str] = set()
+        for n in ast.walk(root):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                a = n.args
+                bound |= {x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+                bound |= {x.arg for x in (a.vararg, a.kwarg) if x is not None}
+                if not isinstance(n, ast.Lambda):
+                    bound.add(n.name)
+            elif isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    reads.add(n.id)
+                else:
+                    bound.add(n.id)
+            elif isinstance(n, (ast.comprehension,)):
+                pass
+        return reads - bound
+
+    def _check_free_name(self, src, root, fi, name, reg_line, out):
+        scope_fi = fi.parent
+        while scope_fi is not None:
+            scope = scope_fi.node
+            a = scope.args
+            params = {x.arg for x in (*a.posonlyargs, *a.args, *a.kwonlyargs)} | {
+                x.arg for x in (a.vararg, a.kwarg) if x is not None
+            }
+            loop_hit = self._loop_target_containing(scope, root, name)
+            if loop_hit is not None:
+                f = Finding(
+                    src.path, root.lineno, root.col_offset, self.id,
+                    f"jitted function '{getattr(root, 'name', '<lambda>')}' closes over "
+                    f"'{name}', the loop variable of the enclosing loop at line "
+                    f"{loop_hit}: every iteration re-wraps and recompiles (or bakes a "
+                    "stale value); pass it as an argument or fold_in/static it",
+                )
+                out.setdefault((f.path, f.line, name), f)
+                return
+            late = self._assigned_after(scope, root, name, reg_line)
+            if late is not None:
+                f = Finding(
+                    src.path, root.lineno, root.col_offset, self.id,
+                    f"jitted function '{getattr(root, 'name', '<lambda>')}' closes over "
+                    f"'{name}', reassigned at line {late} AFTER the jit was created: "
+                    "the compiled program keeps the trace-time value (a re-jit would "
+                    "recompile per call); pass it as an argument instead",
+                )
+                out.setdefault((f.path, f.line, name), f)
+                return
+            if name in params or self._binds(scope, root, name):
+                return  # bound here, and none of the hazard shapes: clean
+            scope_fi = scope_fi.parent
+
+    @staticmethod
+    def _loop_target_containing(scope, root, name) -> int | None:
+        """Line of a for-loop in ``scope`` whose target binds ``name`` and
+        whose body contains ``root``; None otherwise."""
+
+        def walk(node, loops):
+            if node is root:
+                for lp in loops:
+                    if name in RecompilationHazard._target_names(lp.target):
+                        return lp.lineno
+                return None
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+                and node is not scope
+                and not _contains(node, root)
+            ):
+                return None  # a sibling scope: root isn't down this branch
+            for child in ast.iter_child_nodes(node):
+                nxt = loops + [node] if isinstance(node, (ast.For, ast.AsyncFor)) else loops
+                hit = walk(child, nxt)
+                if hit is not None:
+                    return hit
+            return None
+
+        return walk(scope, [])
+
+    @staticmethod
+    def _target_names(t) -> set[str]:
+        out: set[str] = set()
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+        return out
+
+    @staticmethod
+    def _assigned_after(scope, root, name, reg_line) -> int | None:
+        """Earliest assignment line of ``name`` in ``scope`` (nested defs
+        excluded, other than the chain down to ``root``) strictly after the
+        jit registration line."""
+        hits: list[int] = []
+        stack = [c for c in ast.iter_child_nodes(scope)]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    if name in RecompilationHazard._target_names(t) and n.lineno > reg_line:
+                        hits.append(n.lineno)
+            stack.extend(ast.iter_child_nodes(n))
+        return min(hits) if hits else None
+
+    @staticmethod
+    def _binds(scope, root, name) -> bool:
+        stack = [c for c in ast.iter_child_nodes(scope)]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                if any(name in RecompilationHazard._target_names(t) for t in targets):
+                    return True
+            elif isinstance(n, (ast.For, ast.AsyncFor)) and name in RecompilationHazard._target_names(n.target):
+                return True
+            elif isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if item.optional_vars is not None and name in RecompilationHazard._target_names(item.optional_vars):
+                        return True
+            stack.extend(ast.iter_child_nodes(n))
+        return False
+
+
+def _contains(node, target) -> bool:
+    return any(n is target for n in ast.walk(node))
+
+
+def _call_label(func: ast.expr) -> str:
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)) or "<call>"

@@ -3,16 +3,20 @@
 YAMT001 — host-side effects inside jit/shard_map-traced functions. A
 ``print``/``time.time()``/``np.random.*`` call under trace runs ONCE at trace
 time (or forces a host sync via ``.item()``), silently breaking the
-single-XLA-program contract of train/steps.py. Detection is per-module and
-heuristic: a function is "traced" when it is decorated with a tracing
-transform (``@jax.jit``, ``@partial(jax.jit, ...)``, ``@jax.checkpoint``) or
-its name is passed to one in the same module (``jax.jit(f)``,
-``shard_map(f, ...)``, ``jax.grad(f)``, ``lax.scan(f, ...)``, ...); nested
-``def``s inside a traced function are traced too. A function containing a
-mesh collective (``lax.psum``/``pmean``/``axis_index``/...) is also a traced
-context — collectives only execute under trace — which catches step builders
-whose inner ``step_fn`` is returned and jitted in ANOTHER module
-(train/steps.py -> parallel/dp.py).
+single-XLA-program contract of train/steps.py. A function is "traced" when it
+is decorated with a tracing transform (``@jax.jit``,
+``@partial(jax.jit, ...)``, ``@jax.checkpoint``) or passed to one
+(``jax.jit(f)``, ``shard_map(f, ...)``, ``jax.grad(f)``,
+``lax.scan(f, ...)``, ...) — since the interprocedural PR including
+attribute-call and factory-result arguments (``jax.jit(trainer.step)``,
+``jax.jit(make_prune_event(...))``), resolved through the project call graph
+into ANY linted module. Nested ``def``s inside a traced function are traced
+too, and so is every resolved callee: a call inside a traced body executes
+under trace, so the scan follows it (opaque calls stay skipped). A function
+containing a mesh collective (``lax.psum``/``pmean``/``axis_index``/...) is
+also a traced context — collectives only execute under trace — which catches
+step builders whose inner ``step_fn`` is returned and jitted in ANOTHER
+module (train/steps.py -> parallel/dp.py).
 
 YAMT002 — PRNG key discipline. A key consumed by two or more ``jax.random``
 draws without an intervening ``split``/``fold_in`` (or reassignment) yields
@@ -80,6 +84,17 @@ def _arg_names(fn: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda) -> set[s
     }
 
 
+def _resolved_function(cg, src, expr, scope):
+    """Project FunctionInfo behind an expression (unwrapping one jit layer),
+    or None when the call graph can't resolve it."""
+    t = cg.resolve_expr(src, expr, scope)
+    if t is None:
+        return None
+    if t.kind == "jit" and t.inner is not None:
+        t = t.inner
+    return t.func if t.kind == "function" else None
+
+
 def _directly_contains_collective(fn_node, aliases, collectives) -> bool:
     """A collective in the function's OWN body (nested defs excluded — they
     make their own root decision; the enclosing factory runs on the host)."""
@@ -106,25 +121,29 @@ class HostEffectsUnderTrace(Rule):
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
         from .rules_spmd import _COLLECTIVES
 
+        cg = project.callgraph
         tree, aliases = src.tree, src.aliases
         defs_by_name: dict[str, list[ast.AST]] = {}
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs_by_name.setdefault(node.name, []).append(node)
 
-        roots: list[ast.AST] = []
+        # roots: (node, SourceFile) — the interprocedural layer can resolve a
+        # traced callable into ANOTHER module (jax.jit(trainer.step),
+        # jax.jit(make_prune_event(...)))
+        roots: list[tuple[ast.AST, SourceFile]] = []
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # a body with a mesh collective DIRECTLY in it (not via a
                 # nested def — a factory's build-time code is host code) is a
                 # traced context by construction, however it reaches jit
                 if _directly_contains_collective(node, aliases, _COLLECTIVES):
-                    roots.append(node)
+                    roots.append((node, src))
                 for dec in node.decorator_list:
                     target = dec.func if isinstance(dec, ast.Call) else dec
                     q = qualified_name(target, aliases)
                     if q and _is_trace_entry(q):
-                        roots.append(node)
+                        roots.append((node, src))
                     elif (
                         isinstance(dec, ast.Call)
                         and qualified_name(dec.func, aliases) in ("functools.partial", "partial")
@@ -132,7 +151,7 @@ class HostEffectsUnderTrace(Rule):
                     ):
                         q2 = qualified_name(dec.args[0], aliases)
                         if q2 and _is_trace_entry(q2):
-                            roots.append(node)
+                            roots.append((node, src))
             elif isinstance(node, ast.Call):
                 q = qualified_name(node.func, aliases)
                 if not q:
@@ -141,30 +160,52 @@ class HostEffectsUnderTrace(Rule):
                     if i < len(node.args):
                         arg = node.args[i]
                         if isinstance(arg, ast.Lambda):
-                            roots.append(arg)
-                        elif isinstance(arg, ast.Name):
-                            roots.extend(defs_by_name.get(arg.id, ()))
+                            roots.append((arg, src))
+                        elif isinstance(arg, ast.Name) and arg.id in defs_by_name:
+                            roots.extend((d, src) for d in defs_by_name[arg.id])
+                        else:
+                            # attribute / cross-module / factory-result arg:
+                            # resolve through the call graph
+                            fi = _resolved_function(cg, src, arg, cg.enclosing_scope(src, node))
+                            if fi is not None:
+                                roots.append((fi.node, fi.module.src))
 
         findings: dict[tuple, Finding] = {}
+        visited: set[int] = set()
         # one finding per location; inner defs processed last so the most
         # specific function name wins when roots nest (factory + inner step)
-        unique = {id(r): r for r in roots}
-        for root in sorted(unique.values(), key=lambda r: r.lineno):
+        unique = {id(r): (r, s) for r, s in roots}
+        for root, rsrc in sorted(unique.values(), key=lambda rs: (rs[1].path != src.path, rs[0].lineno)):
             fname = getattr(root, "name", "<lambda>")
-            self._scan(root, fname, _arg_names(root), aliases, src.path, findings)
+            visited.add(id(root))  # a recursive traced fn must not loop _follow
+            self._scan(root, fname, _arg_names(root), rsrc, findings, cg, visited)
         return list(findings.values())
 
-    def _scan(self, node, fname, params, aliases, path, out):
+    def _scan(self, node, fname, params, src, out, cg, visited, scope=None):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
             params = params | _arg_names(node)
+            scope = node
         if isinstance(node, ast.Call):
-            self._check_call(node, fname, params, aliases, path, out)
+            self._check_call(node, fname, params, src.aliases, src.path, out)
+            self._follow(node, src, out, cg, visited, scope)
         for child in ast.iter_child_nodes(node):
-            self._scan(child, fname, params, aliases, path, out)
+            self._scan(child, fname, params, src, out, cg, visited, scope)
+
+    def _follow(self, call, src, out, cg, visited, scope):
+        """A call inside a traced body executes under trace too: descend into
+        the resolved callee (any module) and scan it with ITS own context.
+        Unresolvable calls stay opaque — no guess, no crash."""
+        fi = _resolved_function(cg, src, call.func, scope)
+        if fi is None or id(fi.node) in visited:
+            return
+        visited.add(id(fi.node))
+        self._scan(
+            fi.node, fi.name, fi.all_params, fi.module.src, out, cg, visited, scope=fi.node
+        )
 
     def _check_call(self, node: ast.Call, fname, params, aliases, path, out):
         def flag(msg):
-            out[(node.lineno, node.col_offset)] = Finding(path, node.lineno, node.col_offset, self.id, msg)
+            out[(path, node.lineno, node.col_offset)] = Finding(path, node.lineno, node.col_offset, self.id, msg)
 
         func = node.func
         if isinstance(func, ast.Name):
@@ -238,6 +279,8 @@ class PRNGKeyReuse(Rule):
                 seeds = {n for n in _arg_names(node) if _KEY_PARAM_RE.search(n)}
                 scopes.append((node, seeds))
         for scope, seeds in scopes:
+            # current scope for subclasses that resolve calls (YAMT010)
+            self._scope = scope if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
             state = _KeyState(seeds)
             self._block(list(getattr(scope, "body", [])), state, 0, src, out)
         return list(out.values())

@@ -28,9 +28,12 @@ from typing import Any
 TUNING_KEYS = ("bn_mode", "remat", "remat_policy", "conv1x1_dot", "steps_per_dispatch")
 # metadata keys the watcher's adoption step writes alongside the config
 # (scripts/tpu_watch.py _AB_KEYS/_DISPATCH_KEYS/_FLAG_KEYS); 'provisional'
-# marks a compute-family win whose parity evidence is synthetic-fixture only
+# marks a compute-family win whose parity evidence is synthetic-fixture only;
+# 'contention_invalidated'/'contention_note' mark an adoption whose measured
+# justification was skewed by host contention (ADVICE r5) — kept so the run
+# that consumes the tuning sees the warning, not just the decision artifact
 METADATA_KEYS = ("source", "steps_per_dispatch_source", "flags", "flags_source",
-                 "provisional")
+                 "provisional", "contention_invalidated", "contention_note")
 
 
 def validate_tuning(raw: dict) -> dict[str, Any]:
@@ -94,6 +97,11 @@ def apply_tuning_file(cfg):
             # the warning must reach the operator of the run that consumes
             # the tuning, not just the decision artifact nobody re-reads
             lines.append(f"tuning: WARNING — PROVISIONAL adoption: {raw['provisional']}")
+        if raw.get("contention_invalidated"):
+            lines.append(
+                "tuning: WARNING — CONTENTION-INVALIDATED adoption: "
+                f"{raw.get('contention_note', 'measured justification was contention-skewed')}"
+            )
         cfg = dc.replace(cfg, train=dc.replace(cfg.train, **tuning))
     flags = raw.get("flags", "")
     if not isinstance(flags, str):
