@@ -72,7 +72,9 @@ def summarize(log_dir: str) -> str:
                     snap.get("serve.shed_deadline", 0), snap.get("serve.rejected_full", 0))
             )
             for h, label in (("serve.queue_wait_seconds", "queue wait"),
-                             ("serve.run_seconds", "run latency")):
+                             ("serve.run_seconds", "run latency"),
+                             ("serve.dispatch_seconds", "dispatch"),
+                             ("serve.dispatch_to_complete_seconds", "dispatch->complete")):
                 if snap.get(f"{h}.count"):
                     lines.append(
                         f"  {label}: mean {snap[f'{h}.mean'] * 1e3:.2f} ms, "
@@ -82,6 +84,11 @@ def summarize(log_dir: str) -> str:
                 lines.append(
                     f"  batch size: mean {snap['serve.batch_size.mean']:.2f}, "
                     f"max {snap['serve.batch_size.max']:.0f}"
+                )
+            if snap.get("serve.shed_at_completion"):
+                lines.append(
+                    f"  shed at completion: {snap['serve.shed_at_completion']:.0f} "
+                    "(deadline passed while the batch executed)"
                 )
             hits = {k.rsplit(".", 1)[-1]: v for k, v in snap.items() if k.startswith("serve.bucket_hits.")}
             if hits:

@@ -1,14 +1,22 @@
 #!/usr/bin/env python
-"""Serving benchmark: folded-model inference latency/QPS per batch bucket.
+"""Serving benchmark: latency/QPS per bucket + pipelined/bf16 A/B.
 
 Prints exactly ONE JSON line on stdout in the bench.py artifact shape
 (tests/test_bench_contract.py contract: exit 0 always; a failed run emits
 ``value: null`` with an ``error`` field, never a stack trace) and optionally
-writes it to a BENCH_SERVE_*.json via --out:
+writes it to a BENCH_SERVE_*.json via --out. Three measurements per run:
 
-  {"metric": "<arch>_serve_images_per_sec", "value": <peak qps>,
-   "unit": "images/sec", "vs_baseline": null, "platform": ...,
-   "buckets": [{"batch": B, "p50_ms": ..., "p99_ms": ..., "qps": ...}, ...]}
+1. **direct** — engine.predict latency per (bucket, image_size), exact-bucket
+   batches: p50/p99 ms + QPS (the BENCH_SERVE_r01 shape, now per size).
+2. **concurrent-submit A/B** — closed-loop client threads submitting single
+   images through the real batcher, once through the legacy sync
+   MicroBatcher and once through the PipelinedBatcher (serve/pipeline.py):
+   per-(bucket, size) ``qps_sync`` vs ``qps_pipelined``. This measures the
+   tentpole: continuous batching + async double-buffered dispatch hiding
+   host collect/stage time behind device compute.
+3. **fp32-vs-bf16 A/B** — a second engine with compute_dtype=bfloat16,
+   direct QPS per bucket plus the measured max |logit delta| vs fp32
+   against the pinned BF16_PARITY_ATOL (serve/engine.py).
 
 The model is random-init + synthetic BN stats, folded through the real
 serve/export transform and dispatched through the real AOT engine — the
@@ -16,7 +24,8 @@ numbers measure the serving path (compile, pad, dispatch, device_get), which
 does not depend on trained weight values.
 
 Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
-           [--image-size 224] [--buckets 1,8,32] [--iters 20] [--out f.json]
+           [--image-sizes 224] [--buckets 1,8,32] [--iters 10]
+           [--concurrent-iters 6] [--ab-iters 5] [--no-bf16] [--out f.json]
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -38,13 +48,125 @@ def _percentile(sorted_vals, q):
     return sorted_vals[idx]
 
 
-def measure(arch: str, image_size: int, buckets: tuple[int, ...], iters: int) -> dict:
+def _direct_row(engine, batch, size, iters, rng):
+    """Exact-bucket engine.predict latency: one untimed page-in, then iters."""
+    x = rng.normal(0, 1, (batch, size, size, 3)).astype("float32")
+    engine.predict(x)
+    lat = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        engine.predict(x)
+        lat.append(time.perf_counter() - t1)
+    lat.sort()
+    mean = sum(lat) / len(lat)
+    return {
+        "batch": batch,
+        "image_size": size,
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+        "qps": round(batch / mean, 2),
+    }
+
+
+def _drive_concurrent(batcher, image, n_requests, n_clients):
+    """Closed-loop clients: each submits one image, waits, repeats. Returns
+    (qps, sorted latencies). The batcher must already be started."""
+    lock = threading.Lock()
+    left = [n_requests]
+    lat: list[float] = []
+
+    def client():
+        while True:
+            with lock:
+                if left[0] <= 0:
+                    return
+                left[0] -= 1
+            t0 = time.perf_counter()
+            fut = batcher.submit(image)
+            fut.result(timeout=300)
+            with lock:
+                lat.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, daemon=True) for _ in range(n_clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    lat.sort()
+    return (len(lat) / wall if wall > 0 else 0.0), lat
+
+
+def _concurrent_row(engine, batch, size, conc_iters, max_inflight, rng):
+    """Sync-vs-pipelined QPS through the real batchers at max_batch=batch.
+
+    2*batch closed-loop clients drive both batchers (sharing one warm
+    engine) in INTERLEAVED rounds — sync, pipelined, sync, pipelined... —
+    and the reported QPS is the per-mode MEDIAN of 5 rounds: on a shared
+    box, minute-scale CPU drift is bigger than the effect under test;
+    interleaving makes drift hit both modes alike, and the median (unlike
+    best-of or mean) ignores the occasional round that lands in a lucky or
+    throttled scheduler window. Per-round arrays are recorded in the
+    artifact so the spread is visible. The request count per round is
+    floored (a 12-request window is pure scheduler noise) and capped (the
+    biggest bucket would otherwise dominate the whole run).
+    ``avg_fill_*`` (serve.batch_size histogram deltas) says how full the
+    dispatched buckets actually were — fill < 1 means padded dead rows, a
+    batching-policy failure the QPS numbers would otherwise hide."""
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.batcher import MicroBatcher
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+    image = rng.normal(0, 1, (size, size, 3)).astype("float32")
+    n_clients = min(max(2 * batch, 4), 64)
+    n_requests = min(max(conc_iters * batch, 48), 96)
+    rounds = 5
+    # a long linger fills buckets; the pipelined path hides it behind compute
+    common = dict(max_batch=batch, max_wait_ms=10.0, queue_depth=max(64, 4 * batch))
+    reg = get_registry()
+    row = {"batch": batch, "image_size": size, "requests": n_requests, "clients": n_clients,
+           "rounds": rounds}
+    batchers = {
+        "sync": MicroBatcher(engine.predict, **common).start(),
+        "pipelined": PipelinedBatcher(engine, max_inflight=max_inflight, **common).start(),
+    }
+    runs = {m: [] for m in batchers}  # (qps, lat) per round
+    fills = {m: [] for m in batchers}
+    try:
+        for b in batchers.values():  # warm both paths
+            _drive_concurrent(b, image, min(2 * batch, n_requests), n_clients)
+        for _ in range(rounds):
+            for mode, b in batchers.items():
+                s0 = reg.snapshot()
+                qps, lat = _drive_concurrent(b, image, n_requests, n_clients)
+                s1 = reg.snapshot()
+                d_count = s1["serve.batch_size.count"] - s0["serve.batch_size.count"]
+                d_sum = s1["serve.batch_size.sum"] - s0["serve.batch_size.sum"]
+                fills[mode].append(d_sum / d_count / batch if d_count else 0.0)
+                runs[mode].append((qps, lat))
+    finally:
+        for b in batchers.values():
+            b.stop()
+    for mode in batchers:
+        ordered = sorted(runs[mode], key=lambda r: r[0])
+        med_qps, med_lat = ordered[len(ordered) // 2]
+        row[f"qps_{mode}"] = round(med_qps, 2)
+        row[f"qps_rounds_{mode}"] = [round(q, 2) for q, _ in runs[mode]]
+        row[f"p99_ms_{mode}"] = round(_percentile(med_lat, 0.99) * 1e3, 3)
+        row[f"avg_fill_{mode}"] = round(sum(fills[mode]) / len(fills[mode]), 3)
+    row["pipelined_speedup"] = round(row["qps_pipelined"] / row["qps_sync"], 4) if row["qps_sync"] else None
+    return row
+
+
+def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_inflight, with_bf16):
     import jax
+    import jax.numpy as jnp
     import numpy as np
 
     from yet_another_mobilenet_series_tpu.config import ModelConfig
     from yet_another_mobilenet_series_tpu.models import get_model
-    from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+    from yet_another_mobilenet_series_tpu.serve.engine import BF16_PARITY_ATOL, InferenceEngine
     from yet_another_mobilenet_series_tpu.serve.export import InferenceBundle, fold_network
 
     if arch == "tiny":  # contract-test preset: 2 blocks, compiles in seconds
@@ -52,65 +174,117 @@ def measure(arch: str, image_size: int, buckets: tuple[int, ...], iters: int) ->
                          block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}, {"t": 2, "c": 16, "n": 1, "s": 2}])
     else:
         mc = ModelConfig(arch=arch)
-    net = get_model(mc, image_size)
+    base_size = image_sizes[0]
+    net = get_model(mc, base_size)
     params, state = net.init(jax.random.PRNGKey(0))
+    # non-trivial BN running stats (fresh init is mean=0/var=1): a fold of
+    # the identity affine collapses random-init logits to ~1e-11, which
+    # would make the bf16-vs-fp32 parity delta degenerate
+    leaves, treedef = jax.tree.flatten(state)
+    keys = jax.random.split(jax.random.PRNGKey(1), len(leaves))
+    state = jax.tree.unflatten(
+        treedef,
+        [l + 0.1 * jnp.abs(jax.random.normal(k, l.shape)) + 0.01 for l, k in zip(leaves, keys)],
+    )
     bundle = InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
-    engine = InferenceEngine(bundle, buckets=buckets, image_size=image_size)
 
+    def make_engine(dtype):
+        return InferenceEngine(bundle, buckets=buckets, compute_dtype=dtype,
+                               image_size=base_size, image_sizes=image_sizes)
+
+    engine = make_engine("float32")
     t0 = time.perf_counter()
     engine.warmup()
     warmup_s = time.perf_counter() - t0
 
     rng = np.random.RandomState(0)
-    rows = []
-    for b in engine.buckets:
-        x = rng.normal(0, 1, (b, image_size, image_size, 3)).astype(np.float32)
-        engine.predict(x)  # one untimed call: page in the executable
-        lat = []
-        for _ in range(iters):
-            t1 = time.perf_counter()
-            engine.predict(x)
-            lat.append(time.perf_counter() - t1)
-        lat.sort()
-        mean = sum(lat) / len(lat)
-        rows.append({
-            "batch": b,
-            "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
-            "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
-            "qps": round(b / mean, 2),
-        })
+    direct_rows = [
+        _direct_row(engine, b, s, iters, rng) for s in engine.image_sizes for b in engine.buckets
+    ]
+    concurrent_rows = [
+        _concurrent_row(engine, b, s, conc_iters, max_inflight, rng)
+        for s in engine.image_sizes for b in engine.buckets
+    ]
+    peak_sync = max(r["qps_sync"] for r in concurrent_rows)
+    peak_pipe = max(r["qps_pipelined"] for r in concurrent_rows)
+    ab = {
+        "pipelined_vs_sync": {
+            "peak_qps_sync": peak_sync,
+            "peak_qps_pipelined": peak_pipe,
+            "peak_speedup": round(peak_pipe / peak_sync, 4) if peak_sync else None,
+        }
+    }
+    if with_bf16:
+        bf16 = make_engine("bfloat16")
+        bf16.warmup()
+        bf16_rows = [_direct_row(bf16, b, base_size, ab_iters, rng) for b in bf16.buckets]
+        # parity on one fixed batch at the largest bucket: the measured
+        # delta every artifact carries, judged against the pinned tolerance
+        xp = rng.normal(0, 1, (buckets[-1], base_size, base_size, 3)).astype("float32")
+        ref = engine.predict(xp)
+        delta = float(np.max(np.abs(bf16.predict(xp) - ref)))
+        logit_scale = float(np.mean(np.abs(ref)))
+        fp32_by_bucket = {r["batch"]: r["qps"] for r in direct_rows if r["image_size"] == base_size}
+        peak_fp32 = max(fp32_by_bucket.values())
+        peak_bf16 = max(r["qps"] for r in bf16_rows)
+        ab["bf16_vs_fp32"] = {
+            "buckets": [
+                {"batch": r["batch"], "qps_bf16": r["qps"], "qps_fp32": fp32_by_bucket[r["batch"]]}
+                for r in bf16_rows
+            ],
+            "peak_qps_fp32": peak_fp32,
+            "peak_qps_bf16": peak_bf16,
+            "peak_speedup": round(peak_bf16 / peak_fp32, 4) if peak_fp32 else None,
+            "max_abs_logit_delta": round(delta, 6),
+            "mean_abs_logit": round(logit_scale, 6),
+            "parity_atol": BF16_PARITY_ATOL,
+            "parity_ok": delta <= BF16_PARITY_ATOL,
+        }
     dev = jax.devices()[0]
     return {
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "n_chips": len(jax.devices()),
         "warmup_compile_s": round(warmup_s, 2),
-        "buckets": rows,
-        "peak_qps": max(r["qps"] for r in rows),
+        "buckets": direct_rows,
+        "concurrent": concurrent_rows,
+        "ab": ab,
+        "peak_qps": max([peak_pipe, peak_sync] + [r["qps"] for r in direct_rows]),
     }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--arch", default="mobilenet_v3_large")
-    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--image-sizes", default="224", help="comma ladder; first entry is the base size")
     ap.add_argument("--buckets", default="1,8,32")
-    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--iters", type=int, default=10, help="direct-mode timed predicts per bucket")
+    ap.add_argument("--concurrent-iters", type=int, default=6,
+                    help="concurrent mode drives max(iters*batch, 32) requests per bucket and mode")
+    ap.add_argument("--ab-iters", type=int, default=5, help="bf16 direct-mode iters per bucket")
+    ap.add_argument("--max-inflight", type=int, default=2,
+                    help="pipelined window; 1 = pure double buffering (stage||compute, no "
+                         "concurrent executions — best when host and device share cores)")
+    ap.add_argument("--no-bf16", action="store_true", help="skip the fp32-vs-bf16 A/B")
     ap.add_argument("--out", default="", help="also write the JSON artifact here")
     args = ap.parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
+    image_sizes = tuple(int(s) for s in args.image_sizes.split(","))
 
     out = {
         "metric": f"{args.arch}_serve_images_per_sec",
         "value": None,
         "unit": "images/sec",
         "vs_baseline": None,
-        "vs_baseline_note": "no serving reference measurement exists yet",
-        "image_size": args.image_size,
+        "vs_baseline_note": "BENCH_SERVE_r01 predates the concurrent-submit mode; direct rows are comparable",
+        "image_size": image_sizes[0],
+        "image_sizes": list(image_sizes),
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     try:
-        m = measure(args.arch, args.image_size, buckets, max(1, args.iters))
+        m = measure(args.arch, image_sizes, buckets, max(1, args.iters),
+                    max(1, args.concurrent_iters), max(1, args.ab_iters),
+                    max(1, args.max_inflight), not args.no_bf16)
         out.update(m)
         out["value"] = m["peak_qps"]
     except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0

@@ -40,7 +40,8 @@ from yet_another_mobilenet_series_tpu.nas import masking
 from yet_another_mobilenet_series_tpu.obs.registry import get_registry
 from yet_another_mobilenet_series_tpu.parallel import mesh as mesh_lib
 from yet_another_mobilenet_series_tpu.serve.batcher import DeadlineExceeded, MicroBatcher, QueueFull
-from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.engine import BF16_PARITY_ATOL, InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
 from yet_another_mobilenet_series_tpu.serve.export import (
     InferenceBundle,
     apply_folded,
@@ -183,7 +184,7 @@ def test_engine_bucket_padding_bitwise(tmp_path):
     bundle = _bundle(tmp_path)
     eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24)
     eng.warmup()
-    assert set(eng._compiled) == {2, 4}  # warmup precompiled every bucket
+    assert set(eng._compiled) == {(2, 24), (4, 24)}  # warmup precompiled every (bucket, size)
     x = np.random.RandomState(0).normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
     full = eng.predict(x)  # exact bucket, no padding
     part = eng.predict(x[:3])  # 3 -> padded to 4
@@ -224,6 +225,338 @@ def test_engine_input_validation(tmp_path):
         eng.predict(np.zeros((24, 24, 3), np.float32))
     with pytest.raises(ValueError, match="bucket"):
         InferenceEngine(bundle, buckets=())
+
+
+# ---------------------------------------------------------------------------
+# engine: async dispatch, image-size ladder, staging, bf16
+# ---------------------------------------------------------------------------
+
+
+def test_engine_async_matches_sync_bitwise(tmp_path):
+    """Interleaved multi-chunk predict_async == predict row-for-row, bitwise:
+    both paths run the identical compiled executable, and staging-buffer
+    reuse while earlier chunks are still in flight must not corrupt them."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24)
+    eng.warmup()
+    rs = np.random.RandomState(7)
+    x = rs.normal(0, 1, (10, 24, 24, 3)).astype(np.float32)  # chunks 4, 4, 2
+    y = rs.normal(0, 1, (7, 24, 24, 3)).astype(np.float32)  # chunks 4, 3->pad 4
+    sync_x = eng.predict(x.copy())
+    sync_y = eng.predict(y.copy())
+    # two handles pending at once: all chunks of both dispatched before any sync
+    hx = eng.predict_async(x)
+    hy = eng.predict_async(y)
+    # plus two PADDED dispatches sharing the (4, 24) staging buffer while
+    # hx/hy are still unsynced — reuse must be copy-safe
+    hz1 = eng.predict_async(x[:3])
+    hz2 = eng.predict_async(y[:3])
+    np.testing.assert_array_equal(hy.result(), sync_y)
+    np.testing.assert_array_equal(hx.result(), sync_x)
+    np.testing.assert_array_equal(hz1.result(), sync_x[:3])
+    np.testing.assert_array_equal(hz2.result(), sync_y[:3])
+    assert hx.result() is hx.result()  # the sync happens once, then caches
+
+
+def test_engine_mixed_size_ladder_no_postwarmup_compile(tmp_path):
+    """Mixed image-size traffic over the configured ladder hits only warm
+    (bucket, size) executables — zero post-warmup compiles (the
+    serve.compile_seconds counter is the recompile-cliff alarm)."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24,
+                          image_sizes=(24, 32))
+    eng.warmup()
+    assert set(eng._compiled) == {(2, 24), (4, 24), (2, 32), (4, 32)}
+    reg = get_registry()
+    before = reg.snapshot()["serve.compile_seconds.count"]
+    rs = np.random.RandomState(3)
+    for n, s in [(1, 24), (3, 32), (4, 32), (2, 24), (7, 32)]:
+        out = eng.predict(rs.normal(0, 1, (n, s, s, 3)).astype(np.float32))
+        assert out.shape == (n, 10)
+    assert reg.snapshot()["serve.compile_seconds.count"] == before
+    # a size OFF the ladder compiles lazily exactly once instead of failing
+    eng.predict(np.zeros((2, 16, 16, 3), np.float32))
+    eng.predict(np.zeros((2, 16, 16, 3), np.float32))
+    assert reg.snapshot()["serve.compile_seconds.count"] == before + 1
+    with pytest.raises(ValueError, match="expects"):
+        eng.predict(np.zeros((2, 24, 32, 3), np.float32))  # non-square
+
+
+def test_engine_staging_buffer_is_reused(tmp_path):
+    """Padded dispatches fill one per-(bucket, size) staging buffer instead
+    of np.concatenate-allocating per call."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(4,), image_size=24)
+    eng.warmup()
+    rs = np.random.RandomState(5)
+    x = rs.normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
+    full = eng.predict(x)
+    eng.predict(x[:2])
+    buf = eng._staging[(4, 24)]
+    got = eng.predict(x[:3])
+    assert eng._staging[(4, 24)] is buf  # same buffer, not reallocated
+    np.testing.assert_array_equal(got, full[:3])  # and stale rows were re-zeroed out of play
+
+
+def test_engine_bf16_parity_within_pinned_tolerance(tmp_path):
+    """compute_dtype=bfloat16 is a first-class serving path: logits stay
+    within the pinned BF16_PARITY_ATOL of the fp32 forward on the same
+    folded weights (the serve_bench A/B records the measured delta)."""
+    bundle = _bundle(tmp_path, atom=True)
+    fp32 = InferenceEngine(bundle, buckets=(4,), image_size=24)
+    bf16 = InferenceEngine(bundle, buckets=(4,), compute_dtype="bfloat16", image_size=24)
+    x = np.random.RandomState(11).normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
+    a, b = fp32.predict(x.copy()), bf16.predict(x.copy())
+    assert a.dtype == b.dtype == np.float32  # logits are fp32 on both paths
+    delta = float(np.max(np.abs(a - b)))
+    assert 0 < delta <= BF16_PARITY_ATOL  # >0: bf16 genuinely computed in bf16
+
+
+# ---------------------------------------------------------------------------
+# pipelined batcher: continuous batching, inflight window, completion deadlines
+# ---------------------------------------------------------------------------
+
+
+class _FakeAsyncEngine:
+    """predict_async protocol double: records dispatches, optionally blocks
+    result() on an event or fails at dispatch/sync."""
+
+    def __init__(self, block=None, fail_dispatch=False, fail_result=False):
+        self.block = block
+        self.fail_dispatch = fail_dispatch
+        self.fail_result = fail_result
+        self.dispatches = 0
+        self.batch_sizes = []
+
+    def predict_async(self, images):
+        if self.fail_dispatch:
+            raise RuntimeError("dispatch died")
+        self.dispatches += 1
+        self.batch_sizes.append(images.shape[0])
+        block, fail = self.block, self.fail_result
+
+        class _Handle:
+            def result(_self):
+                if block is not None:
+                    assert block.wait(10)
+                if fail:
+                    raise RuntimeError("sync died")
+                return _row_id_predict(images)
+
+        return _Handle()
+
+    def predict(self, images):
+        return self.predict_async(images).result()
+
+
+def test_pipelined_batcher_routes_rows_concurrent():
+    eng = _FakeAsyncEngine()
+    b = PipelinedBatcher(eng, max_inflight=2, max_batch=8, max_wait_ms=20.0, queue_depth=64).start()
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def client(i):
+            img = np.full((4, 4, 3), float(i), np.float32)
+            val = b.submit(img).result(timeout=10)
+            with lock:
+                results[i] = float(val[0])
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        b.stop()
+    assert results == {i: float(i) for i in range(24)}
+    assert sum(eng.batch_sizes) == 24
+    assert max(eng.batch_sizes) > 1, "no coalescing under 24 concurrent clients"
+    snap = get_registry().snapshot()
+    assert "serve.inflight" in snap  # the window gauge is registered and set
+
+
+def test_pipelined_inflight_window_bounds_dispatch():
+    """The window slot is reserved BEFORE dispatch: with completion blocked,
+    at most max_inflight batches are ever dispatched-but-unsynced — the
+    continuous-batching lookahead is bounded, not unbounded."""
+    gate = threading.Event()
+    eng = _FakeAsyncEngine(block=gate)
+    b = PipelinedBatcher(eng, max_inflight=2, max_batch=1, max_wait_ms=0.0, queue_depth=64).start()
+    img = np.zeros((2, 2, 3), np.float32)
+    try:
+        futs = [b.submit(img) for _ in range(10)]
+        time.sleep(0.3)
+        assert 1 <= eng.dispatches <= 2  # never more than the window
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+        assert eng.dispatches == 10
+    finally:
+        gate.set()
+        b.stop()
+
+
+def test_pipelined_completion_deadline_shed():
+    """A deadline that expires while the batch executes on-device sheds at
+    completion: DeadlineExceeded instead of a stale answer."""
+    gate = threading.Event()
+    eng = _FakeAsyncEngine(block=gate)
+    b = PipelinedBatcher(eng, max_inflight=1, max_batch=1, max_wait_ms=0.0).start()
+    reg = get_registry()
+    base = reg.snapshot().get("serve.shed_at_completion", 0)
+    try:
+        fut = b.submit(np.zeros((2, 2, 3), np.float32), deadline_ms=30.0)
+        time.sleep(0.2)  # dispatched immediately; expires during "execution"
+        gate.set()
+        with pytest.raises(DeadlineExceeded, match="completed"):
+            fut.result(timeout=10)
+    finally:
+        gate.set()
+        b.stop()
+    snap = reg.snapshot()
+    assert snap["serve.shed_at_completion"] - base == 1
+    assert snap.get("serve.shed_deadline", 0) >= 1  # feeds the shared shed counter too
+
+
+def test_pipelined_engine_failures_fail_futures_not_hang():
+    # failure at dispatch (collect thread)
+    b = PipelinedBatcher(_FakeAsyncEngine(fail_dispatch=True), max_batch=4, max_wait_ms=1.0).start()
+    try:
+        with pytest.raises(RuntimeError, match="dispatch died"):
+            b.submit(np.zeros((2, 2, 3), np.float32)).result(timeout=10)
+        with pytest.raises(RuntimeError, match="dispatch died"):  # thread survived
+            b.submit(np.zeros((2, 2, 3), np.float32)).result(timeout=10)
+    finally:
+        b.stop()
+    # failure at sync (completion thread)
+    b = PipelinedBatcher(_FakeAsyncEngine(fail_result=True), max_batch=4, max_wait_ms=1.0).start()
+    try:
+        with pytest.raises(RuntimeError, match="sync died"):
+            b.submit(np.zeros((2, 2, 3), np.float32)).result(timeout=10)
+        with pytest.raises(RuntimeError, match="sync died"):
+            b.submit(np.zeros((2, 2, 3), np.float32)).result(timeout=10)
+    finally:
+        b.stop()
+
+
+def test_pipelined_stop_drains_pending_under_load():
+    gate = threading.Event()
+    eng = _FakeAsyncEngine(block=gate)
+    b = PipelinedBatcher(eng, max_inflight=1, max_batch=2, max_wait_ms=0.0, queue_depth=64).start()
+    img = np.zeros((2, 2, 3), np.float32)
+    futs = [b.submit(img) for _ in range(6)]
+    stopper = threading.Thread(target=b.stop)
+    stopper.start()
+    time.sleep(0.1)
+    gate.set()
+    stopper.join(timeout=10)
+    assert not stopper.is_alive(), "stop(drain=True) deadlocked under load"
+    for f in futs:
+        assert f.result(timeout=10) is not None  # every pre-stop request was served
+
+
+def test_pipelined_mixed_image_sizes_end_to_end(tmp_path):
+    """Continuous batching over mixed image sizes: interleaved 24px and 32px
+    submits are partitioned by shape, served from warm (bucket, size)
+    executables — correct rows, zero post-warmup compiles."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(1, 4), image_size=24, image_sizes=(24, 32))
+    eng.warmup()
+    rs = np.random.RandomState(13)
+    im24 = rs.normal(0, 1, (6, 24, 24, 3)).astype(np.float32)
+    im32 = rs.normal(0, 1, (6, 32, 32, 3)).astype(np.float32)
+    ref24, ref32 = eng.predict(im24.copy()), eng.predict(im32.copy())
+    before = get_registry().snapshot()["serve.compile_seconds.count"]
+    b = PipelinedBatcher(eng, max_inflight=2, max_batch=8, max_wait_ms=10.0).start()
+    try:
+        futs = []
+        for i in range(6):  # interleave the two sizes into the same queue
+            futs.append((b.submit(im24[i]), ref24[i]))
+            futs.append((b.submit(im32[i]), ref32[i]))
+        for fut, ref in futs:
+            np.testing.assert_allclose(fut.result(timeout=30), ref, atol=2e-5, rtol=1e-5)
+    finally:
+        b.stop()
+    assert get_registry().snapshot()["serve.compile_seconds.count"] == before
+
+
+def test_pipelined_batcher_with_real_engine(tmp_path):
+    """End-to-end: async engine + pipelined batcher under concurrent load —
+    every request's row matches the reference forward."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(1, 4), image_size=24)
+    eng.warmup()
+    rs = np.random.RandomState(9)
+    imgs = rs.normal(0, 1, (12, 24, 24, 3)).astype(np.float32)
+    ref = eng.predict(imgs.copy())
+    b = PipelinedBatcher(eng, max_inflight=2, max_batch=4, max_wait_ms=10.0).start()
+    try:
+        futs = [b.submit(imgs[i]) for i in range(12)]
+        rows = [f.result(timeout=30) for f in futs]
+    finally:
+        b.stop()
+    # coalesced buckets differ from the reference's — tight allclose, not bitwise
+    np.testing.assert_allclose(np.stack(rows), ref, atol=2e-5, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# batcher satellites: accepted-only counting, event-driven idle wait
+# ---------------------------------------------------------------------------
+
+
+def test_submit_counts_accepted_only():
+    """A rejected submit must not inflate serve.requests — only
+    serve.rejected_full moves, so requests == completed + shed."""
+    hold = threading.Event()
+
+    def predict(images):
+        hold.wait(5)
+        return _row_id_predict(images)
+
+    b = MicroBatcher(predict, max_batch=1, max_wait_ms=0.0, queue_depth=1).start()
+    img = np.zeros((2, 2, 3), np.float32)
+    reg = get_registry()
+    base_req = reg.snapshot().get("serve.requests", 0)
+    base_rej = reg.snapshot().get("serve.rejected_full", 0)
+    try:
+        futs = [b.submit(img)]
+        time.sleep(0.1)  # worker holds it inside the blocked engine
+        futs.append(b.submit(img))  # fills the depth-1 queue
+        with pytest.raises(QueueFull):
+            b.submit(img)
+        snap = reg.snapshot()
+        assert snap["serve.requests"] - base_req == 2  # the accepted ones only
+        assert snap["serve.rejected_full"] - base_rej == 1
+        hold.set()
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        hold.set()
+        b.stop()
+
+
+@pytest.mark.parametrize("cls", ["micro", "pipelined"])
+def test_idle_batcher_does_not_spin(cls):
+    """The collect wait is event-driven: an idle batcher has ZERO empty-handed
+    wakeups (the old 50 ms poll produced ~5 in this window), and the first
+    request of a burst is served without a poll-interval delay."""
+    if cls == "micro":
+        b = MicroBatcher(_row_id_predict, max_batch=4, max_wait_ms=1.0).start()
+    else:
+        b = PipelinedBatcher(_FakeAsyncEngine(), max_batch=4, max_wait_ms=1.0).start()
+    try:
+        time.sleep(0.3)  # idle
+        fut = b.submit(np.zeros((2, 2, 3), np.float32))
+        assert fut.result(timeout=10) is not None
+    finally:
+        b.stop()
+    assert b._idle_wakeups == 0
+
+
+def test_pipelined_rejects_bad_window():
+    with pytest.raises(ValueError, match="max_inflight"):
+        PipelinedBatcher(_FakeAsyncEngine(), max_inflight=0)
 
 
 # ---------------------------------------------------------------------------

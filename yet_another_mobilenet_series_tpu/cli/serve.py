@@ -6,14 +6,15 @@ Two phases, both optional, driven by the ``serve:`` config block:
 1. **export** (``serve.export_from`` set): checkpoint -> InferenceBundle at
    ``serve.bundle`` — prune masks hard-applied, EMA weights selected, BN
    folded into conv weights (serve/export.py).
-2. **serve** (``serve.requests`` > 0): load the bundle, AOT-warm the engine's
-   batch buckets, and drive a synthetic closed-loop load of
-   ``serve.requests`` single-image requests from ``serve.clients`` client
-   threads through the micro-batcher — the in-process stand-in for an RPC
-   front door, exercising the exact queue/coalesce/dispatch path one would
-   sit behind one. Prints p50/p99 end-to-end latency and QPS; with a
-   log_dir, metrics + obs_registry.json land where scripts/obs_report.py
-   reads them.
+2. **serve** (``serve.requests`` > 0): load the bundle, AOT-warm the
+   engine's (bucket, image_size) ladder, and drive a synthetic closed-loop
+   load of ``serve.requests`` single-image requests from ``serve.clients``
+   client threads through the batcher — the pipelined continuous-batching
+   one by default (``serve.pipelined``, serve/pipeline.py), or the legacy
+   sync micro-batcher — the in-process stand-in for an RPC front door,
+   exercising the exact queue/coalesce/dispatch path one would sit behind
+   one. Prints p50/p99 end-to-end latency and QPS; with a log_dir, metrics
+   + obs_registry.json land where scripts/obs_report.py reads them.
 
 ``serve.requests=0`` with a bundle still warms up every bucket — a
 deploy-time smoke that the artifact compiles and serves shape-correctly.
@@ -34,6 +35,7 @@ from ..obs import trace as obs_trace
 from ..parallel import mesh as mesh_lib
 from ..serve.batcher import MicroBatcher, QueueFull
 from ..serve.engine import InferenceEngine
+from ..serve.pipeline import PipelinedBatcher
 from ..serve.export import export_checkpoint, load_bundle
 from ..utils.logging import Logger
 
@@ -134,19 +136,27 @@ def run(cfg: Config) -> dict:
             mesh=mesh,
             donate_input=cfg.serve.donate_input,
             image_size=cfg.data.image_size,
+            image_sizes=cfg.serve.image_sizes,
         )
         if cfg.serve.warmup:
             t0 = time.perf_counter()
             engine.warmup()
-            log.log(f"warmup: compiled buckets {engine.buckets} in {time.perf_counter() - t0:.1f}s")
+            log.log(
+                f"warmup: compiled buckets {engine.buckets} x sizes {engine.image_sizes} "
+                f"in {time.perf_counter() - t0:.1f}s"
+            )
         if cfg.serve.requests > 0:
-            batcher = MicroBatcher(
-                engine.predict,
+            common = dict(
                 max_batch=cfg.serve.max_batch,
                 max_wait_ms=cfg.serve.max_wait_ms,
                 queue_depth=cfg.serve.queue_depth,
                 default_deadline_ms=cfg.serve.deadline_ms,
-            ).start()
+            )
+            if cfg.serve.pipelined:
+                batcher = PipelinedBatcher(engine, max_inflight=cfg.serve.max_inflight, **common)
+            else:
+                batcher = MicroBatcher(engine.predict, **common)
+            batcher.start()
             try:
                 result.update(_drive_load(cfg, batcher, cfg.data.image_size, log))
             finally:

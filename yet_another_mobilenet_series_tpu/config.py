@@ -348,9 +348,20 @@ class ServeConfig:
     # batch-shape ladder: each request batch pads up to the smallest bucket
     # that fits; every bucket is AOT-compiled at startup (engine warmup)
     buckets: Sequence[int] = (1, 8, 32)
+    # image-size ladder for mixed-size traffic: every (bucket, size) pair is
+    # AOT-warmed so a size shift hits a warm executable, not a recompile
+    # cliff; () = just data.image_size (serve/engine.py)
+    image_sizes: Sequence[int] = ()
     # micro-batcher: coalesce up to max_batch images or max_wait_ms linger
     max_batch: int = 32
     max_wait_ms: float = 2.0
+    # pipelined serving (serve/pipeline.py): a collect/dispatch thread keeps
+    # the device fed via async dispatch while a completion thread syncs —
+    # continuous batching. false = legacy one-thread sync batcher
+    pipelined: bool = True
+    # dispatched-but-unsynced batches the pipeline may hold (2 = double
+    # buffering); bounds device-side memory, backs pressure into the queue
+    max_inflight: int = 2
     # bounded request queue (backpressure: submit rejects when full)
     queue_depth: int = 256
     # per-request deadline; queued-past-deadline requests are shed. 0 = none

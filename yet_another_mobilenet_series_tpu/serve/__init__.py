@@ -12,11 +12,17 @@ training graph re-run with train=False):
   ``InferenceBundle`` (spec JSON via models/serialize schema v2 + npz
   weights) — plus the folded forward pass the engine runs.
 - :mod:`.engine` — bucketed batch shapes with pad-and-slice dispatch to an
-  AOT-compiled per-bucket executable cache, warmup precompile, input-buffer
-  donation, optional data-parallel sharding over parallel/mesh.
+  AOT-compiled ``(bucket, image_size)`` executable cache, async no-sync
+  dispatch (``predict_async`` -> ``PendingPrediction``), reused staging
+  buffers, warmup precompile, input-buffer donation, optional data-parallel
+  sharding over parallel/mesh.
 - :mod:`.batcher` — thread-based micro-batching request queue: coalesce up
   to ``max_batch`` or ``max_wait_ms``, bounded queue for backpressure,
   per-request deadlines with timeout shedding.
+- :mod:`.pipeline` — the pipelined producer/consumer batcher: a collect/
+  dispatch thread keeps the device fed through ``predict_async`` while a
+  completion thread syncs results, bounded by a ``max_inflight`` window
+  (continuous batching; the serving default).
 
 Everything is instrumented through obs/ (``serve/*`` spans, queue-wait and
 run-latency histograms, request/shed counters), so scripts/obs_report.py

@@ -4,7 +4,15 @@ Single-image requests are latency-cheap but throughput-poisonous: the chip
 is happiest at the biggest bucket. The batcher coalesces concurrent
 requests into engine batches — up to ``max_batch`` images or ``max_wait_ms``
 of linger, whichever first — on a dedicated dispatch thread, so clients see
-a Future and the engine sees full buckets.
+a Future and the engine sees full buckets. A coalesced batch of MIXED image
+sizes partitions by shape and dispatches one engine batch per size, each
+hitting its own (bucket, image_size) executable (serve/engine.py ladder).
+
+The collect wait is event-driven, not polled: an idle batcher blocks on the
+queue (zero wakeups/s) and the first request of a burst is picked up the
+moment it lands — ``stop()`` wakes the thread with a queue sentinel instead
+of a poll-interval check. FIFO makes the sentinel double as the drain
+barrier: everything enqueued before ``stop()`` is served first.
 
 Overload behavior is explicit, not emergent:
 
@@ -14,11 +22,15 @@ Overload behavior is explicit, not emergent:
 - **timeout shedding**: a request carrying a deadline that expires while
   still queued is dropped with :class:`DeadlineExceeded` set on its Future —
   the engine never burns a bucket slot on an answer nobody is waiting for.
+  (The pipelined batcher additionally re-checks deadlines at completion —
+  serve/pipeline.py.)
 
 Instrumentation (obs/): ``serve.queue_wait_seconds`` (enqueue -> dispatch),
-``serve.batch_size`` histograms, ``serve.requests`` / ``serve.completed`` /
-``serve.shed_deadline`` / ``serve.rejected_full`` counters — all in the same
-registry every scalars row and obs_registry.json snapshot carries.
+``serve.batch_size`` histograms, ``serve.requests`` (counted only on a
+SUCCESSFUL enqueue — a rejected submit increments ``serve.rejected_full``
+alone, so requests - completed - shed always balances) / ``serve.completed``
+/ ``serve.shed_deadline`` / ``serve.rejected_full`` counters — all in the
+same registry every scalars row and obs_registry.json snapshot carries.
 """
 
 from __future__ import annotations
@@ -33,13 +45,18 @@ import numpy as np
 
 from ..obs.registry import get_registry
 
+# queue sentinel: wakes the (blocking) collect thread for shutdown. FIFO
+# ordering makes everything enqueued before stop() drain ahead of it.
+_STOP = object()
+
 
 class QueueFull(RuntimeError):
     """submit() rejected: the bounded request queue is at queue_depth."""
 
 
 class DeadlineExceeded(RuntimeError):
-    """The request's deadline expired while it was still queued."""
+    """The request's deadline expired while it was still queued (or, on the
+    pipelined path, before its completed batch was synced)."""
 
 
 class _Request:
@@ -50,6 +67,16 @@ class _Request:
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.t_deadline = None if deadline_s is None else self.t_enqueue + deadline_s
+
+
+def _group_by_shape(reqs: list["_Request"]) -> list[list["_Request"]]:
+    """Partition a coalesced batch by image shape (insertion-ordered): mixed
+    image-size traffic dispatches one engine batch per size, each hitting
+    its own (bucket, image_size) executable — never a stack error."""
+    groups: dict[tuple, list[_Request]] = {}
+    for r in reqs:
+        groups.setdefault(r.image.shape, []).append(r)
+    return list(groups.values())
 
 
 class MicroBatcher:
@@ -74,10 +101,17 @@ class MicroBatcher:
         self._max_batch = max_batch
         self._max_wait_s = max_wait_ms / 1e3
         self._default_deadline_s = default_deadline_ms / 1e3 if default_deadline_ms > 0 else None
-        self._q: queue.Queue[_Request] = queue.Queue(maxsize=queue_depth)
+        self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._reg = get_registry()
+        # empty-handed collect returns; stays 0 with the event-driven wait
+        # (pinned by tests) — the old 50 ms poll produced ~20/s while idle
+        self._idle_wakeups = 0
+        # set when the stop sentinel is drawn mid-linger: serve the batch in
+        # hand, then exit (never re-enqueue the sentinel — a full queue would
+        # deadlock the put)
+        self._exit_after_batch = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -85,21 +119,29 @@ class MicroBatcher:
         if self._thread is not None:
             raise RuntimeError("batcher already started")
         self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, name="serve-batcher", daemon=True)
-        self._thread.start()
+        self._start_threads()
         return self
 
+    def _start_threads(self) -> None:
+        self._thread = threading.Thread(target=self._loop, name="serve-batcher", daemon=True)
+        self._thread.start()
+
     def stop(self, drain: bool = True) -> None:
-        """Stop the dispatch thread. ``drain=True`` serves what is already
-        queued first; False fails pending requests immediately."""
+        """Stop the worker thread(s). ``drain=True`` serves what is already
+        queued first (FIFO: the wake sentinel lands behind every pending
+        request); False fails pending requests immediately."""
         if self._thread is None:
             return
         if not drain:
             self._fail_queued(RuntimeError("batcher stopped"))
         self._stop.set()
-        self._thread.join()
+        self._q.put(_STOP)  # wakes the blocking collect; drains ahead of it
+        self._join_threads()
         self._thread = None
         self._fail_queued(RuntimeError("batcher stopped"))
+
+    def _join_threads(self) -> None:
+        self._thread.join()
 
     def _fail_queued(self, exc: Exception) -> None:
         while True:
@@ -107,6 +149,8 @@ class MicroBatcher:
                 req = self._q.get_nowait()
             except queue.Empty:
                 return
+            if req is _STOP:
+                continue
             req.future.set_exception(exc)
 
     # -- client side --------------------------------------------------------
@@ -119,23 +163,23 @@ class MicroBatcher:
             raise RuntimeError("batcher not started")
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
         req = _Request(np.asarray(image, np.float32), deadline_s)
-        self._reg.counter("serve.requests").inc()
         try:
             self._q.put_nowait(req)
         except queue.Full:
             self._reg.counter("serve.rejected_full").inc()
             raise QueueFull(f"request queue at capacity ({self._q.maxsize})") from None
+        self._reg.counter("serve.requests").inc()  # accepted only, after the enqueue
         return req.future
 
     # -- dispatch thread ----------------------------------------------------
 
-    def _collect(self) -> list[_Request]:
-        """Block for the first request, then linger up to max_wait_s (or
-        until max_batch) for companions."""
-        try:
-            first = self._q.get(timeout=0.05)
-        except queue.Empty:
-            return []
+    def _collect(self) -> list[_Request] | None:
+        """Block (no polling) for the first request, then linger up to
+        max_wait_s (or until max_batch) for companions. Returns None when
+        the stop sentinel is drawn first — the thread's exit signal."""
+        first = self._q.get()
+        if first is _STOP:
+            return None
         batch = [first]
         t_close = time.perf_counter() + self._max_wait_s
         while len(batch) < self._max_batch:
@@ -143,36 +187,55 @@ class MicroBatcher:
             if remaining <= 0:
                 break
             try:
-                batch.append(self._q.get(timeout=remaining))
+                nxt = self._q.get(timeout=remaining)
             except queue.Empty:
                 break
+            if nxt is _STOP:
+                # serve this batch, then exit: anything enqueued after the
+                # sentinel is failed by stop()'s final _fail_queued sweep
+                self._exit_after_batch = True
+                break
+            batch.append(nxt)
         return batch
 
+    def _shed_expired(self, batch: list[_Request]) -> list[_Request]:
+        """Dispatch-time deadline check: fail expired requests, record queue
+        wait for the survivors."""
+        now = time.perf_counter()
+        live: list[_Request] = []
+        for req in batch:
+            if req.t_deadline is not None and now > req.t_deadline:
+                self._reg.counter("serve.shed_deadline").inc()
+                req.future.set_exception(
+                    DeadlineExceeded(f"queued {now - req.t_enqueue:.3f}s past deadline")
+                )
+            else:
+                self._reg.histogram("serve.queue_wait_seconds").observe(now - req.t_enqueue)
+                live.append(req)
+        return live
+
     def _loop(self) -> None:
-        while not (self._stop.is_set() and self._q.empty()):
+        while True:
             batch = self._collect()
+            if batch is None:
+                return
             if not batch:
+                self._idle_wakeups += 1
                 continue
-            now = time.perf_counter()
-            live: list[_Request] = []
-            for req in batch:
-                if req.t_deadline is not None and now > req.t_deadline:
-                    self._reg.counter("serve.shed_deadline").inc()
-                    req.future.set_exception(
-                        DeadlineExceeded(f"queued {now - req.t_enqueue:.3f}s past deadline")
-                    )
-                else:
-                    self._reg.histogram("serve.queue_wait_seconds").observe(now - req.t_enqueue)
-                    live.append(req)
-            if not live:
-                continue
-            self._reg.histogram("serve.batch_size").observe(len(live))
+            self._serve_batch(batch)
+            if self._exit_after_batch:
+                return
+
+    def _serve_batch(self, batch: list[_Request]) -> None:
+        live = self._shed_expired(batch)
+        for group in _group_by_shape(live):
+            self._reg.histogram("serve.batch_size").observe(len(group))
             try:
-                logits = self._predict(np.stack([r.image for r in live]))
+                logits = self._predict(np.stack([r.image for r in group]))
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
-                for req in live:
+                for req in group:
                     req.future.set_exception(e)
                 continue
-            for req, row in zip(live, logits):
+            for req, row in zip(group, logits):
                 req.future.set_result(row)
-            self._reg.counter("serve.completed").inc(len(live))
+            self._reg.counter("serve.completed").inc(len(group))

@@ -1,9 +1,10 @@
-"""AOT-batched inference engine: bucketed shapes, pad-and-slice dispatch.
+"""AOT-batched inference engine: bucketed shapes, pipelined async dispatch.
 
 XLA programs are shape-static, so a serving path that jits on the request's
 natural batch size recompiles on every new size — a latency cliff exactly
 when traffic shifts. The engine instead fixes a small ladder of batch
-**buckets** (e.g. 1/8/32), AOT-compiles one executable per bucket at warmup
+**buckets** (e.g. 1/8/32) and an **image-size ladder** (e.g. 192/224/256),
+AOT-compiles one executable per ``(bucket, image_size)`` pair at warmup
 (``jit(...).lower(...).compile()`` — no first-request compile stall), and
 dispatches every batch to the smallest bucket that fits, zero-padding the
 tail rows and slicing them back off the logits. Padding is sound because the
@@ -11,23 +12,45 @@ folded forward is row-independent (no BN batch statistics — the export fold
 removed BN entirely), so the real rows' logits are BITWISE identical to an
 unpadded run of the same bucket (pinned by tests/test_serve.py).
 
+**Async dispatch** is the pipelining primitive: :meth:`predict_async` stages
+and dispatches every chunk of a request and returns a
+:class:`PendingPrediction` WITHOUT syncing — JAX's async dispatch keeps the
+device computing while the host pads/stages the next chunk (or the next
+request entirely; serve/pipeline.py builds continuous batching on top).
+Large requests dispatch ALL chunks before the first ``device_get``; the only
+host<->device sync is :meth:`PendingPrediction.result`. ``predict`` is
+literally ``predict_async(...).result()``, so the two paths share one
+executable and are bitwise-identical by construction.
+
+Tail padding writes into a **reused per-(bucket, size) staging buffer**
+instead of ``np.concatenate([chunk, pad])``: no allocation per dispatch, and
+only the pad rows are re-zeroed. Reuse right after dispatch is safe because
+``jnp.asarray`` copies the host buffer synchronously (the device array never
+aliases the staging memory); the multi-chunk bitwise-parity tests would
+catch any backend that broke that assumption.
+
 Input buffers are donated to the executable (``donate_argnums``): the padded
 batch is engine-private and dead after the call, so XLA may overwrite it
 in-place instead of allocating — on TPU that removes one HBM buffer per
-in-flight request batch. The padded array must never be read after dispatch
-(yamt-lint YAMT008 exists to catch exactly that class of bug).
+in-flight request batch. The donated device array must never be read after
+dispatch (yamt-lint YAMT008 exists to catch exactly that class of bug;
+tests/fixtures/lint/yamt008/clean/async_engine_ok.py pins this engine's
+dispatch shape as clean).
 
 Optional data parallelism: pass a ``parallel/mesh`` mesh and every bucket is
 sharded over its 'data' axis (params replicated) — the eval forward has no
 collectives, so partitioning is pure SPMD batch splitting.
 
-Instrumentation (obs/): ``serve.run_seconds`` / ``serve.infer_images`` /
-``serve.padded_rows`` / per-bucket hit counters in the registry; a
-``serve/run`` span per dispatch.
+Instrumentation (obs/): ``serve.dispatch_seconds`` (host stage+dispatch per
+chunk), ``serve.dispatch_to_complete_seconds`` (first dispatch -> logits on
+host), ``serve.run_seconds`` (predict start -> result done),
+``serve.infer_images`` / ``serve.padded_rows`` / per-bucket hit counters;
+``serve/stage`` + ``serve/dispatch`` + ``serve/complete`` spans.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Sequence
 
@@ -41,9 +64,48 @@ from ..obs.registry import get_registry
 from ..parallel import mesh as mesh_lib
 from .export import InferenceBundle, apply_folded
 
+# bf16 serving parity bar vs the fp32 forward on the same folded weights:
+# bf16 has an 8-bit mantissa (~0.4% relative), accumulated through a deep
+# stack; measured max |logit delta| on the test nets is ~1e-2..1e-1, so the
+# pinned tolerance carries ~3x headroom (tests/test_serve.py pins it, the
+# serve_bench fp32-vs-bf16 A/B records the measured delta per artifact).
+BF16_PARITY_ATOL = 0.35
+
 
 def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+class PendingPrediction:
+    """Device-side handle returned by :meth:`InferenceEngine.predict_async`.
+
+    Holds the dispatched-but-unsynced logits of every chunk; ``result()`` is
+    the ONE host<->device sync (device_get, slice off pad rows, concat) and
+    caches its value, so calling it twice is free. Until then the device is
+    free to still be computing — that's the point.
+    """
+
+    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out")
+
+    def __init__(self, engine: "InferenceEngine", parts, t_start: float, t_dispatched: float):
+        self._engine = engine
+        self._parts = parts  # [(device_logits, real_rows), ...]
+        self._t_start = t_start
+        self._t_dispatched = t_dispatched
+        self._out: np.ndarray | None = None
+
+    def result(self) -> np.ndarray:
+        """Block until every chunk's logits are on host; (N, num_classes)."""
+        if self._out is None:
+            reg = self._engine._reg
+            with obs_trace.get_tracer().span("serve/complete", "serve", chunks=len(self._parts)):
+                outs = [np.asarray(jax.device_get(dev))[:rows] for dev, rows in self._parts]
+            now = time.perf_counter()
+            reg.histogram("serve.dispatch_to_complete_seconds").observe(now - self._t_dispatched)
+            reg.histogram("serve.run_seconds").observe(now - self._t_start)
+            self._out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+            self._parts = ()  # drop the device references as soon as synced
+        return self._out
 
 
 class InferenceEngine:
@@ -51,7 +113,10 @@ class InferenceEngine:
 
     ``predict(images)`` accepts any batch size: requests larger than the
     biggest bucket are chunked, everything else is padded up to the smallest
-    fitting bucket. One host sync per chunk (the device_get of the logits).
+    fitting bucket. ``predict_async`` is the no-sync variant feeding the
+    pipelined batcher. Mixed image sizes hit the ``image_sizes`` ladder's
+    warm executables; a size off the ladder compiles lazily (once) instead
+    of failing, and ``serve.compile_seconds.count`` exposes the cliff.
     """
 
     def __init__(
@@ -63,6 +128,7 @@ class InferenceEngine:
         mesh=None,
         donate_input: bool = True,
         image_size: int | None = None,
+        image_sizes: Sequence[int] | None = None,
     ):
         if not buckets:
             raise ValueError("engine needs at least one batch bucket")
@@ -71,6 +137,9 @@ class InferenceEngine:
             raise ValueError(f"batch buckets must be >= 1, got {self.buckets}")
         self.net: Network = bundle.net
         self.image_size = int(image_size or bundle.net.image_size)
+        self.image_sizes = tuple(sorted(set(int(s) for s in (image_sizes or ())) | {self.image_size}))
+        if self.image_sizes[0] < 1:
+            raise ValueError(f"image sizes must be >= 1, got {self.image_sizes}")
         self._compute_dtype = _dtype(compute_dtype)
         self._mesh = mesh
         self._donate = donate_input
@@ -84,12 +153,16 @@ class InferenceEngine:
             self._params = mesh_lib.replicate(bundle.params, mesh)
         else:
             self._params = jax.tree.map(jnp.asarray, bundle.params)
-        self._compiled: dict[int, jax.stages.Compiled] = {}
+        # executables and staging buffers are keyed (bucket, image_size)
+        self._compiled: dict[tuple[int, int], jax.stages.Compiled] = {}
+        self._staging: dict[tuple[int, int], np.ndarray] = {}
+        # one dispatcher at a time: staging buffers are reused across calls
+        self._dispatch_lock = threading.Lock()
         self._reg = get_registry()
 
     # -- compilation --------------------------------------------------------
 
-    def _build(self, bucket: int):
+    def _build(self, bucket: int, size: int):
         def run(params, x):
             return apply_folded(self.net, params, x, compute_dtype=self._compute_dtype)
 
@@ -100,19 +173,21 @@ class InferenceEngine:
                 mesh_lib.batch_sharding(self._mesh),
             )
         fn = jax.jit(run, donate_argnums=(1,) if self._donate else (), **kwargs)
-        x_shape = jax.ShapeDtypeStruct((bucket, self.image_size, self.image_size, 3), jnp.float32)
+        x_shape = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.float32)
         t0 = time.perf_counter()
-        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket):
+        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket, image_size=size):
             compiled = fn.lower(self._params, x_shape).compile()
         self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
         return compiled
 
     def warmup(self) -> None:
-        """AOT-compile every bucket up front so the first request of any size
-        hits a ready executable, never a compile stall."""
-        for b in self.buckets:
-            if b not in self._compiled:
-                self._compiled[b] = self._build(b)
+        """AOT-compile every (bucket, image_size) pair up front so the first
+        request of any size on the ladder hits a ready executable, never a
+        compile stall."""
+        for s in self.image_sizes:
+            for b in self.buckets:
+                if (b, s) not in self._compiled:
+                    self._compiled[(b, s)] = self._build(b, s)
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -122,40 +197,73 @@ class InferenceEngine:
 
     # -- dispatch -----------------------------------------------------------
 
-    def _run_chunk(self, chunk: np.ndarray) -> np.ndarray:
+    def _stage(self, chunk: np.ndarray, bucket: int, size: int) -> np.ndarray:
+        """Bucket-shaped host array for ``chunk``: the chunk itself when it
+        fills the bucket exactly, else the reused per-(bucket, size) staging
+        buffer with the tail rows zeroed. Only the pad rows are re-zeroed —
+        no per-dispatch allocation, no full-buffer copy."""
+        n = chunk.shape[0]
+        if n == bucket:
+            return np.ascontiguousarray(chunk)
+        key = (bucket, size)
+        buf = self._staging.get(key)
+        if buf is None:
+            buf = self._staging[key] = np.zeros((bucket, size, size, 3), np.float32)
+        buf[:n] = chunk
+        buf[n:] = 0.0
+        self._reg.counter("serve.padded_rows").inc(bucket - n)
+        return buf
+
+    def _dispatch_chunk(self, chunk: np.ndarray, size: int):
+        """Stage + dispatch ONE chunk; returns (device_logits, real_rows)
+        without syncing. The device array handed to the executable is
+        donated; it is never read afterwards (YAMT008 discipline)."""
         n = chunk.shape[0]
         bucket = self._bucket_for(n)
-        if bucket not in self._compiled:
-            self._compiled[bucket] = self._build(bucket)
-        if n < bucket:
-            pad = np.zeros((bucket - n,) + chunk.shape[1:], chunk.dtype)
-            chunk = np.concatenate([chunk, pad], axis=0)
-            self._reg.counter("serve.padded_rows").inc(bucket - n)
-        if self._mesh is not None:
-            x = mesh_lib.shard_batch({"image": chunk}, self._mesh)["image"]
-        else:
-            x = jnp.asarray(chunk)
+        key = (bucket, size)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(bucket, size)
+        tracer = obs_trace.get_tracer()
         t0 = time.perf_counter()
-        with obs_trace.get_tracer().span("serve/run", "serve", bucket=bucket, rows=n):
-            logits = self._compiled[bucket](self._params, x)
-            out = np.asarray(jax.device_get(logits))[:n]
-        self._reg.histogram("serve.run_seconds").observe(time.perf_counter() - t0)
+        with tracer.span("serve/stage", "serve", bucket=bucket, rows=n):
+            staged = self._stage(chunk, bucket, size)
+            if self._mesh is not None:
+                # defensive: device_put's host-read timing is backend-defined,
+                # so never hand the reused staging buffer to the sharded path
+                if staged is self._staging.get(key):
+                    staged = np.array(staged)
+                x = mesh_lib.shard_batch({"image": staged}, self._mesh)["image"]
+            else:
+                # jnp.asarray copies synchronously: the staging buffer is
+                # reusable the moment dispatch returns (parity tests pin it)
+                x = jnp.asarray(staged)
+        with tracer.span("serve/dispatch", "serve", bucket=bucket, image_size=size, rows=n):
+            logits = self._compiled[key](self._params, x)
+        self._reg.histogram("serve.dispatch_seconds").observe(time.perf_counter() - t0)
         self._reg.counter(f"serve.bucket_hits.{bucket}").inc()
-        return out
+        return logits, n
 
-    def predict(self, images: np.ndarray) -> np.ndarray:
-        """(N, H, W, 3) float32 (already normalized, pipeline semantics) ->
-        (N, num_classes) float32 logits. N is unconstrained: > max bucket is
-        served in max-bucket chunks."""
+    def predict_async(self, images: np.ndarray) -> PendingPrediction:
+        """Dispatch without syncing: (N, S, S, 3) float32 -> handle whose
+        ``result()`` yields (N, num_classes) float32 logits. Every chunk of
+        an oversized request is dispatched before the caller can sync, so
+        the device pipeline never drains between chunks."""
         images = np.asarray(images, np.float32)
-        if images.ndim != 4:
-            raise ValueError(f"predict expects (N, H, W, 3), got shape {images.shape}")
+        if images.ndim != 4 or images.shape[1] != images.shape[2]:
+            raise ValueError(f"predict expects (N, S, S, 3), got shape {images.shape}")
         n = images.shape[0]
         if n == 0:
             raise ValueError("empty batch")
+        size = int(images.shape[1])
         self._reg.counter("serve.infer_images").inc(n)
+        t_start = time.perf_counter()
         cap = self.buckets[-1]
-        if n <= cap:
-            return self._run_chunk(images)
-        outs = [self._run_chunk(images[i : i + cap]) for i in range(0, n, cap)]
-        return np.concatenate(outs, axis=0)
+        with self._dispatch_lock:
+            parts = [self._dispatch_chunk(images[i : i + cap], size) for i in range(0, n, cap)]
+        return PendingPrediction(self, parts, t_start, time.perf_counter())
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """(N, S, S, 3) float32 (already normalized, pipeline semantics) ->
+        (N, num_classes) float32 logits. N is unconstrained: > max bucket is
+        served in max-bucket chunks, all dispatched before the single sync."""
+        return self.predict_async(images).result()

@@ -1,0 +1,225 @@
+"""Pipelined continuous batching: collect/dispatch and completion decoupled.
+
+The plain :class:`~.batcher.MicroBatcher` is a one-thread cycle — collect,
+predict (which blocks on the device_get), resolve futures, repeat — so the
+host's collect/pad/stage work and the device's compute strictly alternate:
+while the chip runs a bucket, no requests coalesce, and while the host
+coalesces, the chip idles. BENCH_SERVE_r01 shows the cost (the batch-32
+bucket delivering LOWER QPS than batch-8 on CPU rehearsal).
+
+:class:`PipelinedBatcher` splits the cycle across two threads around the
+engine's async dispatch (serve/engine.py ``predict_async``):
+
+- the **collect thread** gathers a batch, stages + dispatches it via
+  ``predict_async`` (no sync — JAX async dispatch returns as soon as the
+  work is enqueued on the device), and pushes the resulting
+  :class:`~.engine.PendingPrediction` into a bounded in-flight window;
+- the **completion thread** pops handles in dispatch order, blocks on
+  ``result()`` (the only host<->device sync), re-checks deadlines, and
+  resolves the futures.
+
+So the NEXT bucket fills and stages while the PREVIOUS one executes on the
+device — continuous batching. While the window is full the collect thread
+keeps TOPPING UP the batch in hand instead of closing it early: dispatch
+cannot proceed anyway, and a partial bucket pads with dead rows the device
+then computes — under saturation every dispatched bucket arrives full.
+``max_inflight`` bounds the number of dispatched-but-unsynced batches, and
+the slot is reserved BEFORE dispatch, so at most ``max_inflight``
+executions are ever enqueued device-side:
+``1`` = classic double buffering (stage batch k+1 while k computes; never
+two concurrent executions — the right setting when host and "device" share
+cores, i.e. CPU), ``2`` (default) additionally keeps one execution queued
+behind the running one so the device never drains between batches. A full
+window blocks the collect thread, which backs pressure up into the bounded
+submit queue and ultimately :class:`~.batcher.QueueFull`, exactly like the
+sync path.
+
+Failure semantics are preserved, not weakened:
+
+- ``QueueFull`` backpressure and dispatch-time deadline shedding behave as
+  in the sync batcher (shared code);
+- deadlines are ALSO checked at completion: a request whose deadline passed
+  while its batch was executing gets :class:`~.batcher.DeadlineExceeded`
+  instead of a stale answer (``serve.shed_at_completion`` counts these,
+  on top of the shared ``serve.shed_deadline``);
+- an engine failure at dispatch or at sync fails exactly that batch's
+  futures and both threads keep serving;
+- ``stop(drain=True)`` drains the request queue, then the in-flight window,
+  in FIFO order.
+
+Instrumentation (obs/): ``serve.inflight`` gauge (window occupancy at each
+push/pop) plus everything the engine and shared batcher record —
+``serve.dispatch_seconds``, ``serve.dispatch_to_complete_seconds``,
+``serve.batch_size``, ``serve.queue_wait_seconds``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .batcher import _STOP, DeadlineExceeded, MicroBatcher, _Request, _group_by_shape
+
+# in-flight window sentinel: collect thread -> completion thread shutdown
+_DRAINED = object()
+
+
+class PipelinedBatcher(MicroBatcher):
+    """Two-thread continuous batcher over an engine with ``predict_async``.
+
+    ``engine`` needs ``predict_async(images) -> handle`` with a blocking
+    ``handle.result()`` — the :class:`~.engine.InferenceEngine` protocol.
+    Everything client-facing (``submit`` / ``QueueFull`` / deadlines /
+    ``stop``) matches :class:`~.batcher.MicroBatcher`.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        max_inflight: int = 2,
+        max_batch: int = 32,
+        max_wait_ms: float = 2.0,
+        queue_depth: int = 256,
+        default_deadline_ms: float = 0.0,
+    ):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        super().__init__(
+            engine.predict,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_depth=queue_depth,
+            default_deadline_ms=default_deadline_ms,
+        )
+        self._engine = engine
+        self._max_inflight = max_inflight
+        # dispatched-but-unsynced budget, acquired BEFORE each dispatch so
+        # at most max_inflight executions are ever enqueued device-side
+        self._window = threading.BoundedSemaphore(max_inflight)
+        # (handle, live_requests) in dispatch order; the semaphore is the
+        # bound, the queue just carries them to the completion thread
+        self._inflight: queue.Queue = queue.Queue()
+        self._inflight_n = 0
+        self._inflight_lock = threading.Lock()
+        self._completion: threading.Thread | None = None
+
+    def _inflight_adj(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight_n += delta
+            self._reg.gauge("serve.inflight").set(self._inflight_n)
+
+    # -- lifecycle (two threads) --------------------------------------------
+
+    def _start_threads(self) -> None:
+        self._thread = threading.Thread(target=self._collect_loop, name="serve-collect", daemon=True)
+        self._completion = threading.Thread(target=self._complete_loop, name="serve-complete", daemon=True)
+        self._thread.start()
+        self._completion.start()
+
+    def _join_threads(self) -> None:
+        self._thread.join()  # pushes _DRAINED into the in-flight queue on exit
+        self._completion.join()
+        self._completion = None
+
+    # -- collect/dispatch thread --------------------------------------------
+
+    def _collect_loop(self) -> None:
+        try:
+            while True:
+                batch = self._collect()
+                if batch is None:
+                    return
+                if not batch:
+                    self._idle_wakeups += 1
+                    continue
+                self._dispatch_batch(batch)
+                if self._exit_after_batch:
+                    return
+        finally:
+            self._inflight.put(_DRAINED)
+
+    def _acquire_window_topping_up(self, batch: list[_Request]) -> None:
+        """Block until a window slot frees, topping the batch up from the
+        request queue meanwhile. While the window is full nothing can
+        dispatch anyway, so closing a partial batch early would only pad a
+        bucket with dead rows — fill matters more than a head start (the
+        serve_bench fill counters showed exactly this: partial pipelined
+        buckets burning padded compute)."""
+        while not self._window.acquire(blocking=False):
+            if self._exit_after_batch or len(batch) >= self._max_batch:
+                self._window.acquire()
+                return
+            try:
+                nxt = self._q.get(timeout=0.005)
+            except queue.Empty:
+                continue
+            if nxt is _STOP:
+                self._exit_after_batch = True
+            else:
+                batch.append(nxt)
+
+    def _dispatch_batch(self, batch: list[_Request]) -> None:
+        # reserve the slot (window = dispatched-but-unsynced cap) BEFORE
+        # dispatch — backpressure toward submit(); released by completion
+        self._acquire_window_topping_up(batch)
+        live = self._shed_expired(batch)
+        if not live:
+            self._window.release()
+            return
+        # mixed image sizes dispatch one engine batch per size group, each
+        # hitting its own (bucket, image_size) executable; every group past
+        # the first takes its own window slot
+        for i, group in enumerate(_group_by_shape(live)):
+            if i:
+                self._window.acquire()
+            self._reg.histogram("serve.batch_size").observe(len(group))
+            try:
+                handle = self._engine.predict_async(np.stack([r.image for r in group]))
+            except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
+                self._window.release()
+                for req in group:
+                    req.future.set_exception(e)
+                continue
+            self._inflight.put((handle, group))
+            self._inflight_adj(+1)
+
+    # -- completion thread --------------------------------------------------
+
+    def _complete_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is _DRAINED:
+                return
+            handle, live = item
+            try:
+                logits = handle.result()
+            except Exception as e:  # noqa: BLE001 — fail this batch, keep draining
+                self._inflight_adj(-1)
+                self._window.release()
+                for req in live:
+                    req.future.set_exception(e)
+                continue
+            # the device is free the moment the sync returns: open the
+            # window before the host-side future resolution
+            self._inflight_adj(-1)
+            self._window.release()
+            now = time.perf_counter()
+            done = 0
+            for req, row in zip(live, logits):
+                if req.t_deadline is not None and now > req.t_deadline:
+                    # expired while the batch executed: a stale answer is a
+                    # shed, not a success (completion-time deadline check)
+                    self._reg.counter("serve.shed_deadline").inc()
+                    self._reg.counter("serve.shed_at_completion").inc()
+                    req.future.set_exception(
+                        DeadlineExceeded(f"completed {now - req.t_enqueue:.3f}s past deadline")
+                    )
+                else:
+                    req.future.set_result(row)
+                    done += 1
+            if done:
+                self._reg.counter("serve.completed").inc(done)
