@@ -90,6 +90,34 @@ def summarize(log_dir: str) -> str:
                     f"  shed at completion: {snap['serve.shed_at_completion']:.0f} "
                     "(deadline passed while the batch executed)"
                 )
+            # the QoS/resilience edge (serve/admission.py) — per-class
+            # accounting + breaker/retry/drain health, when it was in play
+            classes = sorted(
+                k.rsplit(".", 1)[-1] for k in snap
+                if k.startswith("serve.requests.") and not k.endswith((".count", ".sum", ".mean", ".max"))
+            )
+            for cls in classes:
+                lat = f"serve.latency_seconds.{cls}"
+                row = (
+                    f"  [{cls}] admitted = {snap.get(f'serve.requests.{cls}', 0):.0f}, "
+                    f"completed = {snap.get(f'serve.completed.{cls}', 0):.0f}, "
+                    f"rejected = {snap.get(f'serve.rejected.{cls}', 0):.0f}"
+                )
+                if snap.get(f"{lat}.count"):
+                    row += (f", latency mean {snap[f'{lat}.mean'] * 1e3:.2f} ms "
+                            f"max {snap[f'{lat}.max'] * 1e3:.2f} ms")
+                lines.append(row)
+            if classes or snap.get("serve.breaker_opens") or snap.get("serve.retries"):
+                breaker = {0: "closed", 1: "OPEN", 2: "half-open"}.get(
+                    int(snap.get("serve.breaker_state", 0)), "?")
+                lines.append(
+                    f"  resilience: breaker {breaker} "
+                    f"(opened {snap.get('serve.breaker_opens', 0):.0f}x), "
+                    f"retries = {snap.get('serve.retries', 0):.0f}, "
+                    f"engine failures = {snap.get('serve.engine_failures', 0):.0f}, "
+                    f"drain timeouts = {snap.get('serve.drain_timeouts', 0):.0f}, "
+                    f"thread crashes = {snap.get('serve.thread_crashes', 0):.0f}"
+                )
             hits = {k.rsplit(".", 1)[-1]: v for k, v in snap.items() if k.startswith("serve.bucket_hits.")}
             if hits:
                 lines.append("  bucket hits: " + ", ".join(f"{b}: {v:.0f}" for b, v in sorted(hits.items(), key=lambda kv: int(kv[0]))))

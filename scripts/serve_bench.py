@@ -1,10 +1,10 @@
 #!/usr/bin/env python
-"""Serving benchmark: latency/QPS per bucket + pipelined/bf16 A/B.
+"""Serving benchmark: latency/QPS per bucket + pipelined/bf16/chaos A/Bs.
 
 Prints exactly ONE JSON line on stdout in the bench.py artifact shape
 (tests/test_bench_contract.py contract: exit 0 always; a failed run emits
 ``value: null`` with an ``error`` field, never a stack trace) and optionally
-writes it to a BENCH_SERVE_*.json via --out. Three measurements per run:
+writes it to a BENCH_SERVE_*.json via --out. Four measurements per run:
 
 1. **direct** — engine.predict latency per (bucket, image_size), exact-bucket
    batches: p50/p99 ms + QPS (the BENCH_SERVE_r01 shape, now per size).
@@ -17,6 +17,17 @@ writes it to a BENCH_SERVE_*.json via --out. Three measurements per run:
 3. **fp32-vs-bf16 A/B** — a second engine with compute_dtype=bfloat16,
    direct QPS per bucket plus the measured max |logit delta| vs fp32
    against the pinned BF16_PARITY_ATOL (serve/engine.py).
+4. **chaos A/B** — an OPEN-LOOP Poisson load generator (arrivals fire on
+   schedule regardless of completions — closed loops hide overload) drives
+   mixed priorities (interactive/batch/best_effort via serve/admission.py)
+   and mixed image sizes through the pipelined batcher twice: a healthy
+   round and a faulty round (serve/faults.py: seeded failure rate + latency
+   spikes at the completion edge). Per class: submitted / completed /
+   rejected / shed / failed / p50 / p99, plus retry, injected-fault,
+   rejection-cause, and breaker accounting from the obs registry deltas —
+   and the invariant that EVERY request resolved (``unresolved`` must be
+   0). Both rounds share one arrival schedule (same seed), so the delta is
+   the injected faults, not the load draw.
 
 The model is random-init + synthetic BN stats, folded through the real
 serve/export transform and dispatched through the real AOT engine — the
@@ -25,7 +36,9 @@ does not depend on trained weight values.
 
 Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--image-sizes 224] [--buckets 1,8,32] [--iters 10]
-           [--concurrent-iters 6] [--ab-iters 5] [--no-bf16] [--out f.json]
+           [--concurrent-iters 6] [--ab-iters 5] [--no-bf16]
+           [--chaos-requests 80] [--chaos-qps 0] [--chaos-fault-rate 0.05]
+           [--no-chaos] [--out f.json]
 """
 
 from __future__ import annotations
@@ -159,7 +172,154 @@ def _concurrent_row(engine, batch, size, conc_iters, max_inflight, rng):
     return row
 
 
-def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_inflight, with_bf16):
+_CHAOS_CLASS_MIX = {"interactive": 0.5, "batch": 0.3, "best_effort": 0.2}
+
+
+def _chaos_round(engine, image_sizes, *, seed, n_requests, target_qps,
+                 deadline_ms_by_class, fault_kwargs=None, max_retries=2):
+    """One open-loop Poisson round through batcher + admission control.
+
+    Arrivals are pre-drawn from the seed (both A/B rounds share them), fire
+    on schedule regardless of completions, and every request is resolved at
+    the end — a hang shows up as ``unresolved`` > 0, never a stuck bench."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.admission import AdmissionController
+    from yet_another_mobilenet_series_tpu.serve.batcher import DeadlineExceeded, DrainTimeout
+    from yet_another_mobilenet_series_tpu.serve.faults import FaultyEngine
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+    reg = get_registry()
+    if fault_kwargs:
+        engine = FaultyEngine(engine, **fault_kwargs)
+    batcher = PipelinedBatcher(
+        engine, max_batch=8, max_wait_ms=5.0, queue_depth=256, drain_timeout_s=60.0
+    ).start()
+    admission = AdmissionController(
+        batcher, max_retries=max_retries, retry_backoff_ms=5.0,
+        breaker_threshold=10, breaker_cooldown_s=0.5, seed=seed,
+    )
+    rs = np.random.RandomState(seed)
+    classes, probs = zip(*sorted(_CHAOS_CLASS_MIX.items()))
+    draws_cls = [classes[i] for i in rs.choice(len(classes), size=n_requests, p=probs)]
+    draws_size = [image_sizes[i] for i in rs.randint(0, len(image_sizes), size=n_requests)]
+    gaps = rs.exponential(1.0 / target_qps, size=n_requests)
+    images = {s: rs.normal(0, 1, (s, s, 3)).astype("float32") for s in image_sizes}
+
+    stats = {c: {"submitted": 0, "completed": 0, "rejected": 0, "shed": 0, "failed": 0,
+                 "latencies": []} for c in classes}
+    pending = []
+    s0 = reg.snapshot()
+    t_start = time.perf_counter()
+    t_next = t_start
+    for i in range(n_requests):
+        t_next += gaps[i]
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)  # open loop: the schedule, not completions, paces us
+        cls = draws_cls[i]
+        stats[cls]["submitted"] += 1
+        t0 = time.perf_counter()
+        try:
+            fut = admission.submit(
+                images[draws_size[i]], priority=cls,
+                deadline_ms=deadline_ms_by_class.get(cls),
+            )
+        except Exception:  # noqa: BLE001 — typed arrival rejection (quota/breaker/deadline)
+            stats[cls]["rejected"] += 1
+            continue
+        pending.append((cls, t0, fut))
+    unresolved = 0
+    for cls, t0, fut in pending:
+        try:
+            fut.result(timeout=300)
+            stats[cls]["completed"] += 1
+            stats[cls]["latencies"].append(time.perf_counter() - t0)
+        except (DeadlineExceeded, DrainTimeout):
+            stats[cls]["shed"] += 1
+        except FutTimeout:
+            unresolved += 1  # a real hang: the no-client-ever-hangs invariant broke
+        except Exception:  # noqa: BLE001 — typed engine failure (injected or real)
+            stats[cls]["failed"] += 1
+    wall = time.perf_counter() - t_start
+    batcher.stop()
+    s1 = reg.snapshot()
+
+    def delta(key):
+        return s1.get(key, 0) - s0.get(key, 0)
+
+    out = {
+        "wall_s": round(wall, 3),
+        "qps": round(sum(s["completed"] for s in stats.values()) / wall, 2) if wall else 0.0,
+        "unresolved": unresolved,
+        "retries": delta("serve.retries"),
+        "injected_failures": delta("serve.faults.failures"),
+        "injected_delays": delta("serve.faults.delays"),
+        "breaker_opens": delta("serve.breaker_opens"),
+        "rejected_total": delta("serve.rejected"),
+        "rejected_deadline": delta("serve.rejected_deadline"),
+        "rejected_class_full": delta("serve.rejected_class_full"),
+        "rejected_breaker": delta("serve.rejected_breaker"),
+        "rejected_queue_full": delta("serve.rejected_full"),
+        "shed_deadline": delta("serve.shed_deadline"),
+        "classes": {},
+    }
+    for cls in classes:
+        s = stats[cls]
+        lat = sorted(s.pop("latencies"))
+        out["classes"][cls] = {
+            **s,
+            "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            "qps": round(s["completed"] / wall, 2) if wall else 0.0,
+        }
+    return out
+
+
+def _chaos_ab(engine, image_sizes, direct_rows, *, seed, n_requests, target_qps, fault_rate):
+    """Healthy vs fault-injected open-loop rounds (one arrival schedule)."""
+    base_size = image_sizes[0]
+    t1_s = next(
+        (r["p50_ms"] / 1e3 for r in direct_rows if r["batch"] == min(x["batch"] for x in direct_rows)
+         and r["image_size"] == base_size),
+        0.05,
+    ) or 0.05
+    if target_qps <= 0:
+        # auto: what serial single-image serving would sustain — the batcher
+        # absorbs it; the faulty round then shows what the faults cost
+        target_qps = max(2.0, 1.0 / t1_s)
+    deadline_ms_by_class = {
+        "interactive": max(50.0, 40 * t1_s * 1e3),  # tight-ish: sheds under spikes
+        "batch": max(500.0, 200 * t1_s * 1e3),
+        # best_effort carries no deadline: it sheds via class quota instead
+    }
+    fault_kwargs = {
+        "seed": seed,
+        "failure_rate": fault_rate,
+        "fail_at": "result",  # the completion edge, where retries must reach
+        "latency_s": 3 * t1_s,
+        "latency_rate": fault_rate,
+    }
+    common = dict(seed=seed, n_requests=n_requests, target_qps=target_qps,
+                  deadline_ms_by_class=deadline_ms_by_class)
+    return {
+        "requests": n_requests,
+        "target_qps": round(target_qps, 2),
+        "seed": seed,
+        "class_mix": _CHAOS_CLASS_MIX,
+        "deadline_ms": {k: round(v, 1) for k, v in deadline_ms_by_class.items()},
+        "fault": {"failure_rate": fault_rate, "latency_ms": round(3 * t1_s * 1e3, 1),
+                  "latency_rate": fault_rate, "fail_at": "result"},
+        "healthy": _chaos_round(engine, image_sizes, **common),
+        "faulty": _chaos_round(engine, image_sizes, fault_kwargs=fault_kwargs, **common),
+    }
+
+
+def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_inflight, with_bf16,
+            chaos_requests=0, chaos_qps=0.0, chaos_fault_rate=0.05, chaos_seed=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -240,8 +400,15 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
             "parity_atol": BF16_PARITY_ATOL,
             "parity_ok": delta <= BF16_PARITY_ATOL,
         }
+    chaos = None
+    if chaos_requests > 0:
+        chaos = _chaos_ab(
+            engine, list(engine.image_sizes), direct_rows,
+            seed=chaos_seed, n_requests=chaos_requests,
+            target_qps=chaos_qps, fault_rate=chaos_fault_rate,
+        )
     dev = jax.devices()[0]
-    return {
+    out = {
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "n_chips": len(jax.devices()),
@@ -251,6 +418,9 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
         "ab": ab,
         "peak_qps": max([peak_pipe, peak_sync] + [r["qps"] for r in direct_rows]),
     }
+    if chaos is not None:
+        out["chaos"] = chaos
+    return out
 
 
 def main(argv=None) -> int:
@@ -266,6 +436,15 @@ def main(argv=None) -> int:
                     help="pipelined window; 1 = pure double buffering (stage||compute, no "
                          "concurrent executions — best when host and device share cores)")
     ap.add_argument("--no-bf16", action="store_true", help="skip the fp32-vs-bf16 A/B")
+    ap.add_argument("--chaos-requests", type=int, default=80,
+                    help="open-loop Poisson requests per chaos round (healthy + faulty)")
+    ap.add_argument("--chaos-qps", type=float, default=0.0,
+                    help="open-loop arrival rate; 0 = auto from the measured single-image p50")
+    ap.add_argument("--chaos-fault-rate", type=float, default=0.05,
+                    help="injected failure AND latency-spike probability in the faulty round")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed for arrivals, class/size mix, and the fault schedule")
+    ap.add_argument("--no-chaos", action="store_true", help="skip the chaos A/B")
     ap.add_argument("--out", default="", help="also write the JSON artifact here")
     args = ap.parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
@@ -284,7 +463,10 @@ def main(argv=None) -> int:
     try:
         m = measure(args.arch, image_sizes, buckets, max(1, args.iters),
                     max(1, args.concurrent_iters), max(1, args.ab_iters),
-                    max(1, args.max_inflight), not args.no_bf16)
+                    max(1, args.max_inflight), not args.no_bf16,
+                    chaos_requests=0 if args.no_chaos else max(1, args.chaos_requests),
+                    chaos_qps=args.chaos_qps, chaos_fault_rate=args.chaos_fault_rate,
+                    chaos_seed=args.chaos_seed)
         out.update(m)
         out["value"] = m["peak_qps"]
     except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0

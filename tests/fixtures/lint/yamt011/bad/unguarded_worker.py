@@ -1,0 +1,51 @@
+"""BAD: thread targets without a top-level exception guard — every shape
+the rule must catch (plain def, nested closure, self.method, try/finally
+without except, lambda)."""
+
+import threading
+
+
+def worker(q):
+    while True:  # an exception here kills the thread silently
+        item = q.get()
+        item.process()
+
+
+def start_worker(q):
+    t = threading.Thread(target=worker, args=(q,), daemon=True)
+    t.start()
+    return t
+
+
+def start_closure_worker(q):
+    def drain():
+        while True:
+            q.get().process()
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    return t
+
+
+def finally_is_not_a_guard(q):
+    def run():
+        try:
+            q.get().process()
+        finally:
+            q.close()  # the exception still escapes and kills the thread
+
+    return threading.Thread(target=run)
+
+
+def lambda_target(q):
+    return threading.Thread(target=lambda: q.get().process())
+
+
+class Server:
+    def _loop(self):
+        while True:
+            self.step()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, name="srv", daemon=True)
+        self._thread.start()

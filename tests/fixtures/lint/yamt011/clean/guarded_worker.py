@@ -1,0 +1,63 @@
+"""CLEAN: every thread target carries a top-level try/except guard (setup
+statements before the try are fine), opaque targets degrade to silence."""
+
+import functools
+import threading
+
+from . import helpers  # noqa: F401 — stands in for a cross-module callable
+
+
+def worker(q):
+    """Docstrings and setup bindings before the guard are allowed."""
+    backoff = 0.01
+    try:
+        while True:
+            item = q.get()
+            item.process(backoff)
+    except Exception:
+        q.fail_all("worker crashed")
+
+
+def start_worker(q):
+    t = threading.Thread(target=worker, args=(q,), daemon=True)
+    t.start()
+    return t
+
+
+def start_closure_worker(q):
+    def drain():
+        try:
+            while True:
+                q.get().process()
+        except Exception:
+            q.fail_all("drain crashed")
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    return t
+
+
+class Server:
+    def _loop(self):
+        try:
+            self._loop_inner()
+        except Exception as e:
+            self._crashed(e)
+
+    def _loop_inner(self):
+        while True:
+            self.step()
+
+    def _crashed(self, e):
+        self.log(e)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, name="srv", daemon=True)
+        self._thread.start()
+
+
+def opaque_targets_are_silent(q):
+    # callables the file cannot see into: no finding, no noise
+    t1 = threading.Thread(target=helpers.run, daemon=True)
+    t2 = threading.Thread(target=functools.partial(worker, q), daemon=True)
+    return t1, t2

@@ -76,7 +76,8 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
          "--arch", "tiny", "--image-sizes", "24,32", "--buckets", "2,4", "--iters", "3",
-         "--concurrent-iters", "2", "--ab-iters", "2", "--out", str(out_path)],
+         "--concurrent-iters", "2", "--ab-iters", "2",
+         "--chaos-requests", "40", "--chaos-fault-rate", "0.3", "--out", str(out_path)],
         capture_output=True, text=True, timeout=420, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-1000:]
@@ -116,10 +117,85 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     assert bf["peak_qps_bf16"] > 0 and bf["peak_qps_fp32"] > 0
     assert bf["max_abs_logit_delta"] >= 0
     assert bf["parity_ok"] and bf["max_abs_logit_delta"] <= bf["parity_atol"]
+    # chaos A/B: open-loop Poisson rounds with mixed priorities/sizes — the
+    # books must balance per class and NOTHING may hang (unresolved == 0);
+    # the healthy round must be failure-free (injected-fault counts are
+    # dispatch-granular and timing-dependent under coalescing, so the tiny
+    # preset pins structure + invariants; the checked-in r03 rehearsal pins
+    # the measured retry/injection accounting)
+    chaos = out["chaos"]
+    assert chaos["requests"] == 40 and chaos["target_qps"] > 0
+    assert set(chaos["class_mix"]) == {"interactive", "batch", "best_effort"}
+    for round_name in ("healthy", "faulty"):
+        rnd = chaos[round_name]
+        assert rnd["unresolved"] == 0, f"{round_name}: a client hung"
+        submitted = 0
+        for cls, s in rnd["classes"].items():
+            assert s["submitted"] == s["completed"] + s["rejected"] + s["shed"] + s["failed"], (
+                round_name, cls, s)
+            submitted += s["submitted"]
+            if s["completed"]:
+                assert s["p99_ms"] >= s["p50_ms"] > 0
+        assert submitted == chaos["requests"]
+        assert rnd["qps"] > 0
+    healthy = chaos["healthy"]
+    assert healthy["injected_failures"] == 0 and healthy["breaker_opens"] == 0
+    assert all(s["failed"] == 0 for s in healthy["classes"].values())
+    faulty = chaos["faulty"]
+    assert chaos["fault"]["failure_rate"] == 0.3
+    # arrival-time rejection causes decompose the total
+    for rnd in (healthy, faulty):
+        assert rnd["rejected_total"] == (
+            rnd["rejected_deadline"] + rnd["rejected_class_full"]
+            + rnd["rejected_breaker"] + rnd["rejected_queue_full"])
     # the headline value is the overall peak across direct + concurrent
     assert out["value"] == out["peak_qps"] >= max(r["qps"] for r in out["buckets"])
     # --out writes the same artifact for the driver to collect
     assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_r03_chaos_rehearsal_artifact():
+    """The r03 cpu_rehearsal artifact pins the chaos A/B acceptance: a
+    healthy open-loop Poisson round and a seeded 5%-fault round over mixed
+    priorities, per-class accounting balanced, nothing unresolved, retries
+    absorbing injected failures, and the faulty round still serving (the
+    resilience edge degrades gracefully instead of collapsing)."""
+    with open(os.path.join(REPO, "BENCH_SERVE_r03_cpu_rehearsal.json")) as f:
+        out = json.load(f)
+    assert out["platform"] == "cpu" and "error" not in out
+    chaos = out["chaos"]
+    assert chaos["fault"]["failure_rate"] == 0.05
+    for round_name in ("healthy", "faulty"):
+        rnd = chaos[round_name]
+        assert rnd["unresolved"] == 0, f"{round_name}: a request hung"
+        submitted = 0
+        for cls, s in rnd["classes"].items():
+            assert s["submitted"] == s["completed"] + s["rejected"] + s["shed"] + s["failed"], (
+                round_name, cls, s)
+            submitted += s["submitted"]
+        assert submitted == chaos["requests"]
+        assert rnd["rejected_total"] == (
+            rnd["rejected_deadline"] + rnd["rejected_class_full"]
+            + rnd["rejected_breaker"] + rnd["rejected_queue_full"])
+        assert rnd["qps"] > 0
+    healthy, faulty = chaos["healthy"], chaos["faulty"]
+    assert healthy["injected_failures"] == 0
+    assert all(s["failed"] == 0 for s in healthy["classes"].values())
+    # the faulty round really injected faults, and the edge responded:
+    # every injected failure was retried or surfaced typed — and the
+    # service kept serving a comparable share of the load
+    assert faulty["injected_failures"] >= 1
+    assert faulty["retries"] >= 1
+    total_completed = {
+        r: sum(s["completed"] for s in chaos[r]["classes"].values())
+        for r in ("healthy", "faulty")
+    }
+    assert total_completed["faulty"] >= 0.5 * total_completed["healthy"]
+    # per-class latency quantiles exist for every class that completed work
+    for rnd in (healthy, faulty):
+        for cls, s in rnd["classes"].items():
+            if s["completed"]:
+                assert s["p99_ms"] >= s["p50_ms"] > 0, (cls, s)
 
 
 def test_serve_bench_checked_in_rehearsal_artifact():

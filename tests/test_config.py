@@ -56,6 +56,36 @@ def test_cli_rejects_garbage():
         cfg_lib.parse_cli(["not-an-arg"])
 
 
+def test_nested_serve_blocks_parse_and_override():
+    """The front-door sub-sections (serve.listen / serve.admission /
+    serve.faults) are real config sections: nested dict input, dotted CLI
+    overrides, unknown-key rejection — two levels deep."""
+    cfg = cfg_lib.config_from_dict({
+        "serve": {
+            "drain_timeout_s": 3.5,
+            "listen": {"enable": True, "port": 8181},
+            "admission": {"weights": [4, 2, 1], "breaker_threshold": 7},
+            "faults": {"enable": True, "failure_rate": 0.05, "hang_at": 3},
+        }
+    })
+    assert cfg.serve.drain_timeout_s == 3.5
+    assert cfg.serve.listen.enable is True and cfg.serve.listen.port == 8181
+    assert cfg.serve.listen.host == "127.0.0.1"  # default preserved
+    assert cfg.serve.admission.weights == (4, 2, 1)
+    assert cfg.serve.admission.breaker_threshold == 7
+    assert cfg.serve.faults.enable and cfg.serve.faults.hang_at == 3
+    # dotted CLI overrides reach two levels down (+ the --listen sugar path
+    # is just this key)
+    cfg = cfg_lib.parse_cli(
+        ["serve.listen.enable=true", "serve.admission.max_retries=5", "serve.faults.seed=9"])
+    assert cfg.serve.listen.enable is True
+    assert cfg.serve.admission.max_retries == 5 and cfg.serve.faults.seed == 9
+    with pytest.raises(KeyError):
+        cfg_lib.config_from_dict({"serve": {"listen": {"prot": 1}}})
+    with pytest.raises(KeyError):
+        cfg_lib.config_from_dict({"serve": {"admission": {"breaker": 1}}})
+
+
 def test_shipped_apps_parse():
     apps_dir = os.path.join(os.path.dirname(cfg_lib.__file__), "apps")
     ymls = [f for f in os.listdir(apps_dir) if f.endswith(".yml")]

@@ -1,0 +1,372 @@
+"""The HTTP front door (serve/frontend.py + serve/admission.py, ROADMAP
+item 1): priority + deadline headers propagate end-to-end, every failure
+mode maps to a typed HTTP status, /healthz reflects breaker + queue state,
+and `cli/serve.py --listen` survives real traffic and drains on SIGTERM
+within serve.drain_timeout_s.
+
+Most tests drive the real HTTP server over loopback against a pure-host
+engine double (fast); the one subprocess test exercises the full
+train-less path — bundle -> engine -> batcher -> admission -> HTTP -> drain
+— with a real compiled engine and a real SIGTERM.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+from yet_another_mobilenet_series_tpu.serve.admission import AdmissionController
+from yet_another_mobilenet_series_tpu.serve.faults import FaultyEngine
+from yet_another_mobilenet_series_tpu.serve.frontend import Frontend
+from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _row_id_predict(images):
+    return images[:, 0, 0, :1]
+
+
+class _EchoEngine:
+    def __init__(self, block=None):
+        self.block = block
+
+    def predict_async(self, images):
+        block = self.block
+
+        class _Handle:
+            def result(_self):
+                if block is not None:
+                    assert block.wait(10)
+                return _row_id_predict(images)
+
+        return _Handle()
+
+    def predict(self, images):
+        return self.predict_async(images).result()
+
+
+def _stack(engine=None, *, max_retries=2, breaker_threshold=5, breaker_cooldown_s=0.2,
+           weights=(8.0, 3.0, 1.0), queue_depth=64, max_batch=8, reject_unmeetable=True):
+    b = PipelinedBatcher(
+        engine or _EchoEngine(), max_batch=max_batch, max_wait_ms=1.0,
+        queue_depth=queue_depth, drain_timeout_s=2.0,
+    ).start()
+    ac = AdmissionController(
+        b, weights=weights, max_retries=max_retries, retry_backoff_ms=1.0,
+        breaker_threshold=breaker_threshold, breaker_cooldown_s=breaker_cooldown_s,
+        reject_unmeetable=reject_unmeetable,
+    )
+    fe = Frontend(ac, port=0).start()
+    return b, ac, fe
+
+
+def _request(url, *, data=None, headers=None, method=None):
+    """(status, parsed json body, response headers) without raising on 4xx/5xx."""
+    req = urllib.request.Request(url, data=data, headers=headers or {}, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def _post_image(base, val, *, priority=None, deadline_ms=None):
+    headers = {"Content-Type": "application/json"}
+    if priority:
+        headers["X-Priority"] = priority
+    if deadline_ms is not None:
+        headers["X-Deadline-Ms"] = str(deadline_ms)
+    img = np.full((4, 4, 3), float(val), np.float32).tolist()
+    return _request(base + "/predict", data=json.dumps({"image": img}).encode(), headers=headers)
+
+
+# ---------------------------------------------------------------------------
+# request/response semantics
+# ---------------------------------------------------------------------------
+
+
+def test_predict_json_round_trip_with_priority_and_deadline():
+    b, ac, fe = _stack()
+    try:
+        status, doc, _ = _post_image(fe.url, 7, priority="batch", deadline_ms=5000)
+        assert status == 200
+        assert doc["priority"] == "batch"
+        assert doc["logits"] == [7.0]
+        snap = get_registry().snapshot()
+        assert snap["serve.requests.batch"] >= 1  # the header reached admission
+        assert snap["serve.latency_seconds.batch.count"] >= 1
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_predict_raw_tensor_body():
+    b, ac, fe = _stack()
+    try:
+        img = np.full((4, 4, 3), 5.0, np.float32)
+        status, doc, _ = _request(
+            fe.url + "/predict", data=img.tobytes(),
+            headers={"Content-Type": "application/octet-stream", "X-Shape": "4,4,3"},
+        )
+        assert status == 200 and doc["logits"] == [5.0]
+        # shape mismatch is a 400, not a crash
+        status, doc, _ = _request(
+            fe.url + "/predict", data=img.tobytes(),
+            headers={"Content-Type": "application/octet-stream", "X-Shape": "8,8,3"},
+        )
+        assert status == 400 and doc["error"] == "bad_request"
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_malformed_requests_get_400_and_404():
+    b, ac, fe = _stack()
+    try:
+        for payload in [b"not json", json.dumps({"not_image": 1}).encode(),
+                        json.dumps({"image": [1.0, 2.0]}).encode()]:
+            status, doc, _ = _request(fe.url + "/predict", data=payload,
+                                      headers={"Content-Type": "application/json"})
+            assert status == 400 and doc["error"] == "bad_request"
+        status, doc, _ = _post_image(fe.url, 1, priority="platinum")
+        assert status == 400 and "platinum" in doc["message"]
+        assert _request(fe.url + "/nope", data=b"x")[0] == 404
+        assert _request(fe.url + "/nope")[0] == 404
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_deadline_shed_maps_to_504():
+    gate = threading.Event()
+    b = PipelinedBatcher(_EchoEngine(block=gate), max_batch=1, max_inflight=1,
+                         max_wait_ms=0.0, queue_depth=64, drain_timeout_s=5.0).start()
+    ac = AdmissionController(b, max_retries=2, retry_backoff_ms=1.0, reject_unmeetable=False)
+    fe = Frontend(ac, port=0).start()
+    try:
+        # request 0 wedges the single in-flight slot; request 1's deadline
+        # expires while it waits behind it -> shed -> 504
+        responses = {}
+
+        def post(i, deadline_ms):
+            responses[i] = _post_image(fe.url, i, deadline_ms=deadline_ms)
+
+        slow = threading.Thread(target=post, args=(0, 30000), daemon=True)
+        doomed = threading.Thread(target=post, args=(1, 40.0), daemon=True)
+        slow.start()
+        time.sleep(0.1)
+        doomed.start()
+        time.sleep(0.2)  # deadline 1 expires while the window is wedged
+        gate.set()
+        slow.join(timeout=30)
+        doomed.join(timeout=30)
+        assert responses[0][0] == 200
+        status, doc, _ = responses[1]
+        assert status == 504 and doc["error"] == "deadline_exceeded"
+    finally:
+        gate.set()
+        fe.stop()
+        b.stop()
+
+
+def test_breaker_drill_over_http_and_healthz():
+    """Engine errors surface as 500s, the streak opens the breaker (503 +
+    Retry-After, healthz flips to 503/open), the cooldown probe closes it
+    (healthz back to 200/closed)."""
+    eng = FaultyEngine(_EchoEngine(), fail_first_n=3)
+    b, ac, fe = _stack(eng, max_retries=0, breaker_threshold=3, breaker_cooldown_s=0.3)
+    try:
+        status, doc, _ = _request(fe.url + "/healthz")
+        assert status == 200 and doc["ok"] and doc["breaker"] == "closed"
+        assert set(doc["classes"]) == {"interactive", "batch", "best_effort"}
+        for _ in range(3):
+            status, doc, _ = _post_image(fe.url, 1)
+            assert status == 500 and doc["error"] == "engine_error"
+        status, doc, headers = _post_image(fe.url, 1)
+        assert status == 503 and doc["error"] == "breaker_open"
+        assert float(headers["Retry-After"]) >= 0
+        status, doc, _ = _request(fe.url + "/healthz")
+        assert status == 503 and doc["breaker"] == "open" and not doc["ok"]
+        time.sleep(0.35)  # cooldown -> the next predict is the half-open probe
+        status, doc, _ = _post_image(fe.url, 6)
+        assert status == 200 and doc["logits"] == [6.0]
+        status, doc, _ = _request(fe.url + "/healthz")
+        assert status == 200 and doc["breaker"] == "closed"
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_class_quota_rejections_map_to_429():
+    """best_effort floods 429 at their weighted share while interactive
+    still admits — the QoS point of per-class admission."""
+    gate = threading.Event()
+    b, ac, fe = _stack(_EchoEngine(block=gate), weights=(8.0, 3.0, 1.0),
+                       queue_depth=12, max_batch=1)
+    try:
+        results = {"ok_or_pending": 0, "rejected": 0}
+        lock = threading.Lock()
+
+        def flood(i):
+            status, doc, _ = _post_image(fe.url, i, priority="best_effort", deadline_ms=30000)
+            with lock:
+                if status == 429:
+                    assert doc["error"] == "queue_full"
+                    results["rejected"] += 1
+                else:
+                    results["ok_or_pending"] += 1
+
+        threads = [threading.Thread(target=flood, args=(i,), daemon=True) for i in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # floods are queued/rejected; engine still wedged
+        # interactive has its own share: admitted despite the flood
+        status_doc = {}
+
+        def interactive():
+            status_doc["r"] = _post_image(fe.url, 9, priority="interactive", deadline_ms=30000)
+
+        it = threading.Thread(target=interactive, daemon=True)
+        it.start()
+        time.sleep(0.2)
+        gate.set()
+        it.join(timeout=30)
+        for t in threads:
+            t.join(timeout=30)
+        status, doc, _ = status_doc["r"]
+        assert status == 200 and doc["logits"] == [9.0]
+        assert results["rejected"] >= 1  # the flood hit its quota
+    finally:
+        gate.set()
+        fe.stop()
+        b.stop()
+
+
+def test_reject_unmeetable_deadline_at_arrival():
+    """Once the latency EWMA knows the service is slow, a request whose
+    deadline cannot be met is rejected at ARRIVAL (429 deadline_unmeetable),
+    before burning a queue slot."""
+    class _Slow(_EchoEngine):
+        def predict_async(self, images):
+            time.sleep(0.05)
+            return super().predict_async(images)
+
+    b, ac, fe = _stack(_Slow(), max_batch=1)
+    try:
+        assert _post_image(fe.url, 1)[0] == 200  # teaches the EWMA ~50ms
+        assert ac.predicted_wait_s() > 0.01
+        status, doc, _ = _post_image(fe.url, 2, deadline_ms=1.0)
+        assert status == 429 and doc["error"] == "deadline_unmeetable"
+        assert get_registry().snapshot()["serve.rejected_deadline"] >= 1
+        # a meetable deadline still admits
+        assert _post_image(fe.url, 3, deadline_ms=30000)[0] == 200
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_concurrent_http_clients_route_rows():
+    b, ac, fe = _stack()
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def client(i):
+            status, doc, _ = _post_image(fe.url, i, priority=("interactive", "batch")[i % 2])
+            with lock:
+                results[i] = (status, doc["logits"])
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True) for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert results == {i: (200, [float(i)]) for i in range(16)}
+    finally:
+        fe.stop()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# the full front door: cli/serve.py --listen + SIGTERM drain (subprocess)
+# ---------------------------------------------------------------------------
+
+_LISTEN_DRIVER = """
+import os, sys
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from yet_another_mobilenet_series_tpu.cli.serve import main
+main(sys.argv[1:])
+"""
+
+
+def test_cli_listen_end_to_end_sigterm_drain(tmp_path):
+    """cli/serve.py --listen against a real exported bundle: HTTP predict
+    with priority + deadline headers, /healthz with breaker/queue state,
+    then SIGTERM -> graceful drain within serve.drain_timeout_s."""
+    import jax
+
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.serve.export import export_bundle
+
+    net = get_model(
+        ModelConfig(arch="mobilenet_v2", num_classes=4, dropout=0.0,
+                    block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}]),
+        image_size=24,
+    )
+    params, state = net.init(jax.random.PRNGKey(0))
+    bundle_dir = str(tmp_path / "bundle")
+    export_bundle(net, params, state, bundle_dir)
+
+    log_dir = str(tmp_path / "srv")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _LISTEN_DRIVER, "--listen",
+         f"serve.bundle={bundle_dir}", "serve.buckets=[1,4]", "data.image_size=24",
+         "serve.drain_timeout_s=10", f"train.log_dir={log_dir}"],
+        env=dict(os.environ, PYTHONPATH=REPO),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        addr_path = os.path.join(log_dir, "listen_addr.json")
+        deadline = time.time() + 120
+        while not os.path.exists(addr_path):
+            assert proc.poll() is None, f"server died early:\n{proc.stdout.read()[-2000:]}"
+            assert time.time() < deadline, "server never bound"
+            time.sleep(0.2)
+        addr = json.loads(open(addr_path).read())
+        base = f"http://{addr['host']}:{addr['port']}"
+
+        status, doc, _ = _post_image(base, 2, priority="interactive", deadline_ms=30000)
+        assert status == 200 and len(doc["logits"]) == 4
+        status, health, _ = _request(base + "/healthz")
+        assert status == 200 and health["breaker"] == "closed"
+        assert health["classes"]["interactive"]["quota"] >= 1
+
+        proc.send_signal(signal.SIGTERM)
+        t0 = time.time()
+        rc = proc.wait(timeout=30)
+        assert rc == 0
+        assert time.time() - t0 < 15  # drained inside the configured bound
+        out = proc.stdout.read()
+        assert "drained in" in out and "clean" in out
+        # obs artifacts landed, with the front-door counters in them
+        snap = json.loads(open(os.path.join(log_dir, "obs_registry.json")).read())
+        assert snap["serve.requests.interactive"] >= 1
+        assert snap["serve.http_requests"] >= 1
+        assert snap["serve.breaker_state"] == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -205,6 +205,92 @@ def test_watchdog_rejects_nonpositive_deadline(tmp_path):
         StallWatchdog(str(tmp_path), deadline_s=0.0)
 
 
+def test_watchdog_info_providers_reach_hang_report(tmp_path):
+    """The serving extension: registered info providers (batcher threads,
+    in-flight window, breaker state — cli/serve.py wires the real ones)
+    land in hang_report.json, and a provider that raises contributes its
+    error string instead of killing the report."""
+    wd = StallWatchdog(
+        str(tmp_path), deadline_s=0.2, poll_s=0.05,
+        info_providers={"serving": lambda: {
+            "batcher_threads": [{"name": "serve-collect", "alive": True}],
+            "inflight": 2,
+            "admission": {"breaker": "open"},
+        }},
+    )
+
+    def broken():
+        raise RuntimeError("provider died")
+
+    wd.register_info("broken", broken)
+    wd.start()
+    wd.arm(step=1, phase="serve")
+    deadline = time.time() + 10
+    report_path = tmp_path / "hang_report.json"
+    while time.time() < deadline and not report_path.exists():
+        time.sleep(0.05)
+    wd.stop()
+    assert report_path.exists()
+    rep = json.loads(report_path.read_text())
+    assert rep["last_phase"] == "serve"
+    serving = rep["info"]["serving"]
+    assert serving["inflight"] == 2
+    assert serving["batcher_threads"][0]["name"] == "serve-collect"
+    assert serving["admission"]["breaker"] == "open"
+    assert "provider failed" in rep["info"]["broken"] and "provider died" in rep["info"]["broken"]
+
+
+def test_watchdog_serving_report_from_live_batcher(tmp_path):
+    """End-to-end serving hang report: a pipelined batcher wedged on a hung
+    engine, the watchdog's serving section carries the real thread names,
+    window occupancy, and breaker state."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.cli.serve import _serving_info
+    from yet_another_mobilenet_series_tpu.serve.admission import AdmissionController
+    from yet_another_mobilenet_series_tpu.serve.faults import FaultyEngine
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+    class _Echo:
+        def predict_async(self, images):
+            class _H:
+                def result(_s):
+                    return images[:, 0, 0, :1]
+            return _H()
+
+        def predict(self, images):
+            return self.predict_async(images).result()
+
+    eng = FaultyEngine(_Echo(), hang_at=0)
+    b = PipelinedBatcher(eng, max_batch=1, max_wait_ms=0.0, drain_timeout_s=1.0).start()
+    ac = AdmissionController(b)
+    wd = StallWatchdog(str(tmp_path), deadline_s=0.2, poll_s=0.05)
+    wd.register_info("serving", lambda: _serving_info(b, ac))
+    wd.start()
+    wd.arm(phase="serve")
+    try:
+        fut = ac.submit(np.zeros((4, 4, 3), np.float32))
+        report_path = tmp_path / "hang_report.json"
+        deadline = time.time() + 10
+        while time.time() < deadline and not report_path.exists():
+            time.sleep(0.05)
+        assert report_path.exists()
+        rep = json.loads(report_path.read_text())
+        serving = rep["info"]["serving"]
+        names = {t["name"] for t in serving["batcher_threads"]}
+        assert names == {"serve-collect", "serve-complete"}
+        assert serving["inflight"] >= 1  # the wedged batch occupies the window
+        assert serving["admission"]["breaker"] == "closed"
+        assert serving["admission"]["classes"]["interactive"]["in_queue"] >= 1
+        # the wedged request is also visible in the dumped thread stacks
+        assert any("serve-complete" in name for name in rep["threads"])
+    finally:
+        wd.stop()
+        b.stop()  # drain-bounded: the hung engine cannot wedge teardown
+        with pytest.raises(Exception):
+            fut.result(timeout=1)
+
+
 # ---------------------------------------------------------------------------
 # Logger integration
 # ---------------------------------------------------------------------------

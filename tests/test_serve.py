@@ -560,6 +560,119 @@ def test_pipelined_rejects_bad_window():
 
 
 # ---------------------------------------------------------------------------
+# robustness satellites: bounded drain, priority plumbing, crash containment
+# ---------------------------------------------------------------------------
+
+
+def test_micro_batcher_drain_timeout_on_hung_engine():
+    """MicroBatcher.stop(drain=True) with a wedged predict fails the
+    remaining futures with DrainTimeout within drain_timeout_s instead of
+    hanging shutdown forever (the pre-robustness behavior)."""
+    from yet_another_mobilenet_series_tpu.serve.batcher import DrainTimeout
+
+    wedge = threading.Event()
+
+    def predict(images):
+        wedge.wait()  # never released: a truly hung engine
+        return _row_id_predict(images)
+
+    b = MicroBatcher(predict, max_batch=1, max_wait_ms=0.0, drain_timeout_s=0.4).start()
+    futs = [b.submit(np.zeros((2, 2, 3), np.float32)) for _ in range(3)]
+    time.sleep(0.1)
+    t0 = time.perf_counter()
+    b.stop()
+    assert time.perf_counter() - t0 < 3.0
+    for f in futs:
+        with pytest.raises((DrainTimeout, RuntimeError)):
+            f.result(timeout=1)
+    wedge.set()  # un-wedge the abandoned daemon; its late answer is dropped
+    time.sleep(0.05)
+    assert get_registry().snapshot()["serve.drain_timeouts"] >= 1
+
+
+def test_late_answer_after_drain_timeout_is_dropped():
+    """The abandoned worker eventually returns: its set_result on an
+    already-failed future must be swallowed, not crash the thread."""
+    wedge = threading.Event()
+
+    def predict(images):
+        wedge.wait(10)
+        return _row_id_predict(images)
+
+    b = MicroBatcher(predict, max_batch=1, max_wait_ms=0.0, drain_timeout_s=0.2).start()
+    fut = b.submit(np.zeros((2, 2, 3), np.float32))
+    time.sleep(0.05)
+    base_crashes = get_registry().snapshot().get("serve.thread_crashes", 0)
+    b.stop()
+    with pytest.raises(Exception):
+        fut.result(timeout=1)
+    wedge.set()
+    time.sleep(0.2)  # the abandoned worker resolves into the failed future
+    assert get_registry().snapshot().get("serve.thread_crashes", 0) == base_crashes
+
+
+@pytest.mark.parametrize("cls", ["micro", "pipelined"])
+def test_priority_plumbs_through_and_sheds_per_class(cls):
+    """submit(priority=...) rides the request into the batcher; a shed is
+    attributed to its class (serve.shed_deadline.<class>)."""
+    release = threading.Event()
+
+    def predict(images):
+        release.wait(5)
+        return _row_id_predict(images)
+
+    class _Eng:
+        def predict_async(self, images):
+            class _H:
+                def result(_self):
+                    release.wait(5)
+                    return _row_id_predict(images)
+            return _H()
+
+        def predict(self, images):
+            return self.predict_async(images).result()
+
+    if cls == "micro":
+        b = MicroBatcher(predict, max_batch=1, max_wait_ms=0.0, drain_timeout_s=5.0).start()
+    else:
+        b = PipelinedBatcher(_Eng(), max_inflight=1, max_batch=1, max_wait_ms=0.0,
+                             drain_timeout_s=5.0).start()
+    img = np.zeros((2, 2, 3), np.float32)
+    reg = get_registry()
+    base = reg.snapshot().get("serve.shed_deadline.best_effort", 0)
+    try:
+        first = b.submit(img, priority="interactive")  # occupies the engine
+        time.sleep(0.05)
+        doomed = b.submit(img, deadline_ms=10.0, priority="best_effort")
+        time.sleep(0.1)
+        release.set()
+        first.result(timeout=10)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=10)
+    finally:
+        release.set()
+        b.stop()
+    assert reg.snapshot()["serve.shed_deadline.best_effort"] - base == 1
+
+
+def test_worker_crash_fails_live_futures_not_silent():
+    """YAMT011's runtime counterpart: a bug that escapes the collect loop
+    fails every live future and counts serve.thread_crashes — clients see
+    the error immediately instead of hanging on a dead thread."""
+    b = MicroBatcher(_row_id_predict, max_batch=4, max_wait_ms=1.0).start()
+    reg = get_registry()
+    base = reg.snapshot().get("serve.thread_crashes", 0)
+    # sabotage an internal the loop touches on every batch: the next collect
+    # raises inside the worker, OUTSIDE the engine try/except
+    b._shed_expired = None  # type: ignore[assignment]
+    fut = b.submit(np.zeros((2, 2, 3), np.float32))
+    with pytest.raises(TypeError):  # 'NoneType' object is not callable
+        fut.result(timeout=10)
+    assert reg.snapshot()["serve.thread_crashes"] - base == 1
+    b._thread = None  # the worker is dead; skip stop()'s join bookkeeping
+
+
+# ---------------------------------------------------------------------------
 # batcher: coalescing, backpressure, shedding
 # ---------------------------------------------------------------------------
 

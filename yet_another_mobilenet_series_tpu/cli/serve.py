@@ -1,20 +1,31 @@
 """Serving entry point — ``python -m yet_another_mobilenet_series_tpu.cli.serve
 app:<yaml> [key=value ...]`` (sibling of cli.train / cli.profile).
 
-Two phases, both optional, driven by the ``serve:`` config block:
+Three phases, all optional, driven by the ``serve:`` config block:
 
 1. **export** (``serve.export_from`` set): checkpoint -> InferenceBundle at
    ``serve.bundle`` — prune masks hard-applied, EMA weights selected, BN
    folded into conv weights (serve/export.py).
-2. **serve** (``serve.requests`` > 0): load the bundle, AOT-warm the
-   engine's (bucket, image_size) ladder, and drive a synthetic closed-loop
-   load of ``serve.requests`` single-image requests from ``serve.clients``
-   client threads through the batcher — the pipelined continuous-batching
-   one by default (``serve.pipelined``, serve/pipeline.py), or the legacy
-   sync micro-batcher — the in-process stand-in for an RPC front door,
-   exercising the exact queue/coalesce/dispatch path one would sit behind
-   one. Prints p50/p99 end-to-end latency and QPS; with a log_dir, metrics
-   + obs_registry.json land where scripts/obs_report.py reads them.
+2. **synthetic load** (``serve.requests`` > 0): load the bundle, AOT-warm
+   the engine's (bucket, image_size) ladder, and drive a synthetic
+   closed-loop load of ``serve.requests`` single-image requests from
+   ``serve.clients`` client threads through the batcher — the pipelined
+   continuous-batching one by default (``serve.pipelined``,
+   serve/pipeline.py), or the legacy sync micro-batcher. Prints p50/p99
+   end-to-end latency and QPS; with a log_dir, metrics + obs_registry.json
+   land where scripts/obs_report.py reads them.
+3. **listen** (``serve.listen.enable`` or the ``--listen`` shorthand): the
+   fault-tolerant front door — a loopback HTTP server (serve/frontend.py)
+   in front of priority/QoS admission control, bounded retry, and a
+   circuit breaker (serve/admission.py). ``POST /predict`` takes
+   ``X-Priority`` / ``X-Deadline-Ms`` headers; ``GET /healthz`` reports
+   breaker + queue state. SIGTERM/SIGINT stops accepting and drains
+   in-flight work bounded by ``serve.drain_timeout_s``; the bound address
+   lands in ``<log_dir>/listen_addr.json`` so callers never race the bind.
+   ``serve.faults.enable`` wraps the engine in the seeded chaos injector
+   (serve/faults.py) for recovery drills. With
+   ``obs.watchdog_deadline_s`` > 0 a stall watchdog guards the serving
+   loop, its hang report carrying batcher threads + window + breaker state.
 
 ``serve.requests=0`` with a bundle still warms up every bucket — a
 deploy-time smoke that the artifact compiles and serves shape-correctly.
@@ -24,7 +35,9 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -32,9 +45,13 @@ import numpy as np
 from ..config import Config, parse_cli
 from ..obs import registry as obs_registry
 from ..obs import trace as obs_trace
+from ..obs.watchdog import StallWatchdog
 from ..parallel import mesh as mesh_lib
+from ..serve.admission import AdmissionController
 from ..serve.batcher import MicroBatcher, QueueFull
 from ..serve.engine import InferenceEngine
+from ..serve.faults import FaultyEngine
+from ..serve.frontend import Frontend
 from ..serve.pipeline import PipelinedBatcher
 from ..serve.export import export_checkpoint, load_bundle
 from ..utils.logging import Logger
@@ -50,18 +67,16 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
 def _drive_load(cfg: Config, batcher: MicroBatcher, image_size: int, log: Logger) -> dict:
     """Closed-loop synthetic clients: each thread submits one request, waits
     for its logits, repeats. Returns the latency/QPS summary."""
-    import threading
-
     n_total = cfg.serve.requests
     n_clients = max(1, cfg.serve.clients)
     rng = np.random.RandomState(0)
     image = rng.normal(0, 1, (image_size, image_size, 3)).astype(np.float32)
     latencies: list[float] = []
-    errors = {"shed": 0, "rejected": 0}
+    errors = {"shed": 0, "rejected": 0, "crashed": 0}
     lock = threading.Lock()
     counter = {"left": n_total}
 
-    def client():
+    def client_inner():
         while True:
             with lock:
                 if counter["left"] <= 0:
@@ -83,6 +98,14 @@ def _drive_load(cfg: Config, batcher: MicroBatcher, image_size: int, log: Logger
             with lock:
                 latencies.append(time.perf_counter() - t0)
 
+    def client():
+        # YAMT011: a silently-dead client thread would skew the measured load
+        try:
+            client_inner()
+        except Exception:  # noqa: BLE001 — count the loss, keep the run honest
+            with lock:
+                errors["crashed"] += 1
+
     threads = [threading.Thread(target=client, daemon=True) for _ in range(n_clients)]
     t_start = time.perf_counter()
     for t in threads:
@@ -96,6 +119,7 @@ def _drive_load(cfg: Config, batcher: MicroBatcher, image_size: int, log: Logger
         "completed": len(latencies),
         "shed": errors["shed"],
         "rejected_full": errors["rejected"],
+        "client_crashes": errors["crashed"],
         "wall_s": wall,
         "qps": len(latencies) / wall if wall > 0 else 0.0,
         "p50_ms": _percentile(latencies, 0.50) * 1e3,
@@ -107,6 +131,95 @@ def _drive_load(cfg: Config, batcher: MicroBatcher, image_size: int, log: Logger
         f"p50 {summary['p50_ms']:.2f} ms, p99 {summary['p99_ms']:.2f} ms"
     )
     return summary
+
+
+def _make_batcher(cfg: Config, engine) -> MicroBatcher:
+    common = dict(
+        max_batch=cfg.serve.max_batch,
+        max_wait_ms=cfg.serve.max_wait_ms,
+        queue_depth=cfg.serve.queue_depth,
+        default_deadline_ms=cfg.serve.deadline_ms,
+        drain_timeout_s=cfg.serve.drain_timeout_s,
+    )
+    if cfg.serve.pipelined:
+        return PipelinedBatcher(engine, max_inflight=cfg.serve.max_inflight, **common)
+    return MicroBatcher(engine.predict, **common)
+
+
+def _serving_info(batcher, admission) -> dict:
+    """The watchdog hang-report 'serving' section: worker thread liveness,
+    in-flight window occupancy, breaker + per-class queue state."""
+    info: dict = {"admission": admission.state()}
+    if hasattr(batcher, "worker_threads"):
+        info["batcher_threads"] = batcher.worker_threads()
+        info["inflight"] = batcher.inflight()
+    else:
+        t = batcher._thread
+        info["batcher_threads"] = [] if t is None else [{"name": t.name, "alive": t.is_alive()}]
+    return info
+
+
+def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
+    """The front-door serving loop: HTTP frontend + admission + batcher,
+    running until SIGTERM/SIGINT."""
+    stop_event = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.log(f"signal {signum}: stopping accept loop, draining in-flight work")
+        stop_event.set()
+
+    # only the main thread may install handlers; an embedded (test) run
+    # drives shutdown through the returned stop_event instead
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+    except ValueError:
+        pass
+
+    batcher = _make_batcher(cfg, engine).start()
+    watchdog = None
+    if cfg.obs.watchdog_deadline_s > 0 and cfg.train.log_dir:
+        watchdog = StallWatchdog(
+            cfg.train.log_dir,
+            cfg.obs.watchdog_deadline_s,
+            tracer=tracer,
+            registry=reg,
+            poll_s=cfg.obs.watchdog_poll_s,
+            logger=log,
+        )
+    admission = AdmissionController.from_config(
+        batcher,
+        cfg.serve.admission,
+        heartbeat=(lambda: watchdog.arm(phase="serve")) if watchdog is not None else None,
+    )
+    if watchdog is not None:
+        watchdog.register_info("serving", lambda: _serving_info(batcher, admission))
+        watchdog.start()
+    frontend = Frontend(
+        admission,
+        host=cfg.serve.listen.host,
+        port=cfg.serve.listen.port,
+        request_timeout_s=cfg.serve.listen.request_timeout_s,
+        retry_after_s=cfg.serve.admission.breaker_cooldown_s,
+    ).start()
+    addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid()}
+    if cfg.train.log_dir:
+        os.makedirs(cfg.train.log_dir, exist_ok=True)
+        with open(os.path.join(cfg.train.log_dir, "listen_addr.json"), "w") as f:
+            json.dump(addr, f)
+    log.log(f"listening on {frontend.url} (POST /predict, GET /healthz)")
+    try:
+        stop_event.wait()
+    finally:
+        t0 = time.perf_counter()
+        frontend.stop()
+        batcher.stop(drain=True)  # bounded by serve.drain_timeout_s
+        if watchdog is not None:
+            watchdog.stop()
+        drain_s = time.perf_counter() - t0
+        timeouts = int(reg.snapshot().get("serve.drain_timeouts", 0))
+        log.log(f"drained in {drain_s:.2f}s ({'clean' if not timeouts else 'DRAIN TIMEOUT'})")
+    return {"listened": True, **addr, "drain_s": drain_s, "drain_timeouts": timeouts}
 
 
 def run(cfg: Config) -> dict:
@@ -145,22 +258,22 @@ def run(cfg: Config) -> dict:
                 f"warmup: compiled buckets {engine.buckets} x sizes {engine.image_sizes} "
                 f"in {time.perf_counter() - t0:.1f}s"
             )
-        if cfg.serve.requests > 0:
-            common = dict(
-                max_batch=cfg.serve.max_batch,
-                max_wait_ms=cfg.serve.max_wait_ms,
-                queue_depth=cfg.serve.queue_depth,
-                default_deadline_ms=cfg.serve.deadline_ms,
+        engine = FaultyEngine.from_config(engine, cfg.serve.faults)
+        if cfg.serve.faults.enable:
+            log.log(
+                f"CHAOS: fault injection on (seed={cfg.serve.faults.seed}, "
+                f"failure_rate={cfg.serve.faults.failure_rate}, "
+                f"fail_first_n={cfg.serve.faults.fail_first_n})"
             )
-            if cfg.serve.pipelined:
-                batcher = PipelinedBatcher(engine, max_inflight=cfg.serve.max_inflight, **common)
-            else:
-                batcher = MicroBatcher(engine.predict, **common)
+        if cfg.serve.requests > 0:
+            batcher = _make_batcher(cfg, engine)
             batcher.start()
             try:
                 result.update(_drive_load(cfg, batcher, cfg.data.image_size, log))
             finally:
                 batcher.stop()
+        if cfg.serve.listen.enable:
+            result.update(_listen(cfg, engine, log, reg, tracer))
         return result
     finally:
         if tracer.enabled and cfg.train.log_dir and is_coord:
@@ -174,7 +287,11 @@ def run(cfg: Config) -> dict:
 
 
 def main(argv=None):
-    cfg = parse_cli(sys.argv[1:] if argv is None else argv)
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `--listen` is sugar for serve.listen.enable=true (the front-door mode
+    # named by ROADMAP item 1); everything else stays app:/key=value
+    argv = ["serve.listen.enable=true" if a == "--listen" else a for a in argv]
+    cfg = parse_cli(argv)
     return run(cfg)
 
 

@@ -333,6 +333,76 @@ class ObsConfig:
 
 
 @dataclass(frozen=True)
+class ListenConfig:
+    """Loopback HTTP front door (serve/frontend.py, cli/serve.py --listen):
+    POST /predict with priority + deadline headers, GET /healthz reporting
+    breaker + queue state — docs/SERVING.md "Front door"."""
+
+    enable: bool = False
+    host: str = "127.0.0.1"
+    # 0 = ephemeral; the bound port is logged and written to
+    # <log_dir>/listen_addr.json so callers never race the bind
+    port: int = 0
+    # server-side cap on how long one /predict handler waits for its result
+    # when the request carries no deadline (a deadline extends this bound)
+    request_timeout_s: float = 60.0
+
+
+@dataclass(frozen=True)
+class AdmissionConfig:
+    """Priority/QoS admission control + resilience in front of the batcher
+    (serve/admission.py): per-class weighted queue shares, deadline-aware
+    reject-on-arrival, bounded retry with jittered backoff, circuit breaker."""
+
+    # class a request lands in when it names none (requests naming an
+    # unknown class are rejected, not silently reclassified)
+    default_class: str = "interactive"
+    # queue-share weights for (interactive, batch, best_effort): each class
+    # gets at least ceil(queue_depth * w / sum(w)) slots, so best-effort
+    # floods can never starve interactive admission
+    weights: Sequence[float] = (8.0, 3.0, 1.0)
+    # bounded retry of TRANSIENT engine failures (inference is pure, so a
+    # retry can never double-apply anything); 0 = fail on first error
+    max_retries: int = 2
+    retry_backoff_ms: float = 5.0  # doubles per attempt
+    retry_jitter: float = 0.5  # +/- fraction of the backoff, desynchronizes herds
+    # consecutive engine failures (across requests) that open the breaker
+    breaker_threshold: int = 5
+    # open -> half-open delay; half-open admits ONE probe before closing
+    breaker_cooldown_s: float = 1.0
+    # EWMA smoothing for observed request latency (the arrival-time wait
+    # predictor feeding reject_unmeetable)
+    ewma_alpha: float = 0.2
+    # reject-on-arrival when the predicted wait already exceeds the request's
+    # deadline: cheaper than shedding it after it burned a queue slot
+    reject_unmeetable: bool = True
+
+
+@dataclass(frozen=True)
+class FaultsConfig:
+    """Deterministic, seeded fault injection around the engine
+    (serve/faults.py) — chaos testing the admission/retry/breaker stack with
+    reproducible failure schedules. Off in production."""
+
+    enable: bool = False
+    seed: int = 0
+    # per-dispatch failure probability (seeded draw, deterministic in
+    # dispatch order)
+    failure_rate: float = 0.0
+    # fail the first N dispatches then recover (breaker-drill schedule)
+    fail_first_n: int = 0
+    # where injected failures surface: at dispatch (collect thread) or at
+    # result() (completion thread)
+    fail_at: str = "dispatch"  # dispatch | result
+    # injected completion latency, applied with probability latency_rate
+    latency_ms: float = 0.0
+    latency_rate: float = 1.0
+    # dispatch index that HANGS until FaultyEngine.hang_release is set
+    # (drain-timeout / watchdog drills); -1 = never
+    hang_at: int = -1
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Inference serving (serve/, docs/SERVING.md): export a checkpoint to a
     folded InferenceBundle and/or serve a bundle through the AOT-batched
@@ -378,6 +448,14 @@ class ServeConfig:
     # and the number of concurrent client threads driving them
     requests: int = 0
     clients: int = 4
+    # shutdown bound: stop(drain=True) fails still-unresolved requests with
+    # DrainTimeout after this long instead of hanging shutdown on a wedged
+    # engine. 0 = wait forever (the pre-robustness behavior)
+    drain_timeout_s: float = 10.0
+    # HTTP front door / admission control / fault injection sub-blocks
+    listen: ListenConfig = field(default_factory=ListenConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    faults: FaultsConfig = field(default_factory=FaultsConfig)
 
 
 @dataclass(frozen=True)
@@ -445,6 +523,9 @@ _SECTION_TYPES = {
     "TrainConfig": TrainConfig,
     "DistConfig": DistConfig,
     "ObsConfig": ObsConfig,
+    "ListenConfig": ListenConfig,
+    "AdmissionConfig": AdmissionConfig,
+    "FaultsConfig": FaultsConfig,
     "ServeConfig": ServeConfig,
     "Config": Config,
 }

@@ -41,6 +41,7 @@ class StallWatchdog:
         registry: MetricsRegistry | None = None,
         poll_s: float = 0.0,
         logger=None,
+        info_providers: dict | None = None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"watchdog deadline must be > 0, got {deadline_s}")
@@ -54,6 +55,11 @@ class StallWatchdog:
         self._step: int | None = None
         self._phase = "startup"
         self._fired = False
+        # name -> zero-arg callable whose return value lands in the report's
+        # "info" section (e.g. serving: batcher threads, window occupancy,
+        # breaker state); a provider that raises contributes its error string
+        # instead of taking the report down
+        self._info: dict = dict(info_providers or {})
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, name="yamt-obs-watchdog", daemon=True)
 
@@ -65,6 +71,11 @@ class StallWatchdog:
         # the first step's compile time — docs/OBSERVABILITY.md tuning)
         self.arm(step=None, phase="startup")
         self._thread.start()
+
+    def register_info(self, name: str, fn) -> None:
+        """Attach a named state provider to future hang reports (the serving
+        stack registers breaker/queue/window state here — docs/SERVING.md)."""
+        self._info[name] = fn
 
     def arm(self, step: int | None = None, phase: str = "step") -> None:
         """Heartbeat: "the loop made progress". Called per completed train
@@ -86,6 +97,14 @@ class StallWatchdog:
     # -- watchdog thread -----------------------------------------------------
 
     def _run(self) -> None:
+        # top-level guard (yamt-lint YAMT011): a crashed watchdog thread is a
+        # silently-disarmed alarm — at least say so on the way down
+        try:
+            self._run_inner()
+        except Exception:  # noqa: BLE001 — terminal for the thread; be loud
+            sys.stderr.write("WATCHDOG: thread crashed:\n" + traceback.format_exc())
+
+    def _run_inner(self) -> None:
         while not self._stop.wait(self.poll_s):
             beat = self._beat_ns
             if beat is None or self._fired:
@@ -114,6 +133,12 @@ class StallWatchdog:
             f"{names.get(tid, 'thread')}-{tid}": traceback.format_stack(frame)
             for tid, frame in sys._current_frames().items()
         }
+        info = {}
+        for name, fn in self._info.items():
+            try:
+                info[name] = fn()
+            except Exception as e:  # noqa: BLE001 — a dying provider must not kill the report
+                info[name] = f"provider failed: {type(e).__name__}: {e}"
         report = {
             "seconds_since_last_beat": elapsed_s,
             "deadline_s": self.deadline_s,
@@ -122,6 +147,7 @@ class StallWatchdog:
             "open_spans": self._tracer.open_spans() if self._tracer is not None else [],
             "registry": self._registry.snapshot() if self._registry is not None else {},
             "threads": threads,
+            "info": info,
         }
         tmp = f"{self.report_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:

@@ -23,6 +23,16 @@ training graph re-run with train=False):
   dispatch thread keeps the device fed through ``predict_async`` while a
   completion thread syncs results, bounded by a ``max_inflight`` window
   (continuous batching; the serving default).
+- :mod:`.admission` — the resilience edge: per-class (interactive / batch /
+  best_effort) weighted admission with deadline-aware reject-on-arrival,
+  bounded retry with jittered backoff for transient engine failures, and a
+  consecutive-failure circuit breaker with a single half-open probe.
+- :mod:`.frontend` — the stdlib-only loopback HTTP front door
+  (``POST /predict`` with priority + deadline headers, ``GET /healthz``
+  with breaker + queue state) behind ``cli/serve.py --listen``.
+- :mod:`.faults` — deterministic, seeded fault injection around any engine
+  (failure rates, fail-N-then-recover, added latency, hang-until-event) so
+  every recovery path above is testable and benchable.
 
 Everything is instrumented through obs/ (``serve/*`` spans, queue-wait and
 run-latency histograms, request/shed counters), so scripts/obs_report.py

@@ -1,0 +1,418 @@
+"""Priority/QoS admission control + resilience edge in front of the batcher.
+
+The batcher (serve/batcher.py, serve/pipeline.py) gives every request the
+same FIFO treatment and fails a batch's futures the moment the engine throws.
+Correct, but production traffic is not uniform and engines are not immortal:
+interactive requests must not starve behind a best-effort flood, a transient
+engine failure should cost a retry, not a user error, and a SICK engine must
+stop eating every queued request. Per Kernel Looping (PAPERS.md,
+arXiv:2410.23668) the device-feeding path must stay non-blocking, so ALL of
+this logic lives at **admission time** (synchronous, before the queue) and
+**completion time** (future callbacks) — never inside the dispatch loop.
+
+:class:`AdmissionController` wraps a started batcher and adds three layers:
+
+**1. Per-class weighted admission.** Three priority classes —
+``interactive`` / ``batch`` / ``best_effort`` — each holding a weighted
+share of the queue (``weights``): a class at its quota is rejected with
+:class:`ClassQueueFull` while other classes still admit, so overload sheds
+the cheap traffic first and a flood in one class can never starve another.
+On top of quotas, **deadline-aware rejection at arrival**: an EWMA of
+observed request latency predicts the wait a new request faces; a request
+whose deadline the prediction already blows is rejected with
+:class:`DeadlineUnmeetable` immediately — reject-on-arrival beats
+shed-after-queue (the request never burns a queue slot or a bucket row).
+
+**2. Bounded retry with jittered backoff.** The folded inference forward is
+pure — a retry cannot double-apply anything — so a transient engine failure
+(the only exception class that retries; deadline sheds and rejections do
+not) re-submits up to ``max_retries`` times with exponentially growing,
+jitter-desynchronized backoff, counted in ``serve.retries``. Retries stop
+early when the request's own deadline passes or the breaker opens.
+
+**3. Circuit breaker.** ``breaker_threshold`` CONSECUTIVE engine failures
+open the breaker: every submit fails fast with :class:`BreakerOpen` (no
+queue time, no engine load) for ``breaker_cooldown_s``, after which the
+breaker goes half-open and admits exactly ONE probe request; the probe's
+outcome closes the breaker (success) or re-opens it for another cooldown
+(failure). State is exported as the ``serve.breaker_state`` gauge
+(0 closed / 1 open / 2 half-open) and in :meth:`AdmissionController.state`
+— the payload behind ``GET /healthz`` (serve/frontend.py).
+
+Instrumentation (obs/): per-class ``serve.latency_seconds.<class>``
+histograms and ``serve.requests.<class>`` / ``serve.completed.<class>`` /
+``serve.rejected.<class>`` counters; cause-split ``serve.rejected_breaker``
+/ ``serve.rejected_deadline`` / ``serve.rejected_class_full`` counters;
+``serve.retries`` (+ per class), ``serve.engine_failures``,
+``serve.breaker_opens``, and the ``serve.breaker_state`` gauge.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+from ..obs.registry import get_registry
+from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
+
+# the QoS taxonomy, cheapest-to-shed last; weights align with this order
+CLASSES = ("interactive", "batch", "best_effort")
+
+BREAKER_CLOSED, BREAKER_OPEN, BREAKER_HALF_OPEN = 0, 1, 2
+_BREAKER_NAMES = {BREAKER_CLOSED: "closed", BREAKER_OPEN: "open", BREAKER_HALF_OPEN: "half_open"}
+
+
+class BreakerOpen(RuntimeError):
+    """Rejected at arrival: the circuit breaker is open (engine failure
+    streak); retry after the cooldown."""
+
+
+class DeadlineUnmeetable(RuntimeError):
+    """Rejected at arrival: the predicted wait already exceeds the request's
+    deadline — shedding now is strictly cheaper than shedding after queueing."""
+
+
+class ClassQueueFull(QueueFull):
+    """Rejected at arrival: this priority class is at its weighted queue
+    share (other classes may still be admitting)."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with a single half-open probe.
+
+    Thread-safe; transitions are driven by :meth:`allow` (at admission) and
+    :meth:`on_success` / :meth:`on_failure` (at completion).
+    """
+
+    def __init__(self, threshold: int, cooldown_s: float):
+        if threshold < 1:
+            raise ValueError(f"breaker_threshold must be >= 1, got {threshold}")
+        self._threshold = threshold
+        self._cooldown_s = cooldown_s
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._streak = 0
+        self._opened_at = 0.0
+        self._probe_pending = False
+        self._reg = get_registry()
+        self._reg.gauge("serve.breaker_state").set(BREAKER_CLOSED)
+
+    def _set_state(self, state: int) -> None:
+        self._state = state
+        self._reg.gauge("serve.breaker_state").set(state)
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    @property
+    def state_name(self) -> str:
+        return _BREAKER_NAMES[self.state]
+
+    def allow(self) -> tuple[bool, bool]:
+        """(admit?, is_probe?) for one arriving request."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True, False
+            if self._state == BREAKER_OPEN:
+                if time.perf_counter() - self._opened_at < self._cooldown_s:
+                    return False, False
+                self._set_state(BREAKER_HALF_OPEN)
+                self._probe_pending = True
+                return True, True  # the cooldown's first arrival IS the probe
+            # half-open: one probe outstanding at a time
+            if self._probe_pending:
+                return False, False
+            self._probe_pending = True
+            return True, True
+
+    def cancel_probe(self) -> None:
+        """The admitted probe was rejected downstream before reaching the
+        engine: free the probe slot for the next arrival."""
+        with self._lock:
+            self._probe_pending = False
+
+    def on_success(self, probe: bool) -> None:
+        with self._lock:
+            self._streak = 0
+            if probe:
+                self._probe_pending = False
+                self._set_state(BREAKER_CLOSED)
+
+    def on_failure(self, probe: bool) -> None:
+        with self._lock:
+            if probe:
+                self._probe_pending = False
+                self._open()
+                return
+            if self._state != BREAKER_CLOSED:
+                return  # failures of pre-open stragglers don't re-arm the clock
+            self._streak += 1
+            if self._streak >= self._threshold:
+                self._open()
+
+    def _open(self) -> None:
+        self._streak = 0
+        self._opened_at = time.perf_counter()
+        if self._state != BREAKER_OPEN:
+            self._set_state(BREAKER_OPEN)
+            self._reg.counter("serve.breaker_opens").inc()
+
+
+class _Pending:
+    """Admission-side bookkeeping for one in-system request (survives
+    retries — the class quota slot is held until final resolution)."""
+
+    __slots__ = ("cls", "image", "t_submit", "t_deadline", "retries_left", "probe", "attempt")
+
+    def __init__(self, cls, image, deadline_s, retries_left, probe):
+        self.cls = cls
+        self.image = image
+        self.t_submit = time.perf_counter()
+        self.t_deadline = None if deadline_s is None else self.t_submit + deadline_s
+        self.retries_left = retries_left
+        self.probe = probe
+        self.attempt = 0
+
+
+class AdmissionController:
+    """QoS admission + retry + breaker around a started batcher.
+
+    ``submit`` mirrors the batcher's API (image, deadline) plus ``priority``
+    and returns a Future that ALWAYS resolves: to logits, or to a typed
+    rejection/shed/failure — never a silent hang.
+    """
+
+    def __init__(
+        self,
+        batcher,
+        *,
+        weights=(8.0, 3.0, 1.0),
+        default_class: str = "interactive",
+        max_retries: int = 2,
+        retry_backoff_ms: float = 5.0,
+        retry_jitter: float = 0.5,
+        breaker_threshold: int = 5,
+        breaker_cooldown_s: float = 1.0,
+        ewma_alpha: float = 0.2,
+        reject_unmeetable: bool = True,
+        seed: int = 0,
+        heartbeat=None,
+    ):
+        if len(weights) != len(CLASSES):
+            raise ValueError(f"need one weight per class {CLASSES}, got {weights}")
+        if default_class not in CLASSES:
+            raise ValueError(f"default_class {default_class!r} not in {CLASSES}")
+        self._batcher = batcher
+        self._default_class = default_class
+        self._max_retries = max(0, int(max_retries))
+        self._backoff_s = retry_backoff_ms / 1e3
+        self._jitter = retry_jitter
+        self._alpha = ewma_alpha
+        self._reject_unmeetable = reject_unmeetable
+        self._heartbeat = heartbeat  # e.g. StallWatchdog.arm — beats per completion
+        self._rng = random.Random(seed)
+        self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown_s)
+        depth = batcher._q.maxsize or 256
+        total_w = float(sum(weights))
+        self._quota = {
+            cls: max(1, int(round(depth * w / total_w))) for cls, w in zip(CLASSES, weights)
+        }
+        self._weights = dict(zip(CLASSES, weights))
+        self._lock = threading.Lock()
+        self._in_queue = {cls: 0 for cls in CLASSES}
+        self._ewma_s: float | None = None
+        self._reg = get_registry()
+
+    # -- the arrival-time wait predictor ------------------------------------
+
+    def _observe(self, cls: str, latency_s: float) -> None:
+        self._reg.histogram(f"serve.latency_seconds.{cls}").observe(latency_s)
+        with self._lock:
+            self._ewma_s = (
+                latency_s if self._ewma_s is None
+                else self._alpha * latency_s + (1 - self._alpha) * self._ewma_s
+            )
+
+    def predicted_wait_s(self) -> float:
+        """Expected time-to-answer for a request admitted NOW: the latency
+        EWMA scaled by the backlog in units of engine batches. 0 until the
+        first completion lands (no data — admit optimistically)."""
+        with self._lock:
+            ewma = self._ewma_s
+            backlog = sum(self._in_queue.values())
+        if ewma is None:
+            return 0.0
+        per_batch = max(getattr(self._batcher, "_max_batch", 1), 1)
+        return ewma * (1.0 + backlog / per_batch)
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, image, *, priority: str | None = None, deadline_ms: float | None = None) -> Future:
+        cls = priority or self._default_class
+        if cls not in CLASSES:
+            raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
+        admit, probe = self.breaker.allow()
+        if not admit:
+            self._reject(cls, "serve.rejected_breaker")
+            raise BreakerOpen(
+                f"circuit breaker open (cooldown {self.breaker._cooldown_s:.1f}s); failing fast"
+            )
+        deadline_s = deadline_ms / 1e3 if deadline_ms is not None else None
+        if self._reject_unmeetable and deadline_s is not None:
+            wait = self.predicted_wait_s()
+            if wait > deadline_s:
+                if probe:
+                    self.breaker.cancel_probe()  # probe slot not consumed
+                self._reject(cls, "serve.rejected_deadline")
+                raise DeadlineUnmeetable(
+                    f"predicted wait {wait * 1e3:.1f}ms exceeds deadline {deadline_ms:.1f}ms"
+                )
+        with self._lock:
+            if self._in_queue[cls] >= self._quota[cls]:
+                over_quota = True
+            else:
+                over_quota = False
+                self._in_queue[cls] += 1
+        if over_quota:
+            if probe:
+                self.breaker.cancel_probe()
+            self._reject(cls, "serve.rejected_class_full")
+            raise ClassQueueFull(
+                f"class {cls!r} at its weighted queue share ({self._quota[cls]})"
+            )
+        ctx = _Pending(cls, image, deadline_s, self._max_retries, probe)
+        outer: Future = Future()
+        try:
+            inner = self._batcher.submit(image, deadline_ms=deadline_ms, priority=cls)
+        except Exception:
+            self._release(cls)
+            if probe:
+                self.breaker.cancel_probe()
+            self._reject(cls, None)  # rejected_full already counted by the batcher
+            raise
+        self._reg.counter(f"serve.requests.{cls}").inc()
+        inner.add_done_callback(lambda fut: self._on_done(ctx, outer, fut))
+        return outer
+
+    def _reject(self, cls: str, cause_counter: str | None) -> None:
+        self._reg.counter("serve.rejected").inc()
+        self._reg.counter(f"serve.rejected.{cls}").inc()
+        if cause_counter:
+            self._reg.counter(cause_counter).inc()
+
+    def _release(self, cls: str) -> None:
+        with self._lock:
+            self._in_queue[cls] = max(0, self._in_queue[cls] - 1)
+
+    # -- completion side (runs on batcher worker / timer threads) -----------
+
+    def _resolve(self, outer: Future, value=None, exc: Exception | None = None) -> None:
+        try:
+            if exc is not None:
+                outer.set_exception(exc)
+            else:
+                outer.set_result(value)
+        except InvalidStateError:
+            pass  # client cancelled; nothing left to deliver
+        if self._heartbeat is not None:
+            self._heartbeat()
+
+    def _on_done(self, ctx: _Pending, outer: Future, inner: Future) -> None:
+        exc = inner.exception()
+        if exc is None:
+            self.breaker.on_success(ctx.probe)
+            self._observe(ctx.cls, time.perf_counter() - ctx.t_submit)
+            self._reg.counter(f"serve.completed.{ctx.cls}").inc()
+            self._release(ctx.cls)
+            self._resolve(outer, value=inner.result())
+            return
+        if isinstance(exc, (DeadlineExceeded, DrainTimeout)):
+            # sheds are policy, not engine health: no breaker, no retry
+            self._release(ctx.cls)
+            self._resolve(outer, exc=exc)
+            return
+        # engine failure: breaker accounting, then bounded retry
+        self._reg.counter("serve.engine_failures").inc()
+        self.breaker.on_failure(ctx.probe)
+        ctx.probe = False  # the probe verdict is spent; a retry is ordinary traffic
+        if ctx.retries_left <= 0 or self.breaker.state == BREAKER_OPEN or (
+            ctx.t_deadline is not None and time.perf_counter() >= ctx.t_deadline
+        ):
+            self._release(ctx.cls)
+            self._resolve(outer, exc=exc)
+            return
+        ctx.retries_left -= 1
+        ctx.attempt += 1
+        delay = self._backoff_s * (2 ** (ctx.attempt - 1))
+        delay *= 1.0 + self._jitter * self._rng.uniform(-1.0, 1.0)
+        self._reg.counter("serve.retries").inc()
+        self._reg.counter(f"serve.retries.{ctx.cls}").inc()
+        timer = threading.Timer(max(delay, 0.0), self._retry, args=(ctx, outer, exc))
+        timer.daemon = True
+        timer.start()
+
+    def _retry(self, ctx: _Pending, outer: Future, prev_exc: Exception) -> None:
+        if ctx.t_deadline is not None and time.perf_counter() >= ctx.t_deadline:
+            self._release(ctx.cls)
+            self._resolve(outer, exc=DeadlineExceeded("deadline passed during retry backoff"))
+            return
+        if self.breaker.state == BREAKER_OPEN:
+            self._release(ctx.cls)
+            self._resolve(outer, exc=prev_exc)
+            return
+        remaining_ms = (
+            None if ctx.t_deadline is None
+            else max((ctx.t_deadline - time.perf_counter()) * 1e3, 0.0)
+        )
+        try:
+            inner = self._batcher.submit(ctx.image, deadline_ms=remaining_ms, priority=ctx.cls)
+        except Exception as e:  # noqa: BLE001 — stopped batcher / QueueFull: final answer
+            self._release(ctx.cls)
+            self._resolve(outer, exc=e)
+            return
+        inner.add_done_callback(lambda fut: self._on_done(ctx, outer, fut))
+
+    # -- introspection (healthz / hang reports) ------------------------------
+
+    def state(self) -> dict:
+        """JSON-safe snapshot: breaker, per-class occupancy/quota, predictor."""
+        with self._lock:
+            in_queue = dict(self._in_queue)
+            ewma = self._ewma_s
+        return {
+            "breaker": self.breaker.state_name,
+            "breaker_state": self.breaker.state,
+            "ewma_latency_s": ewma,
+            "predicted_wait_s": self.predicted_wait_s(),
+            "queued_total": sum(in_queue.values()),
+            "classes": {
+                cls: {
+                    "in_queue": in_queue[cls],
+                    "quota": self._quota[cls],
+                    "weight": self._weights[cls],
+                }
+                for cls in CLASSES
+            },
+        }
+
+    @classmethod
+    def from_config(cls, batcher, ac, *, heartbeat=None, seed: int = 0) -> "AdmissionController":
+        """Build from a config.AdmissionConfig block (cli/serve.py)."""
+        return cls(
+            batcher,
+            weights=tuple(ac.weights),
+            default_class=ac.default_class,
+            max_retries=ac.max_retries,
+            retry_backoff_ms=ac.retry_backoff_ms,
+            retry_jitter=ac.retry_jitter,
+            breaker_threshold=ac.breaker_threshold,
+            breaker_cooldown_s=ac.breaker_cooldown_s,
+            ewma_alpha=ac.ewma_alpha,
+            reject_unmeetable=ac.reject_unmeetable,
+            seed=seed,
+            heartbeat=heartbeat,
+        )

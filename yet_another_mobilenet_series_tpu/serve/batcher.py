@@ -25,12 +25,32 @@ Overload behavior is explicit, not emergent:
   (The pipelined batcher additionally re-checks deadlines at completion —
   serve/pipeline.py.)
 
+Failure containment (the robustness contract every layer above builds on):
+
+- every request is tracked in a live set from submit to resolution, and all
+  future resolution goes through :meth:`_finish_ok` / :meth:`_finish_err` —
+  idempotent, so a late engine answer for a request that shutdown already
+  failed is dropped instead of crashing a worker thread;
+- ``stop(drain=True)`` is BOUNDED: if the engine wedges mid-batch,
+  ``drain_timeout_s`` fails every still-unresolved request with
+  :class:`DrainTimeout` instead of hanging shutdown forever (the worker
+  threads are daemons and are abandoned to the hung call);
+- the worker loop carries a top-level exception guard (yamt-lint YAMT011):
+  an unexpected crash fails every live future and counts
+  ``serve.thread_crashes`` instead of dying silently and hanging clients.
+
+Requests carry an optional **priority class** (serve/admission.py taxonomy);
+the batcher itself stays FIFO — class policy lives at admission time, where
+rejecting is still cheap — but sheds are attributed per class
+(``serve.shed_deadline.<class>``) so overload is diagnosable by QoS tier.
+
 Instrumentation (obs/): ``serve.queue_wait_seconds`` (enqueue -> dispatch),
 ``serve.batch_size`` histograms, ``serve.requests`` (counted only on a
 SUCCESSFUL enqueue — a rejected submit increments ``serve.rejected_full``
 alone, so requests - completed - shed always balances) / ``serve.completed``
-/ ``serve.shed_deadline`` / ``serve.rejected_full`` counters — all in the
-same registry every scalars row and obs_registry.json snapshot carries.
+/ ``serve.shed_deadline`` / ``serve.rejected_full`` / ``serve.drain_timeouts``
+/ ``serve.thread_crashes`` counters — all in the same registry every scalars
+row and obs_registry.json snapshot carries.
 """
 
 from __future__ import annotations
@@ -38,12 +58,13 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable
 
 import numpy as np
 
 from ..obs.registry import get_registry
+from ..utils.logging import emit
 
 # queue sentinel: wakes the (blocking) collect thread for shutdown. FIFO
 # ordering makes everything enqueued before stop() drain ahead of it.
@@ -59,14 +80,20 @@ class DeadlineExceeded(RuntimeError):
     pipelined path, before its completed batch was synced)."""
 
 
-class _Request:
-    __slots__ = ("image", "future", "t_enqueue", "t_deadline")
+class DrainTimeout(RuntimeError):
+    """stop(drain=True) gave up waiting for a wedged engine: the request was
+    failed at shutdown instead of hanging it (serve.drain_timeout_s)."""
 
-    def __init__(self, image: np.ndarray, deadline_s: float | None):
+
+class _Request:
+    __slots__ = ("image", "future", "t_enqueue", "t_deadline", "priority")
+
+    def __init__(self, image: np.ndarray, deadline_s: float | None, priority: str | None = None):
         self.image = image
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.t_deadline = None if deadline_s is None else self.t_enqueue + deadline_s
+        self.priority = priority
 
 
 def _group_by_shape(reqs: list["_Request"]) -> list[list["_Request"]]:
@@ -92,6 +119,7 @@ class MicroBatcher:
         max_wait_ms: float = 2.0,
         queue_depth: int = 256,
         default_deadline_ms: float = 0.0,
+        drain_timeout_s: float = 0.0,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -101,10 +129,15 @@ class MicroBatcher:
         self._max_batch = max_batch
         self._max_wait_s = max_wait_ms / 1e3
         self._default_deadline_s = default_deadline_ms / 1e3 if default_deadline_ms > 0 else None
+        self._drain_timeout_s = drain_timeout_s
         self._q: queue.Queue = queue.Queue(maxsize=queue_depth)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._reg = get_registry()
+        # submit -> resolution tracking: the drain-timeout sweep and the
+        # thread-crash guard fail exactly the requests still in flight
+        self._live: set[_Request] = set()
+        self._live_lock = threading.Lock()
         # empty-handed collect returns; stays 0 with the event-driven wait
         # (pinned by tests) — the old 50 ms poll produced ~20/s while idle
         self._idle_wakeups = 0
@@ -129,19 +162,32 @@ class MicroBatcher:
     def stop(self, drain: bool = True) -> None:
         """Stop the worker thread(s). ``drain=True`` serves what is already
         queued first (FIFO: the wake sentinel lands behind every pending
-        request); False fails pending requests immediately."""
+        request); False fails pending requests immediately. The drain is
+        bounded by ``drain_timeout_s`` (0 = wait forever): on timeout every
+        still-unresolved request fails with :class:`DrainTimeout` and the
+        wedged worker threads are abandoned (they are daemons)."""
         if self._thread is None:
             return
         if not drain:
             self._fail_queued(RuntimeError("batcher stopped"))
         self._stop.set()
         self._q.put(_STOP)  # wakes the blocking collect; drains ahead of it
-        self._join_threads()
+        drained = self._join_threads(self._drain_timeout_s if self._drain_timeout_s > 0 else None)
         self._thread = None
         self._fail_queued(RuntimeError("batcher stopped"))
+        if not drained:
+            self._reg.counter("serve.drain_timeouts").inc()
+            emit(f"[serve] drain timed out after {self._drain_timeout_s:.1f}s; "
+                 "failing in-flight requests and abandoning the wedged worker")
+            self._fail_live(DrainTimeout(
+                f"batcher shutdown drain exceeded {self._drain_timeout_s:.1f}s "
+                "(engine wedged mid-batch?)"
+            ))
 
-    def _join_threads(self) -> None:
-        self._thread.join()
+    def _join_threads(self, timeout_s: float | None = None) -> bool:
+        """Join the worker(s); False when the drain budget ran out first."""
+        self._thread.join(timeout_s)
+        return not self._thread.is_alive()
 
     def _fail_queued(self, exc: Exception) -> None:
         while True:
@@ -151,21 +197,59 @@ class MicroBatcher:
                 return
             if req is _STOP:
                 continue
+            self._finish_err(req, exc)
+
+    def _fail_live(self, exc: Exception) -> None:
+        """Fail every request still unresolved anywhere in the batcher —
+        queued, in a worker's hands, or dispatched-but-unsynced."""
+        for req in list(self._live):
+            self._finish_err(req, exc)
+
+    # -- future resolution (idempotent, the only two mutation paths) --------
+
+    def _finish_ok(self, req: _Request, row) -> bool:
+        with self._live_lock:
+            self._live.discard(req)
+        try:
+            req.future.set_result(row)
+            return True
+        except InvalidStateError:
+            return False  # already failed (drain timeout / crash sweep)
+
+    def _finish_err(self, req: _Request, exc: Exception) -> bool:
+        with self._live_lock:
+            self._live.discard(req)
+        try:
             req.future.set_exception(exc)
+            return True
+        except InvalidStateError:
+            return False
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, image: np.ndarray, *, deadline_ms: float | None = None) -> Future:
+    def submit(
+        self,
+        image: np.ndarray,
+        *,
+        deadline_ms: float | None = None,
+        priority: str | None = None,
+    ) -> Future:
         """Enqueue one (H, W, 3) image; returns a Future resolving to its
         logits row. Raises :class:`QueueFull` when the bounded queue is at
-        capacity (the caller's backpressure signal)."""
+        capacity (the caller's backpressure signal). ``priority`` tags the
+        request with its QoS class (serve/admission.py) for per-class shed
+        attribution; the batcher itself stays FIFO."""
         if self._thread is None:
             raise RuntimeError("batcher not started")
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
-        req = _Request(np.asarray(image, np.float32), deadline_s)
+        req = _Request(np.asarray(image, np.float32), deadline_s, priority)
+        with self._live_lock:
+            self._live.add(req)
         try:
             self._q.put_nowait(req)
         except queue.Full:
+            with self._live_lock:
+                self._live.discard(req)
             self._reg.counter("serve.rejected_full").inc()
             raise QueueFull(f"request queue at capacity ({self._q.maxsize})") from None
         self._reg.counter("serve.requests").inc()  # accepted only, after the enqueue
@@ -205,16 +289,33 @@ class MicroBatcher:
         live: list[_Request] = []
         for req in batch:
             if req.t_deadline is not None and now > req.t_deadline:
-                self._reg.counter("serve.shed_deadline").inc()
-                req.future.set_exception(
-                    DeadlineExceeded(f"queued {now - req.t_enqueue:.3f}s past deadline")
-                )
+                self._shed(req, DeadlineExceeded(f"queued {now - req.t_enqueue:.3f}s past deadline"))
             else:
                 self._reg.histogram("serve.queue_wait_seconds").observe(now - req.t_enqueue)
                 live.append(req)
         return live
 
+    def _shed(self, req: _Request, exc: DeadlineExceeded) -> None:
+        self._reg.counter("serve.shed_deadline").inc()
+        if req.priority:
+            self._reg.counter(f"serve.shed_deadline.{req.priority}").inc()
+        self._finish_err(req, exc)
+
+    def _thread_crash(self, exc: Exception) -> None:
+        """Terminal handler behind every worker's top-level guard (YAMT011):
+        a crashing worker fails every live request instead of dying silently
+        — a silently-dead collect thread would hang every future forever."""
+        self._reg.counter("serve.thread_crashes").inc()
+        emit(f"[serve] worker thread crashed: {type(exc).__name__}: {exc}")
+        self._fail_live(exc)
+
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except Exception as e:  # noqa: BLE001 — terminal: contain, don't hang clients
+            self._thread_crash(e)
+
+    def _loop_inner(self) -> None:
         while True:
             batch = self._collect()
             if batch is None:
@@ -234,8 +335,10 @@ class MicroBatcher:
                 logits = self._predict(np.stack([r.image for r in group]))
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
                 for req in group:
-                    req.future.set_exception(e)
+                    self._finish_err(req, e)
                 continue
+            done = 0
             for req, row in zip(group, logits):
-                req.future.set_result(row)
-            self._reg.counter("serve.completed").inc(len(group))
+                done += self._finish_ok(req, row)
+            if done:
+                self._reg.counter("serve.completed").inc(done)

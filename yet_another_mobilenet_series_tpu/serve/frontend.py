@@ -1,0 +1,244 @@
+"""Loopback HTTP front door: the network edge in front of the batcher.
+
+Pure stdlib (``http.server``), one import away from nothing — the container
+bakes no RPC framework, and the point of this layer is failure BEHAVIOR,
+not protocol sophistication: every request resolves to a result, a typed
+rejection, or a timeout, with the resilience semantics (admission control,
+retry, breaker — serve/admission.py) mapped onto HTTP status codes a load
+balancer already understands.
+
+Endpoints:
+
+``POST /predict``
+    One image per request. Body is either JSON ``{"image": [[[...]]]}``
+    (H, W, 3 nested lists) or raw little-endian float32 bytes
+    (``Content-Type: application/octet-stream``) with an ``X-Shape: H,W,C``
+    header. Per-request QoS rides in headers — ``X-Priority:
+    interactive|batch|best_effort`` and ``X-Deadline-Ms: <float>`` — and is
+    propagated into the admission controller and batcher verbatim.
+    Responses: ``200`` ``{"logits": [...], "priority": cls}``;
+    ``400`` malformed body/headers; ``429`` rejected at arrival (class
+    quota, queue full, or deadline-unmeetable — body carries which);
+    ``503`` breaker open (with ``Retry-After``) or shutdown drain;
+    ``504`` deadline exceeded / server-side timeout; ``500`` engine error
+    after retries. Every error body is ``{"error": <type>, "message": ...}``.
+
+``GET /healthz``
+    The admission controller's state snapshot — breaker state (+ the
+    ``serve.breaker_state`` gauge value), per-class queue occupancy vs
+    quota, EWMA/predicted wait, in-flight window occupancy. Status ``200``
+    while the breaker is closed or half-open, ``503`` while open — a load
+    balancer can drain a sick replica from rotation without parsing JSON.
+
+The server is a ``ThreadingHTTPServer`` bound to loopback by default
+(``cli/serve.py --listen``); its accept loop runs on a guarded daemon
+thread (YAMT011). ``stop()`` shuts the accept loop down and returns — the
+batcher drain (bounded by ``serve.drain_timeout_s``) is the caller's next
+line, so SIGTERM = stop accepting, then drain in-flight work.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..obs.registry import get_registry
+from ..utils.logging import emit
+from .admission import BreakerOpen, DeadlineUnmeetable, BREAKER_OPEN
+from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
+
+# exception type -> (HTTP status, wire error tag); anything else is a 500
+_ERROR_MAP = [
+    (BreakerOpen, 503, "breaker_open"),
+    (DeadlineUnmeetable, 429, "deadline_unmeetable"),
+    (QueueFull, 429, "queue_full"),  # covers ClassQueueFull too
+    (DeadlineExceeded, 504, "deadline_exceeded"),
+    (DrainTimeout, 503, "draining"),
+]
+
+
+def _classify(exc: Exception) -> tuple[int, str]:
+    for typ, status, tag in _ERROR_MAP:
+        if isinstance(exc, typ):
+            return status, tag
+    return 500, "engine_error"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Request handler; the owning :class:`Frontend` is injected as a class
+    attribute by :meth:`Frontend.start` (stdlib handler classes are
+    instantiated per request by the server, so state rides on the class)."""
+
+    frontend: "Frontend" = None  # type: ignore[assignment]
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        # per-request stderr lines would fork the logging path (YAMT007
+        # discipline); request accounting lives in the obs registry instead
+        get_registry().counter("serve.http_requests").inc()
+
+    def _send_json(self, status: int, payload: dict, headers: dict | None = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, status: int, tag: str, message: str, headers: dict | None = None) -> None:
+        get_registry().counter("serve.http_errors").inc()
+        self._send_json(status, {"error": tag, "message": message}, headers)
+
+    # -- GET /healthz -------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802 — stdlib method name
+        if self.path != "/healthz":
+            self._send_error_json(404, "not_found", f"no route {self.path}")
+            return
+        fe = self.frontend
+        state = fe.admission.state()
+        state["inflight"] = int(get_registry().gauge("serve.inflight").value)
+        state["draining"] = fe._draining
+        status = 503 if state["breaker_state"] == BREAKER_OPEN else 200
+        state["ok"] = status == 200 and not fe._draining
+        self._send_json(status, state)
+
+    # -- POST /predict ------------------------------------------------------
+
+    def _parse_image(self) -> np.ndarray:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0:
+            raise ValueError("empty body")
+        body = self.rfile.read(length)
+        ctype = (self.headers.get("Content-Type") or "application/json").split(";")[0].strip()
+        if ctype == "application/octet-stream":
+            shape_hdr = self.headers.get("X-Shape", "")
+            try:
+                shape = tuple(int(s) for s in shape_hdr.split(","))
+            except ValueError:
+                raise ValueError(f"X-Shape must be 'H,W,C' integers, got {shape_hdr!r}") from None
+            image = np.frombuffer(body, dtype="<f4")
+            if len(shape) != 3 or int(np.prod(shape)) != image.size:
+                raise ValueError(f"X-Shape {shape} does not match {image.size} float32 values")
+            image = image.reshape(shape)
+        else:
+            try:
+                doc = json.loads(body)
+                image = np.asarray(doc["image"], np.float32)
+            except (json.JSONDecodeError, KeyError, TypeError) as e:
+                raise ValueError(f"body must be JSON with an 'image' key: {e}") from None
+        if image.ndim != 3:
+            raise ValueError(f"image must be (H, W, C), got shape {tuple(image.shape)}")
+        return image
+
+    def do_POST(self):  # noqa: N802 — stdlib method name
+        if self.path != "/predict":
+            self._send_error_json(404, "not_found", f"no route {self.path}")
+            return
+        fe = self.frontend
+        try:
+            image = self._parse_image()
+            deadline_hdr = self.headers.get("X-Deadline-Ms")
+            deadline_ms = float(deadline_hdr) if deadline_hdr else None
+            priority = self.headers.get("X-Priority") or None
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", str(e))
+            return
+        try:
+            fut = fe.admission.submit(image, priority=priority, deadline_ms=deadline_ms)
+        except ValueError as e:  # unknown priority class
+            self._send_error_json(400, "bad_request", str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — typed arrival rejections
+            status, tag = _classify(e)
+            headers = {"Retry-After": f"{fe.retry_after_s:.0f}"} if status == 503 else None
+            self._send_error_json(status, tag, str(e), headers)
+            return
+        # the handler thread is this request's only waiter: a deadline
+        # extends the server bound (the admission/batcher layers resolve the
+        # future well before this backstop unless something is truly wedged)
+        timeout_s = fe.request_timeout_s + (deadline_ms or 0.0) / 1e3
+        try:
+            logits = fut.result(timeout=timeout_s)
+        except (TimeoutError, FutureTimeout):
+            self._send_error_json(504, "timeout", f"no result within {timeout_s:.1f}s")
+            return
+        except Exception as e:  # noqa: BLE001 — typed shed/failure outcomes
+            status, tag = _classify(e)
+            self._send_error_json(status, tag, str(e))
+            return
+        self._send_json(
+            200,
+            {"logits": np.asarray(logits, np.float64).tolist(),
+             "priority": priority or fe.admission._default_class},
+        )
+
+
+class Frontend:
+    """Owns the HTTP server + accept-loop thread around an
+    :class:`~.admission.AdmissionController`."""
+
+    def __init__(
+        self,
+        admission,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout_s: float = 60.0,
+        retry_after_s: float = 1.0,
+    ):
+        self.admission = admission
+        self._host = host
+        self._port = port
+        self.request_timeout_s = request_timeout_s
+        self.retry_after_s = retry_after_s
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._draining = False
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            raise RuntimeError("frontend not started")
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "Frontend":
+        if self._server is not None:
+            raise RuntimeError("frontend already started")
+        handler = type("_BoundHandler", (_Handler,), {"frontend": self})
+        self._server = ThreadingHTTPServer((self._host, self._port), handler)
+        self._server.daemon_threads = True  # handler threads never block exit
+        self._thread = threading.Thread(target=self._serve, name="serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def _serve(self) -> None:
+        try:
+            self._server.serve_forever(poll_interval=0.1)
+        except Exception as e:  # noqa: BLE001 — YAMT011: never die silently
+            get_registry().counter("serve.thread_crashes").inc()
+            emit(f"[serve] http accept loop crashed: {type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        """Stop accepting; in-flight handler threads finish their responses.
+        The caller drains the batcher next (bounded by drain_timeout_s)."""
+        if self._server is None:
+            return
+        self._draining = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        self._server = None
+        self._thread = None

@@ -45,7 +45,15 @@ Failure semantics are preserved, not weakened:
 - an engine failure at dispatch or at sync fails exactly that batch's
   futures and both threads keep serving;
 - ``stop(drain=True)`` drains the request queue, then the in-flight window,
-  in FIFO order.
+  in FIFO order — BOUNDED by ``drain_timeout_s``: a completion thread
+  wedged inside a hung ``result()`` cannot hang shutdown; the remaining
+  futures fail with :class:`~.batcher.DrainTimeout` and the wedged daemon
+  threads are abandoned (their late answers are dropped by the idempotent
+  resolution helpers);
+- both loops carry top-level exception guards (yamt-lint YAMT011): an
+  unexpected crash fails every live future, counts
+  ``serve.thread_crashes``, and — for the collect thread — still delivers
+  the drain sentinel so the completion thread exits too.
 
 Instrumentation (obs/): ``serve.inflight`` gauge (window occupancy at each
 push/pop) plus everything the engine and shared batcher record —
@@ -85,6 +93,7 @@ class PipelinedBatcher(MicroBatcher):
         max_wait_ms: float = 2.0,
         queue_depth: int = 256,
         default_deadline_ms: float = 0.0,
+        drain_timeout_s: float = 0.0,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -94,6 +103,7 @@ class PipelinedBatcher(MicroBatcher):
             max_wait_ms=max_wait_ms,
             queue_depth=queue_depth,
             default_deadline_ms=default_deadline_ms,
+            drain_timeout_s=drain_timeout_s,
         )
         self._engine = engine
         self._max_inflight = max_inflight
@@ -112,6 +122,20 @@ class PipelinedBatcher(MicroBatcher):
             self._inflight_n += delta
             self._reg.gauge("serve.inflight").set(self._inflight_n)
 
+    def inflight(self) -> int:
+        """Dispatched-but-unsynced batches right now (health/hang reports)."""
+        with self._inflight_lock:
+            return self._inflight_n
+
+    def worker_threads(self) -> list[dict]:
+        """Name/liveness of the batcher's worker threads — the serving
+        section of the watchdog's hang report (obs/watchdog.py)."""
+        return [
+            {"name": t.name, "alive": t.is_alive()}
+            for t in (self._thread, self._completion)
+            if t is not None
+        ]
+
     # -- lifecycle (two threads) --------------------------------------------
 
     def _start_threads(self) -> None:
@@ -120,27 +144,39 @@ class PipelinedBatcher(MicroBatcher):
         self._thread.start()
         self._completion.start()
 
-    def _join_threads(self) -> None:
-        self._thread.join()  # pushes _DRAINED into the in-flight queue on exit
-        self._completion.join()
-        self._completion = None
+    def _join_threads(self, timeout_s: float | None = None) -> bool:
+        # one shared drain budget across both joins, not one budget each
+        deadline = None if timeout_s is None else time.perf_counter() + timeout_s
+        self._thread.join(timeout_s)  # pushes _DRAINED into the in-flight queue on exit
+        if deadline is not None:
+            timeout_s = max(0.0, deadline - time.perf_counter())
+        self._completion.join(timeout_s)
+        drained = not (self._thread.is_alive() or self._completion.is_alive())
+        if drained:
+            self._completion = None
+        return drained
 
     # -- collect/dispatch thread --------------------------------------------
 
     def _collect_loop(self) -> None:
         try:
-            while True:
-                batch = self._collect()
-                if batch is None:
-                    return
-                if not batch:
-                    self._idle_wakeups += 1
-                    continue
-                self._dispatch_batch(batch)
-                if self._exit_after_batch:
-                    return
+            self._collect_loop_inner()
+        except Exception as e:  # noqa: BLE001 — terminal: contain, don't hang clients
+            self._thread_crash(e)
         finally:
             self._inflight.put(_DRAINED)
+
+    def _collect_loop_inner(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            if not batch:
+                self._idle_wakeups += 1
+                continue
+            self._dispatch_batch(batch)
+            if self._exit_after_batch:
+                return
 
     def _acquire_window_topping_up(self, batch: list[_Request]) -> None:
         """Block until a window slot frees, topping the batch up from the
@@ -182,7 +218,7 @@ class PipelinedBatcher(MicroBatcher):
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
                 self._window.release()
                 for req in group:
-                    req.future.set_exception(e)
+                    self._finish_err(req, e)
                 continue
             self._inflight.put((handle, group))
             self._inflight_adj(+1)
@@ -190,6 +226,12 @@ class PipelinedBatcher(MicroBatcher):
     # -- completion thread --------------------------------------------------
 
     def _complete_loop(self) -> None:
+        try:
+            self._complete_loop_inner()
+        except Exception as e:  # noqa: BLE001 — terminal: contain, don't hang clients
+            self._thread_crash(e)
+
+    def _complete_loop_inner(self) -> None:
         while True:
             item = self._inflight.get()
             if item is _DRAINED:
@@ -201,7 +243,7 @@ class PipelinedBatcher(MicroBatcher):
                 self._inflight_adj(-1)
                 self._window.release()
                 for req in live:
-                    req.future.set_exception(e)
+                    self._finish_err(req, e)
                 continue
             # the device is free the moment the sync returns: open the
             # window before the host-side future resolution
@@ -213,13 +255,11 @@ class PipelinedBatcher(MicroBatcher):
                 if req.t_deadline is not None and now > req.t_deadline:
                     # expired while the batch executed: a stale answer is a
                     # shed, not a success (completion-time deadline check)
-                    self._reg.counter("serve.shed_deadline").inc()
                     self._reg.counter("serve.shed_at_completion").inc()
-                    req.future.set_exception(
-                        DeadlineExceeded(f"completed {now - req.t_enqueue:.3f}s past deadline")
-                    )
+                    self._shed(req, DeadlineExceeded(
+                        f"completed {now - req.t_enqueue:.3f}s past deadline"
+                    ))
                 else:
-                    req.future.set_result(row)
-                    done += 1
+                    done += self._finish_ok(req, row)
             if done:
                 self._reg.counter("serve.completed").inc(done)
