@@ -1,0 +1,26 @@
+"""Bad: broad excepts whose pass-only bodies make failures vanish — the
+restore-path bug class (corruption retried as a benign legacy quirk)."""
+
+
+def read_config(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:
+        pass  # which failure? nobody will ever know
+
+
+def read_state(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except:  # noqa: E722 — bare except is the worst variant
+        pass
+
+
+def read_tree(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except (OSError, Exception):  # the tuple still contains a broad type
+        ...

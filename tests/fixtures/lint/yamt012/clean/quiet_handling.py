@@ -1,0 +1,45 @@
+"""Clean: the sanctioned shapes — narrow swallows, broad handlers that act,
+__del__ finalizers, and an explicitly suppressed intentional swallow."""
+
+import os
+
+
+def cleanup(tmp):
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass  # narrow: the one failure this means to ignore
+
+
+def guarded(work, log):
+    try:
+        return work()
+    except Exception as e:
+        log(f"work failed: {e}")  # broad, but the failure is visible
+        return None
+
+
+def reraised(work):
+    try:
+        return work()
+    except Exception:
+        raise RuntimeError("work failed")
+
+
+class Holder:
+    def close(self):
+        pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # finalizer: raising only prints unraisable noise
+
+
+def last_good(read, fallback):
+    try:
+        return read()
+    except Exception:  # yamt-lint: disable=YAMT012 — keep the last good reading
+        pass
+    return fallback

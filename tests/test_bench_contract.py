@@ -10,6 +10,10 @@ traces.
 - scripts/serve_bench.py: the serving benchmark emits the same artifact
   shape (BENCH_SERVE_*.json — p50/p99 latency + QPS per batch bucket) and
   is fast enough to stay in the tier-1 gate via its tiny preset.
+- scripts/train_chaos.py: the TRAINING chaos round (seeded corrupt records
+  + one injected NaN step + a mid-epoch SIGTERM, then a resume) emits the
+  same artifact shape; the contract check here is the kill-and-resume
+  acceptance for the survivable-training PR.
 """
 
 import json
@@ -150,6 +154,53 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
             + rnd["rejected_breaker"] + rnd["rejected_queue_full"])
     # the headline value is the overall peak across direct + concurrent
     assert out["value"] == out["peak_qps"] >= max(r["qps"] for r in out["buckets"])
+    # --out writes the same artifact for the driver to collect
+    assert json.loads(out_path.read_text()) == out
+
+
+def test_train_chaos_emits_parsed_artifact(tmp_path):
+    """scripts/train_chaos.py: exactly one JSON line, bench artifact shape,
+    and the survivable-training acceptance inside it — the chaos round
+    skipped injected corrupt records and the NaN step (counted, bounded),
+    the SIGTERM produced a clean exit with a synchronous checkpoint and a
+    resume marker, and the resume round continued FROM THE KILLED STEP (no
+    restart-from-zero) through to completion with a sane loss."""
+    out_path = tmp_path / "TRAIN_CHAOS_test.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "train_chaos.py"),
+         "--log-dir", str(tmp_path / "run"), "--out", str(out_path)],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "train_chaos_recovered_steps"
+    assert "error" not in out, out.get("error")
+    assert out["value"] is not None and out["value"] > 0
+    assert out["unit"] == "steps" and out["vs_baseline"] is None
+
+    chaos, resume = out["chaos"], out["resume"]
+    # preemption: clean exit, marker written, one preemption counted
+    assert chaos["exit_code"] == 0 and chaos["preemptions"] == 1
+    assert chaos["killed_step"] > 0 and chaos["reason"] == "SIGTERM"
+    # chaos bookkeeping: the injected corrupt records were skipped AND
+    # counted by the resilience wrapper; the injected NaN step was skipped
+    # AND counted by the guard — and neither exhausted its budget
+    assert chaos["injected_corrupt"] >= 1
+    assert chaos["corrupt_records"] >= chaos["injected_corrupt"]
+    assert chaos["injected_nan_steps"] == 1
+    assert chaos["skipped_steps"] >= 1 and chaos["nonfinite_events"] >= 1
+    assert not chaos["health_abort"]
+    # resume: continues from the preemption checkpoint, not from zero
+    assert resume["exit_code"] == 0
+    assert resume["resumed_step"] == chaos["killed_step"] > 0
+    assert resume["marker_consumed"]
+    assert resume["final_step"] > resume["resumed_step"]
+    # loss trajectory continuity: the first post-resume loss stays in the
+    # same regime as the pre-kill loss (no re-init cliff, no blowup)
+    assert resume["loss_after_resume"] is not None and chaos["loss_before_kill"] is not None
+    assert resume["loss_after_resume"] < 3.0 * max(chaos["loss_before_kill"], 0.1)
     # --out writes the same artifact for the driver to collect
     assert json.loads(out_path.read_text()) == out
 

@@ -2,6 +2,7 @@
 bit-identical to never having checkpointed; pruned-shape-first restore."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,58 @@ def test_save_restore_step_bit_equivalence(tmp_path):
 def test_restore_spec_none_when_empty(tmp_path):
     mgr = CheckpointManager(str(tmp_path) + "/empty", async_save=False)
     assert mgr.restore_spec() is None
+    assert mgr.all_steps() == []
+    mgr.close()
+
+
+def test_save_records_digests_and_restore_verifies(tmp_path):
+    """The crash-consistency sidecar: save records per-item digests, an
+    abstract-targeted restore verifies them, and a recorded-vs-restored
+    mismatch raises CheckpointCorrupt (the as-saved export path is exempt:
+    optax containers restore as dicts there, changing leaf order)."""
+    import json
+
+    from yet_another_mobilenet_series_tpu.ckpt import manager as mgr_mod
+
+    cfg, net, opt, ts, step_fn, batch = _mk(tmp_path)
+    ts, _ = step_fn(ts, batch, jax.random.PRNGKey(2))
+    mgr = CheckpointManager(str(tmp_path) + "/ckd", async_save=False)
+    mgr.save(int(ts.step), net, jax.device_get(ts), extra={})
+    mgr.wait()
+
+    digest_path = tmp_path / "ckd" / mgr_mod.DIGEST_NAME
+    index = json.loads(digest_path.read_text())
+    items = index[str(int(ts.step))]
+    # every non-empty TrainState item is protected
+    assert {"step", "params", "state", "opt_state", "ema_params",
+            "ema_state", "masks", "rho_mult"} <= set(items)
+
+    abstract = steps.train_state_to_dict(jax.eval_shape(lambda: ts))
+    tree = mgr.restore_tree(int(ts.step), abstract)  # verifies, passes
+    assert set(tree) == set(abstract)
+
+    # simulate value corruption Orbax's storage checks can't see
+    items["params"] = "0" * 64
+    digest_path.write_text(json.dumps(index))
+    from yet_another_mobilenet_series_tpu.ckpt.manager import CheckpointCorrupt
+
+    with pytest.raises(CheckpointCorrupt, match="params"):
+        mgr.restore_tree(int(ts.step), abstract)
+    mgr.restore_tree(int(ts.step))  # as-saved export read stays unverified
+    mgr.close()
+
+
+def test_tree_keys_reports_saved_items(tmp_path):
+    """tree_keys is the legacy-vs-corruption discriminator: it must list the
+    items actually on disk (including None-valued fields) without reading
+    any array bytes."""
+    cfg, net, opt, ts, step_fn, batch = _mk(tmp_path)
+    mgr = CheckpointManager(str(tmp_path) + "/ckk", async_save=False)
+    mgr.save(3, net, jax.device_get(ts), extra={})
+    mgr.wait()
+    keys = mgr.tree_keys(3)
+    assert keys is not None and {"params", "opt_state", "rho_mult"} <= keys
+    assert mgr.tree_keys(99) is None  # nonexistent step degrades to None
     mgr.close()
 
 

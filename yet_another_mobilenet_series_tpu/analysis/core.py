@@ -250,6 +250,7 @@ def load_rules() -> list[Rule]:
         rules_prng_flow,
         rules_recompile,
         rules_spmd,
+        rules_swallow,
         rules_threads,
         rules_tracing,
     )

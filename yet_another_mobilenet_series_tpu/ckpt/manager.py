@@ -9,10 +9,25 @@ rides in the same Orbax step directory as a JSON item next to the pytree.
 
 Orbax gives async saves (preemption loses minutes, not epochs — SURVEY.md §5
 failure-detection plan) and multi-host coordination for free.
+
+Crash consistency (the robustness PR): every save also records a per-item
+sha256 digest in ``digests.json`` at the manager root, and every RESUME
+restore (abstract-targeted) recomputes and compares — a half-written or
+bit-rotted item that Orbax's own storage checks miss raises
+:class:`CheckpointCorrupt` instead of silently resuming from garbage. The
+digest file lives OUTSIDE the step dirs so Orbax's max_to_keep garbage
+collection never races it; entries for collected steps are pruned at the
+next save. ``all_steps()``/``tree_keys()`` feed cli/train.py's fallback
+restore: when the latest checkpoint is unusable (corrupt meta JSON,
+truncated tree item, digest mismatch) resume walks back step by step
+instead of crashing.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import time
 from typing import Any
 
@@ -24,10 +39,40 @@ from ..models.serialize import network_from_dict, network_to_dict
 from ..models.specs import Network
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
+from ..utils.logging import emit
+
+DIGEST_NAME = "digests.json"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """Restored checkpoint bytes do not match the per-item digests recorded
+    at save time — a half-written or corrupted item. The resume path treats
+    this exactly like an Orbax read error: fall back to an older step."""
+
+
+def _item_digests(tree: dict) -> dict[str, str]:
+    """Per-top-level-item sha256 over every leaf's (dtype, shape, bytes) in
+    flatten order. Items whose subtree holds no array leaves (None fields —
+    EMA off, rho_mult without pruning) are omitted: there are no bytes to
+    protect and the save/restore structures agree by construction."""
+    out: dict[str, str] = {}
+    for key in sorted(tree):
+        leaves = jax.tree_util.tree_leaves(tree[key])
+        if not leaves:
+            continue
+        h = hashlib.sha256()
+        for leaf in leaves:
+            a = np.asarray(leaf)
+            h.update(str(a.dtype).encode())
+            h.update(repr(a.shape).encode())
+            h.update(a.tobytes())
+        out[key] = h.hexdigest()
+    return out
 
 
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True, barrier_prefix: str | None = None):
+    def __init__(self, directory: str, max_to_keep: int = 3, async_save: bool = True,
+                 barrier_prefix: str | None = None, integrity: bool = True):
         """barrier_prefix namespaces Orbax's cross-host sync barriers.
 
         Orbax barrier keys are global per process (e.g.
@@ -37,7 +82,14 @@ class CheckpointManager:
         second multi-host barrier dies with FAILED_PRECONDITION "already
         ongoing" and takes the whole distributed job down. Single-host runs
         never hit this (no distributed barrier), so every extra manager
-        MUST pass a distinct prefix (caught by tests/test_multiproc.py)."""
+        MUST pass a distinct prefix (caught by tests/test_multiproc.py).
+
+        integrity=False skips digest bookkeeping (benches that checkpoint in
+        a tight loop); resume then behaves exactly as before this landed."""
+        self._dir = directory
+        self._integrity = integrity
+        self._max_to_keep = max_to_keep
+        self._digest_warned = False
         self._mgr = ocp.CheckpointManager(
             directory,
             options=ocp.CheckpointManagerOptions(
@@ -67,10 +119,87 @@ class CheckpointManager:
                     meta=ocp.args.JsonSave(meta),
                 ),
             )
+        if self._integrity and jax.process_index() == 0:
+            # digests are computed from the live host tree BEFORE the async
+            # write lands, so a torn write can never produce matching bytes;
+            # coordinator-only like the JSON sidecars orbax itself writes
+            self._record_digests(int(step), _item_digests(tree))
         get_registry().counter("ckpt.saves").inc()
+
+    # -- digest sidecar ------------------------------------------------------
+
+    def _load_digests(self) -> dict:
+        try:
+            with open(os.path.join(self._dir, DIGEST_NAME)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _record_digests(self, step: int, digests: dict[str, str]) -> None:
+        index = self._load_digests()
+        index[str(step)] = digests
+        # prune entries for steps Orbax already garbage-collected (keep a
+        # max_to_keep-sized margin: the collection is async)
+        live = {str(s) for s in self._mgr.all_steps()} | {str(step)}
+        keep = {k: v for k, v in index.items() if k in live}
+        tmp = os.path.join(self._dir, f"{DIGEST_NAME}.tmp.{os.getpid()}")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(keep, f, indent=0, sort_keys=True)
+            os.replace(tmp, os.path.join(self._dir, DIGEST_NAME))
+        except OSError as e:
+            # a read-only or full checkpoint dir degrades integrity
+            # bookkeeping, not the save itself — but say so, once
+            if not self._digest_warned:
+                self._digest_warned = True
+                emit(f"[ckpt] WARNING: could not write {DIGEST_NAME} "
+                     f"({type(e).__name__}: {e}); restore integrity "
+                     "verification is disabled for this run")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _verify(self, step: int, tree: dict) -> None:
+        expected = self._load_digests().get(str(step))
+        if not expected:
+            return  # pre-digest checkpoint (or sidecar lost): nothing to judge
+        actual = _item_digests(tree)
+        bad = sorted(k for k, v in actual.items() if k in expected and expected[k] != v)
+        if bad:
+            get_registry().counter("ckpt.integrity_failures").inc()
+            raise CheckpointCorrupt(
+                f"step {step}: restored item(s) {bad} do not match the digests "
+                f"recorded at save time (half-written or corrupted checkpoint)"
+            )
+
+    # -- queries -------------------------------------------------------------
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        """Available checkpoint steps, NEWEST FIRST — the fallback-restore
+        candidate order (cli/train.py _restore)."""
+        return sorted((int(s) for s in self._mgr.all_steps()), reverse=True)
+
+    def tree_keys(self, step: int) -> set[str] | None:
+        """Top-level item names of the saved tree, from Orbax metadata only
+        (no array reads). None when the metadata itself is unreadable.
+
+        This is what lets the resume path tell a LEGACY layout (a field
+        genuinely absent from the save, e.g. pre-rho_mult checkpoints) from
+        corruption of a field that IS on disk — the distinction the old bare
+        ``except Exception`` retry erased."""
+        try:
+            md = self._mgr.item_metadata(step)["tree"]
+            return set(md.keys())
+        except Exception as e:  # noqa: BLE001 — metadata loss is itself corruption
+            emit(f"[ckpt] step {step}: tree metadata unreadable "
+                 f"({type(e).__name__}: {e})")
+            return None
+
+    # -- restore -------------------------------------------------------------
 
     def restore_spec(self, step: int | None = None):
         """Phase 1 of resume: returns (step, net, extra) with the network
@@ -90,12 +219,21 @@ class CheckpointManager:
         NamedTuple states and dtypes round-trip exactly. ``None`` restores
         as-saved (plain nested dicts of host arrays) — the serving export
         path (serve/export.py) reads weights without rebuilding an optimizer
-        skeleton."""
+        skeleton.
+
+        Abstract-targeted restores (the RESUME path) are digest-verified
+        against the save-time sidecar; a mismatch raises
+        :class:`CheckpointCorrupt`. The as-saved path skips verification:
+        without the abstract target Orbax rebuilds optax containers as plain
+        dicts, which changes leaf order, and export reads are not the
+        crash-consistency surface."""
         with obs_trace.get_tracer().span("ckpt/restore_tree", "ckpt", step=int(step)):
             restore_args = ocp.args.StandardRestore(abstract_tree) if abstract_tree is not None else ocp.args.StandardRestore()
             tree = self._mgr.restore(
                 step, args=ocp.args.Composite(tree=restore_args)
             )["tree"]
+        if abstract_tree is not None and self._integrity:
+            self._verify(int(step), tree)
         get_registry().counter("ckpt.restores").inc()
         return tree
 

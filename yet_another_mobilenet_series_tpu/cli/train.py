@@ -13,19 +13,29 @@ touching the compiled step: spans time every host-side phase (data fetch,
 dispatch, syncs, prune, eval, checkpoint, rebuilds), the metrics registry
 rides into every scalars row, and the stall watchdog turns a wedged tunnel
 into a hang_report.json instead of a silent death.
+
+Survivability (the training-side robustness layer, README "Preemption &
+resume"): SIGTERM/SIGINT triggers a final SYNCHRONOUS checkpoint and a
+clean exit with a resume marker instead of losing the epoch; restore walks
+back through older checkpoints when the latest is corrupt or half-written
+(digest-verified, ckpt/manager.py); train.guard skips-and-rolls-back
+bounded non-finite steps (train/guard.py); the data stream skips corrupt
+records with bounded abort (data/pipeline.py); and train.faults injects all
+of the above deterministically (train/faults.py, scripts/train_chaos.py).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
 import jax
 import numpy as np
 
-from ..ckpt.manager import CheckpointManager
+from ..ckpt.manager import CheckpointCorrupt, CheckpointManager
 from ..config import Config, parse_cli
 from .. import data as data_lib
 from ..models import get_model
@@ -40,6 +50,12 @@ from ..utils.cadence import StepCadence
 from ..utils.logging import Logger
 from ..utils.meters import MetricLogger, format_metrics
 from ..utils.profiling import profile_network
+
+
+# written next to the checkpoint on a clean preemption exit; consumed (and
+# removed) by the next resumed run. Schedulers/operators can poll it to tell
+# "checkpointed and exited on purpose" from "died".
+PREEMPT_MARKER_NAME = "preempt_marker.json"
 
 
 def _dataset_sizes(cfg: Config) -> tuple[int, int]:
@@ -142,29 +158,120 @@ class Trainer:
         return ts
 
 
-def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
-    """Two-phase resume (SURVEY.md §3.5): spec -> rebuild at pruned shape ->
-    weights. Returns (trainer, ts, extra) or None."""
+class _Preemption:
+    """SIGTERM/SIGINT -> cooperative stop flag. The loop checks ``requested``
+    at step boundaries and exits through the final-synchronous-checkpoint
+    path (a preemption loses at most the in-flight step, not the epoch).
+
+    Handlers install only in the main thread (embedded/test runs keep their
+    own); the previous handlers are restored on uninstall so an in-process
+    caller (pytest) is left untouched. Multi-host note: the scheduler
+    delivers the signal to every host and the loops run in lockstep, so all
+    hosts reach the same collective save — the same assumption Orbax's own
+    preemption handling makes."""
+
+    def __init__(self, log: Logger):
+        self._log = log
+        self.requested = False
+        self.reason = ""
+        self._prev: dict = {}
+
+    def _handle(self, signum, frame):
+        self.requested = True
+        self.reason = signal.Signals(signum).name
+        self._log.log(f"{self.reason} received: will checkpoint and exit at the "
+                      "next step boundary")
+
+    def install(self) -> "_Preemption":
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            except ValueError:
+                break  # not the main thread: cooperative flag only
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except ValueError:
+                pass  # uninstall from a non-main thread: nothing was installed
+        self._prev.clear()
+
+
+def _restore_tree(ckpt: CheckpointManager, step: int, abstract: dict, log: Logger):
+    """restore_tree with the NARROW legacy-rho_mult retry: the old bare
+    ``except Exception`` retried EVERY failure as a legacy checkpoint, which
+    masked genuine corruption as a shape quirk. Now the retry happens only
+    when the saved tree demonstrably lacks the rho_mult item (or its
+    metadata is unreadable — the pre-metadata behavior, kept for old saves);
+    digest mismatches and failures of a checkpoint that HAS the item
+    propagate to the fallback walk with their cause logged."""
     import jax.numpy as jnp
 
-    spec = ckpt.restore_spec()
-    if spec is None:
-        return None
-    step, net, extra = spec
-    trainer = Trainer(cfg, net, mesh, log)
-    abstract = steps.train_state_to_dict(trainer.abstract_state())
     try:
-        tree = ckpt.restore_tree(step, abstract)
+        return ckpt.restore_tree(step, abstract)
+    except CheckpointCorrupt:
+        raise  # verified corruption is never a legacy-layout quirk
     except Exception as e:  # noqa: BLE001 — orbax raises bare ValueError
         if "rho_mult" not in abstract or abstract["rho_mult"] is None:
+            raise
+        saved = ckpt.tree_keys(step)
+        if saved is not None and "rho_mult" in saved:
+            # the item exists on disk: this failure is corruption or a real
+            # shape mismatch, not the pre-rho_mult layout
+            log.log(f"restore at step {step} failed ({type(e).__name__}: {e}); "
+                    "saved tree HAS rho_mult, so this is not a legacy checkpoint")
             raise
         # legacy checkpoint written before TrainState grew rho_mult: restore
         # without it and inject the neutral multiplier
         log.log(f"restore with rho_mult failed ({type(e).__name__}); retrying as legacy checkpoint")
         tree = ckpt.restore_tree(step, {k: v for k, v in abstract.items() if k != "rho_mult"})
         tree["rho_mult"] = jnp.ones((), jnp.float32)
-    ts = trainer.place_state(steps.TrainState(**tree))
-    return trainer, ts, extra
+        return tree
+
+
+def _restore(ckpt: CheckpointManager, cfg: Config, mesh, log: Logger):
+    """Two-phase resume (SURVEY.md §3.5): spec -> rebuild at pruned shape ->
+    weights. Returns (trainer, ts, extra) or None when no checkpoint exists.
+
+    Crash-consistent: candidates are tried NEWEST FIRST and a step whose
+    spec sidecar is unreadable, whose tree fails to restore, or whose bytes
+    fail digest verification (ckpt/manager.py) is logged, counted
+    (``ckpt.restore_fallbacks``), and SKIPPED in favor of the previous step
+    — a preemption mid-save costs one checkpoint interval, not the run.
+    Raises only when checkpoints exist but none restores."""
+    candidates = ckpt.all_steps()
+    if not candidates:
+        return None
+    last_err = None
+    for i, step in enumerate(candidates):
+        if i:
+            obs_registry.get_registry().counter("ckpt.restore_fallbacks").inc()
+            log.log(f"falling back to checkpoint step {step}")
+        try:
+            spec = ckpt.restore_spec(step)
+        except Exception as e:  # noqa: BLE001 — a torn sidecar must not end resume
+            log.log(f"checkpoint step {step}: spec sidecar unreadable "
+                    f"({type(e).__name__}: {e})")
+            last_err = e
+            continue
+        _, net, extra = spec
+        trainer = Trainer(cfg, net, mesh, log)
+        abstract = steps.train_state_to_dict(trainer.abstract_state())
+        try:
+            tree = _restore_tree(ckpt, step, abstract, log)
+        except Exception as e:  # noqa: BLE001 — corrupt tree: walk back one step
+            log.log(f"checkpoint step {step}: tree restore failed "
+                    f"({type(e).__name__}: {e})")
+            last_err = e
+            continue
+        ts = trainer.place_state(steps.TrainState(**tree))
+        return trainer, ts, extra
+    raise RuntimeError(
+        f"no restorable checkpoint: all {len(candidates)} candidate step(s) "
+        f"{candidates} failed — see the per-step causes above"
+    ) from last_err
 
 
 def evaluate(trainer: Trainer, ts: steps.TrainState, cfg: Config, *, use_ema=True,
@@ -353,7 +460,33 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
         cfg.train.log_dir + "/ckpt", max_to_keep=cfg.train.max_checkpoints,
         barrier_prefix="periodic",
     )
+    # the best-checkpoint manager is created lazily on the first new-best
+    # eval, inside _train_or_eval; the shared box lets the finally below see
+    # it on every exit path
+    best_box: list[CheckpointManager] = []
+    try:
+        return _train_or_eval(cfg, net, log, mesh, is_coord, tracer, watchdog, ckpt, best_box)
+    finally:
+        # EVERY exit path — normal, KeyboardInterrupt, any raise — waits for
+        # in-flight async saves BEFORE closing, so a checkpoint is never
+        # abandoned half-written (the crash-consistency contract resume
+        # relies on); a failed wait is logged, never allowed to mask the
+        # original exception
+        for mgr in (best_box[0] if best_box else None, ckpt):
+            if mgr is None:
+                continue
+            try:
+                mgr.wait()
+            except Exception as e:  # noqa: BLE001 — shutdown must reach close()
+                log.log(f"checkpoint wait on shutdown failed ({type(e).__name__}: {e})")
+            try:
+                mgr.close()
+            except Exception as e:  # noqa: BLE001 — best-effort shutdown
+                log.log(f"checkpoint close on shutdown failed ({type(e).__name__}: {e})")
 
+
+def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool, tracer,
+                   watchdog, ckpt: CheckpointManager, best_box: list) -> dict:
     # ---- eval-only path (acceptance config #1) ----
     if cfg.train.test_only:
         if cfg.train.torch_pretrained:
@@ -375,10 +508,10 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                 trainer, ts, _ = restored
         result = evaluate(trainer, ts, cfg, watchdog=watchdog)
         log.log(format_metrics("eval:", result))
-        ckpt.close()
         return result
 
     # ---- training path ----
+    reg = obs_registry.get_registry()
     rng = jax.random.PRNGKey(cfg.train.seed)
     restored = _restore(ckpt, cfg, mesh, log) if cfg.train.resume else None
     start_epoch = 0.0
@@ -386,21 +519,50 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
         trainer, ts, extra = restored
         start_epoch = float(extra.get("epoch", int(ts.step) / trainer.steps_per_epoch))
         log.log(f"resumed at step {int(ts.step)} (epoch {start_epoch:.2f})")
+        marker = os.path.join(cfg.train.log_dir, PREEMPT_MARKER_NAME)
+        if is_coord and os.path.exists(marker):
+            # the marker's job (tell the scheduler/operator a clean resume
+            # point exists) is done once the resume actually happened
+            os.remove(marker)
+            log.log("preemption resume marker consumed")
     else:
         log.mark_fresh_run()  # truncate metrics.jsonl: steps restart at 0
         trainer, ts = _init_or_warm_start(cfg, net, mesh, log, rng)
 
+    start_step = int(ts.step)
     local_batch = mesh_lib.local_batch_slice(cfg.train.batch_size, mesh)
-    train_iter = mesh_lib.prefetch_to_mesh(
-        data_lib.make_train_source(
+    if cfg.train.faults.enable:
+        # seeded train-side chaos (train/faults.py): wraps the RAW stream so
+        # injected corrupt records travel the real resilience path
+        from ..train.faults import FaultyTrainSource
+
+        train_src = data_lib.make_train_source(
+            cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count(),
+            start_step=start_step,
+            inject=lambda it: FaultyTrainSource.from_config(it, cfg.train.faults,
+                                                            start_step=start_step),
+        )
+    else:
+        train_src = data_lib.make_train_source(
             cfg.data, local_batch, cfg.train.seed, jax.process_index(), jax.process_count(),
             # resume continues the data order at the restored step (each
             # global step consumed exactly one local batch per host)
-            start_step=int(ts.step),
-        ),
-        mesh,
-        depth=cfg.data.device_prefetch,
-    )
+            start_step=start_step,
+        )
+    train_iter = mesh_lib.prefetch_to_mesh(train_src, mesh, depth=cfg.data.device_prefetch)
+
+    # step health guard (train/guard.py): the device half is already wrapped
+    # into the compiled step (parallel/dp.py); this is the host accounting
+    guard = None
+    if cfg.train.guard.enable:
+        from ..train.guard import StepGuard
+
+        guard = StepGuard(cfg.train.guard, cfg.train.log_dir if is_coord else None, log)
+        if watchdog is not None:
+            watchdog.register_info("train_guard", guard.info)
+
+    preempt = _Preemption(log).install()
+    preempted = False
 
     total_epochs = cfg.train.epochs
     spe = trainer.steps_per_epoch
@@ -445,6 +607,9 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
             t_epoch = time.perf_counter()
             steps_done = 0
             while steps_done < epoch_steps:
+                if preempt.requested:
+                    preempted = True
+                    break
                 if grouped_step is not None and epoch_steps - steps_done >= k_dispatch:
                     with tracer.span("data/next", "data", batches=k_dispatch):
                         bs = tuple(next(train_iter) for _ in range(k_dispatch))
@@ -465,6 +630,8 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                     host_step += 1
                     step_i = host_step
                     metric_log.update(metrics, batch_images=cfg.train.batch_size)
+                    if guard is not None:
+                        guard.observe(step_i, metrics)  # lazy stash; no sync
                     if watchdog is not None:
                         watchdog.arm(step_i)
 
@@ -523,7 +690,13 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                         # gauge that every scalars row snapshots)
                         log.log(format_metrics(f"step {step_i}:", snap))
                         log.scalars(step_i, snap, "train/")
-                        if snap.get("finite", 1.0) < 1.0:
+                        if guard is not None:
+                            # the guard already rolled back any non-finite
+                            # step on device; here it counts the skips and
+                            # enforces the budget (train/guard.py) — may
+                            # raise TrainHealthError with train_health.json
+                            guard.check(step_i)
+                        elif snap.get("finite", 1.0) < 1.0:
                             log.error("non-finite loss detected; aborting")
                             raise FloatingPointError("non-finite loss")
                     if cfg.train.check_finite_every and step_i % cfg.train.check_finite_every == 0:
@@ -541,6 +714,11 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                         if div != 0.0:
                             log.error(f"replica divergence {div} at step {step_i}")
                             raise RuntimeError("replica divergence")
+            if preempted:
+                epoch = host_step / spe  # exact mid-epoch position
+                log.log(f"preemption ({preempt.reason}): stopping at step {host_step} "
+                        f"(epoch {epoch:.2f})")
+                break
             epoch += epoch_steps / spe
             log.log(f"epoch {epoch:.2f} done in {time.perf_counter()-t_epoch:.1f}s")
 
@@ -575,6 +753,7 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                             best_ckpt = CheckpointManager(
                                 cfg.train.log_dir + "/ckpt_best", max_to_keep=1, barrier_prefix="best"
                             )
+                            best_box.append(best_ckpt)  # shutdown wait/close (_run_impl)
                         best_ckpt.save(
                             int(ts.step), trainer.net, jax.device_get(trainer.checkpoint_view(ts)),
                             extra={"epoch": epoch, "best_top1": best_top1},
@@ -598,10 +777,44 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                     watchdog.arm(host_step, phase="checkpoint")
 
     finally:
+        preempt.uninstall()
         if trace_active:
             # training ended (or raised) inside the capture window:
             # flush the trace rather than losing it
             jax.profiler.stop_trace()
+
+    if guard is not None:
+        guard.check(host_step)  # flush verdicts the last log window missed
+
+    if preempted:
+        # final SYNCHRONOUS checkpoint: save, then WAIT — the process exits
+        # right after, so an async enqueue alone could be reaped half-written
+        # (exactly the torn state the digest sidecar would then reject)
+        log.log(f"preemption checkpoint: saving step {host_step} synchronously")
+        ckpt.save(
+            host_step, trainer.net, jax.device_get(trainer.checkpoint_view(ts)),
+            extra={"epoch": epoch, "best_top1": best_top1, "preempted": True},
+        )
+        ckpt.wait()
+        reg.counter("train.preemptions").inc()
+        if is_coord:
+            marker = {
+                "step": host_step,
+                "epoch": epoch,
+                "reason": preempt.reason,
+                "checkpoint_dir": cfg.train.log_dir + "/ckpt",
+            }
+            marker_path = os.path.join(cfg.train.log_dir, PREEMPT_MARKER_NAME)
+            tmp = f"{marker_path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(marker, f, indent=1)
+            os.replace(tmp, marker_path)
+            log.log(f"resume marker -> {marker_path}; restart with train.resume=true "
+                    "to continue from here")
+        final = {"epoch": epoch, "step": host_step, "preempted": True,
+                 **{f"eval_{k}": v for k, v in eval_result.items()}}
+        log.log(format_metrics("preempted:", final))
+        return final
 
     if cfg.prune.enable:
         # apply any remaining masks physically and emit the searched result
@@ -627,11 +840,9 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                 f"({prof_final.total_macs/1e6:.1f}M MACs, {prof_final.total_params/1e6:.2f}M params)"
             )
 
-    ckpt.wait()
-    ckpt.close()
-    if best_ckpt is not None:
-        best_ckpt.wait()
-        best_ckpt.close()
+    # manager wait+close happens in _run_impl's finally — on THIS path and on
+    # every error path, wait always precedes close (an in-flight async save
+    # is never abandoned half-written)
     final = {"epoch": epoch, **{f"eval_{k}": v for k, v in eval_result.items()}}
     log.log(format_metrics("done:", final))
     return final

@@ -144,6 +144,19 @@ class DataConfig:
     deterministic_input: bool = False
     mean: Sequence[float] = (0.485, 0.456, 0.406)
     std: Sequence[float] = (0.229, 0.224, 0.225)
+    # survive corrupt/undecodable records: a batch lost to a decode error is
+    # skipped and counted (data.corrupt_records) instead of killing the run;
+    # max_consecutive_failures consecutive lost batches abort loudly (a fully
+    # rotten shard must not spin forever). tf.data loses the whole batch the
+    # record landed in; the native C++ loader skips at record granularity and
+    # counts data.decode_failures (data/pipeline.py resilient_batches).
+    skip_corrupt_records: bool = True
+    max_consecutive_failures: int = 16
+    # host-side background prefetch thread between the pipeline and the
+    # device-prefetch stage: decouples batch production from the train loop
+    # and survives worker crashes with a bounded restart
+    # (data/pipeline.py PrefetchWorker; crash guard per yamt-lint YAMT011)
+    prefetch_thread: bool = False
     # ship images host->device as uint8 and normalize IN-STEP (on device)
     # instead of shipping normalized f32: 4x less PCIe/transfer volume. At
     # the v4-32 acceptance point the f32 feed costs ~34 GB/s/host (57k
@@ -243,6 +256,48 @@ class PruneConfig:
 
 
 @dataclass(frozen=True)
+class GuardConfig:
+    """Step health guard (train/guard.py): skip-and-count non-finite steps by
+    restoring the pre-step TrainState IN-PROGRAM (a device-side select — no
+    extra host syncs; the host reads the verdicts once per train.log_every
+    boundary), abort with a train_health.json dump when the bound is
+    exceeded. Off by default: the legacy behavior (abort on the first
+    non-finite loss seen at a log boundary) is the conservative debug
+    default; long production runs enable the guard so one bad batch costs
+    one step, not the job."""
+
+    enable: bool = False
+    # total non-finite (skipped) steps tolerated per run before the guard
+    # aborts with TrainHealthError + train_health.json
+    max_skipped_steps: int = 10
+
+
+@dataclass(frozen=True)
+class TrainFaultsConfig:
+    """Deterministic, seeded fault injection around the TRAIN data stream
+    (train/faults.py) — the training twin of serve/faults.py: every recovery
+    path (corrupt-record skip, non-finite step rollback, loader-stall
+    watchdog, SIGTERM preemption checkpoint) is dead code until something
+    fails, and chaos must be reproducible. Off in production."""
+
+    enable: bool = False
+    seed: int = 0
+    # per-pull probability of raising CorruptRecordError instead of a batch
+    # (exercises data.skip_corrupt_records + data.corrupt_records counting)
+    corrupt_record_rate: float = 0.0
+    # global step indices whose batch gets a NaN poisoned in (exercises the
+    # train.guard rollback); () = never
+    nan_at_steps: Sequence[int] = ()
+    # stall the loader for stall_ms at this global step (watchdog drill);
+    # -1 = never
+    stall_at_step: int = -1
+    stall_ms: float = 0.0
+    # send THIS process SIGTERM after serving this global step's batch
+    # (deterministic preemption drill); -1 = never
+    kill_at_step: int = -1
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     epochs: float = 350.0
     batch_size: int = 256  # GLOBAL batch size (split across data-parallel chips)
@@ -306,6 +361,9 @@ class TrainConfig:
     # (train/tuning.py; eval accuracy is immune: eval always runs exact BN
     # + stock conv lowering). "" = off.
     tuning_file: str = ""
+    # step health guard + train-side chaos injection sub-blocks
+    guard: GuardConfig = field(default_factory=GuardConfig)
+    faults: TrainFaultsConfig = field(default_factory=TrainFaultsConfig)
 
 
 @dataclass(frozen=True)
@@ -520,6 +578,8 @@ _SECTION_TYPES = {
     "ScheduleConfig": ScheduleConfig,
     "EMAConfig": EMAConfig,
     "PruneConfig": PruneConfig,
+    "GuardConfig": GuardConfig,
+    "TrainFaultsConfig": TrainFaultsConfig,
     "TrainConfig": TrainConfig,
     "DistConfig": DistConfig,
     "ObsConfig": ObsConfig,

@@ -53,27 +53,48 @@ def _check(cfg: DataConfig) -> None:
 
 
 def make_train_source(cfg: DataConfig, local_batch: int, seed: int, process_index: int = 0,
-                      process_count: int = 1, start_step: int = 0) -> Iterator[dict]:
+                      process_count: int = 1, start_step: int = 0, inject=None) -> Iterator[dict]:
     """Infinite iterator of {'image','label'} numpy batches (this host's shard).
 
     start_step: local batches this host already consumed (== the global train
     step; identical on every host). A resumed run CONTINUES the data order
     from there instead of replaying the epoch-0 shuffle — bit-exact for the
     fake/tfdata and folder/native paths, epoch-faithful for TFRecords
-    (pipeline.make_train_dataset docstring; tests/test_resume_data.py)."""
+    (pipeline.make_train_dataset docstring; tests/test_resume_data.py).
+
+    inject: optional wrapper applied to the RAW stream before the resilience
+    layers — the train-side chaos hook (train/faults.py FaultyTrainSource),
+    placed there so injected corrupt records exercise the same skip/count/
+    abort path real ones take. The resilience stack around it:
+    corrupt-record skip with bounded consecutive-failure abort
+    (cfg.skip_corrupt_records; pipeline.resilient_batches) and an optional
+    guarded background prefetch thread (cfg.prefetch_thread;
+    pipeline.PrefetchWorker)."""
     _check(cfg)
     if cfg.loader == "native":
         from . import native_loader
 
-        return iter(native_loader.make_native_train_iter(
+        src = iter(native_loader.make_native_train_iter(
             cfg, local_batch, seed, process_index, process_count, start_step=start_step))
-    if cfg.loader == "synthetic":
+    elif cfg.loader == "synthetic":
         # position-independent by construction (the same device-resident
         # batch forever) — nothing to skip
-        return _pipeline.synthetic_device_batches(cfg, local_batch, cfg.fake_num_classes or 1000)
-    ds = _pipeline.make_train_dataset(cfg, local_batch, seed, process_index, process_count,
-                                      start_step=start_step)
-    return _pipeline.as_numpy(ds)
+        src = _pipeline.synthetic_device_batches(cfg, local_batch, cfg.fake_num_classes or 1000)
+    else:
+        ds = _pipeline.make_train_dataset(cfg, local_batch, seed, process_index, process_count,
+                                          start_step=start_step)
+        # the RAW tf iterator object, not the as_numpy generator: a decode
+        # error raised through a generator kills the generator (subsequent
+        # next() is StopIteration), while tf's own iterator keeps serving
+        # past the bad batch — which is what resilient_batches relies on
+        src = iter(ds.as_numpy_iterator())
+    if inject is not None:
+        src = inject(src)
+    if cfg.skip_corrupt_records:
+        src = _pipeline.resilient_batches(src, max_consecutive=cfg.max_consecutive_failures)
+    if cfg.prefetch_thread:
+        src = _pipeline.PrefetchWorker(src, depth=cfg.prefetch)
+    return src
 
 
 def make_eval_source(cfg: DataConfig, local_batch: int, process_index: int = 0, process_count: int = 1) -> Iterator[dict]:

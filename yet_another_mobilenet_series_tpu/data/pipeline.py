@@ -508,13 +508,170 @@ def _fake_dataset(cfg: DataConfig, local_batch: int, seed: int, train: bool,
 
 
 # ---------------------------------------------------------------------------
-# numpy iterators
+# numpy iterators + fault tolerance
 # ---------------------------------------------------------------------------
 
 
 def as_numpy(ds) -> Iterator[dict]:
     for batch in ds.as_numpy_iterator():
         yield batch
+
+
+class CorruptRecordError(RuntimeError):
+    """A record (or the batch it landed in) could not be decoded. Raised by
+    the train/faults.py injector and recognized by resilient_batches; the
+    real tf.data equivalents (InvalidArgumentError from a rotten JPEG,
+    DataLossError from torn TFRecord framing) are classified alongside it."""
+
+
+class DataPipelineError(RuntimeError):
+    """Too many CONSECUTIVE corrupt batches: the stream is systematically
+    broken (rotten shard, wrong directory), not transiently unlucky."""
+
+
+def _is_corrupt_record_error(e: BaseException) -> bool:
+    if isinstance(e, CorruptRecordError):
+        return True
+    # classify tf errors without importing tensorflow for non-tf pipelines
+    if (type(e).__module__ or "").startswith("tensorflow"):
+        tf = _tf_mod()
+        return isinstance(e, (tf.errors.InvalidArgumentError, tf.errors.DataLossError))
+    return False
+
+
+def resilient_batches(it: Iterator[dict], max_consecutive: int = 16) -> Iterator[dict]:
+    """Wraps a batch iterator so a corrupt/undecodable record costs one
+    skipped batch (counted in ``data.corrupt_records``) instead of the run.
+
+    tf.data surfaces a decode failure as an error on the batch the record
+    landed in and KEEPS SERVING subsequent batches (verified against a
+    corrupt-JPEG TFRecord; the iterator is not dead) — so skip-and-retry at
+    the batch level is sound. ``max_consecutive`` consecutive failures abort
+    with :class:`DataPipelineError`: a fully rotten shard must fail loudly,
+    not spin forever. Any error that is NOT a record-decode failure
+    propagates untouched — resilience here is for bad DATA, not bad code.
+    """
+    reg = get_registry()
+    consecutive = 0
+    while True:
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        except Exception as e:  # noqa: BLE001 — classified, then re-raised or counted
+            if not _is_corrupt_record_error(e):
+                raise
+            consecutive += 1
+            reg.counter("data.corrupt_records").inc()
+            if consecutive >= max_consecutive:
+                raise DataPipelineError(
+                    f"{consecutive} consecutive corrupt/undecodable batches "
+                    f"(data.max_consecutive_failures={max_consecutive}); the "
+                    "stream is systematically broken"
+                ) from e
+            continue
+        consecutive = 0
+        yield batch
+
+
+class PrefetchWorker:
+    """Host-side background prefetch: a bounded queue fed by a worker thread,
+    so batch production (tf.data next / native decode / augment) overlaps the
+    train loop's dispatch work instead of serializing with it.
+
+    Fault story (the point of this class living in the robustness PR): the
+    worker carries a YAMT011 top-level crash guard — an unhandled exception
+    in batch production is counted (``data.worker_crashes``), the loop is
+    restarted in place up to ``max_restarts`` times
+    (``data.worker_restarts``; the underlying iterator object survives its
+    own exceptions, per resilient_batches), and when the budget is exhausted
+    the error is handed to the CONSUMER through the queue — the train loop
+    dies with the real cause, never by waiting forever on a silently dead
+    thread."""
+
+    _END = ("end", None)
+
+    def __init__(self, it: Iterator[dict], depth: int = 4, max_restarts: int = 3):
+        import queue
+        import threading
+
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._it = it
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._max_restarts = max_restarts
+        self._thread = threading.Thread(target=self._run, name="yamt-data-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- worker thread -------------------------------------------------------
+
+    def _run(self):
+        try:
+            reg = get_registry()
+            restarts = 0
+            while not self._stop.is_set():
+                try:
+                    self._pump()
+                    return  # stream exhausted (or stop requested) cleanly
+                except Exception as e:  # noqa: BLE001 — bounded restart, then surface
+                    reg.counter("data.worker_crashes").inc()
+                    if restarts >= self._max_restarts:
+                        self._put(("error", e))
+                        return
+                    restarts += 1
+                    reg.counter("data.worker_restarts").inc()
+                    emit(f"[data] prefetch worker crashed ({type(e).__name__}: {e}); "
+                         f"restart {restarts}/{self._max_restarts}")
+        except Exception as e:  # noqa: BLE001 — terminal guard (YAMT011): die loud
+            self._put(("error", e))
+
+    def _pump(self):
+        while not self._stop.is_set():
+            try:
+                item = ("item", next(self._it))
+            except StopIteration:
+                self._put(self._END)
+                return
+            self._put(item)
+
+    def _put(self, item):
+        import queue
+
+        # stop-aware put: a consumer that walked away must not wedge the
+        # worker (and therefore interpreter shutdown) on a full queue
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer surface ----------------------------------------------------
+
+    def __iter__(self) -> Iterator[dict]:
+        return self
+
+    def __next__(self) -> dict:
+        kind, payload = self._q.get()
+        if kind == "item":
+            return payload
+        if kind == "error":
+            self.close()
+            raise payload
+        raise StopIteration
+
+    def close(self):
+        self._stop.set()
+        # drain so a blocked _put observes the stop promptly
+        import queue
+
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
 
 
 def synthetic_device_batches(cfg: DataConfig, local_batch: int, num_classes: int) -> Iterator[dict]:

@@ -67,8 +67,8 @@ class Gauge:
         if self._fn is not None:
             try:
                 self._value = float(self._fn())
-            except Exception:
-                pass  # keep the last good reading
+            except Exception:  # yamt-lint: disable=YAMT012 — documented: a dying pull producer keeps the last good reading
+                pass
         return self._value
 
 

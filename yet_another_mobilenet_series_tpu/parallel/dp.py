@@ -68,6 +68,13 @@ def make_dp_train_step(
     inner = make_train_step(
         net, cfg, optimizer, lr_fn, axis_name=DATA_AXIS, penalty_fn=penalty_fn, sharded_update=sharded_update
     )
+    if cfg.train.guard.enable:
+        # device-side non-finite skip-and-rollback (train/guard.py). MUST
+        # wrap inside the jit/donation boundary: the select reads the
+        # pre-step buffers the compiled program donates.
+        from ..train.guard import wrap_step_fn
+
+        inner = wrap_step_fn(inner)
 
     def shard_fn(ts: TrainState, batch, rng):
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))

@@ -14,7 +14,7 @@ def map_params_shaped(obj, params_structure, fn):
     try:
         if jax.tree.structure(obj) == params_structure:
             return fn(obj)
-    except Exception:
+    except Exception:  # yamt-lint: disable=YAMT012 — structure probe: "not params-shaped" is the expected answer, recursion below handles it
         pass
     if isinstance(obj, dict):
         return {k: map_params_shaped(v, params_structure, fn) for k, v in obj.items()}
