@@ -90,6 +90,17 @@ def summarize(log_dir: str) -> str:
                     f"  shed at completion: {snap['serve.shed_at_completion']:.0f} "
                     "(deadline passed while the batch executed)"
                 )
+            if snap.get("serve.fused_dispatches"):
+                lines.append(
+                    f"  fused dispatches: {snap['serve.fused_dispatches']:.0f} "
+                    f"covering {snap.get('serve.fused_chunks', 0):.0f} chunks "
+                    "(whole-request lax.scan pieces)"
+                )
+            if snap.get("serve.evicted_executables"):
+                lines.append(
+                    f"  off-ladder executables evicted: "
+                    f"{snap['serve.evicted_executables']:.0f} (LRU bound)"
+                )
             # the QoS/resilience edge (serve/admission.py) — per-class
             # accounting + breaker/retry/drain health, when it was in play
             classes = sorted(

@@ -17,7 +17,16 @@ writes it to a BENCH_SERVE_*.json via --out. Four measurements per run:
 3. **fp32-vs-bf16 A/B** — a second engine with compute_dtype=bfloat16,
    direct QPS per bucket plus the measured max |logit delta| vs fp32
    against the pinned BF16_PARITY_ATOL (serve/engine.py).
-4. **chaos A/B** — an OPEN-LOOP Poisson load generator (arrivals fire on
+4. **chained-vs-fused A/B** (``--fused``) — the serving twin of the training
+   dispatch probe (PROFILE.md): whole requests of K max-bucket chunks served
+   once through the per-chunk path (K dispatches, host staging between each)
+   and once through the fused multi-chunk executables (serve/engine.py
+   ``fuse_ladder``: ONE ``lax.scan`` dispatch per ladder piece). Per K:
+   dispatches/request (the structural claim — 1 for on-ladder K), p50/p99,
+   QPS, speedup, and the bitwise-parity check; plus the CPU-rehearsal caveat
+   recorded in the artifact (on 1 core the dispatch boundary is nearly free,
+   so the speedup may be ~flat — the dispatch-count drop is the pinned win).
+5. **chaos A/B** — an OPEN-LOOP Poisson load generator (arrivals fire on
    schedule regardless of completions — closed loops hide overload) drives
    mixed priorities (interactive/batch/best_effort via serve/admission.py)
    and mixed image sizes through the pipelined batcher twice: a healthy
@@ -37,6 +46,7 @@ does not depend on trained weight values.
 Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--image-sizes 224] [--buckets 1,8,32] [--iters 10]
            [--concurrent-iters 6] [--ab-iters 5] [--no-bf16]
+           [--fused] [--fuse-ladder 2,4] [--fused-iters 8]
            [--chaos-requests 80] [--chaos-qps 0] [--chaos-fault-rate 0.05]
            [--no-chaos] [--out f.json]
 """
@@ -170,6 +180,69 @@ def _concurrent_row(engine, batch, size, conc_iters, max_inflight, rng):
         row[f"avg_fill_{mode}"] = round(sum(fills[mode]) / len(fills[mode]), 3)
     row["pipelined_speedup"] = round(row["qps_pipelined"] / row["qps_sync"], 4) if row["qps_sync"] else None
     return row
+
+
+# recorded in every fused A/B artifact, the way r02 recorded the pipelined
+# caveat: the structural claim a 1-core box CAN pin is the dispatch count
+_FUSED_CPU_CAVEAT = (
+    "cpu_rehearsal: on a 1-core host the per-dispatch boundary costs little "
+    "(host staging and XLA 'device' compute share the core), so the fused "
+    "speedup may be ~flat here; the pinned structural win is "
+    "dispatches_per_request dropping to 1 for on-ladder K (bitwise-identical "
+    "logits). The throughput claim is an accelerator measurement — ROADMAP "
+    "item 1, same caveat discipline as BENCH_SERVE_r02."
+)
+
+
+def _fused_ab(chained, fused, size, iters, rng):
+    """Chained (per-chunk) vs fused (lax.scan) whole-request serving: same
+    bundle, same buckets, K max-bucket chunks per request for every K on the
+    fuse ladder plus one off-ladder K (decomposes into ladder pieces). The
+    dispatch count per request comes from serve.dispatch_seconds.count
+    registry deltas — the structural measurement; latency/QPS ride along."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+
+    reg = get_registry()
+    cap = fused.buckets[-1]
+    ladder = list(fused.fuse_ladder)
+    off_k = next(k for k in range(2, max(ladder) + 2) if k not in ladder)
+    rows = []
+    for k in ladder + [off_k]:
+        n = k * cap
+        x = rng.normal(0, 1, (n, size, size, 3)).astype("float32")
+        ref = chained.predict(x)
+        row = {"k": k, "rows": n, "on_ladder": k in ladder,
+               "bitwise_ok": bool(np.array_equal(fused.predict(x), ref))}
+        for label, eng in (("chained", chained), ("fused", fused)):
+            eng.predict(x)  # untimed page-in
+            s0 = reg.snapshot()
+            lat = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                eng.predict(x)
+                lat.append(time.perf_counter() - t0)
+            s1 = reg.snapshot()
+            lat.sort()
+            mean = sum(lat) / len(lat)
+            row[f"p50_ms_{label}"] = round(_percentile(lat, 0.50) * 1e3, 3)
+            row[f"p99_ms_{label}"] = round(_percentile(lat, 0.99) * 1e3, 3)
+            row[f"qps_{label}"] = round(n / mean, 2)
+            row[f"dispatches_per_request_{label}"] = round(
+                (s1["serve.dispatch_seconds.count"] - s0["serve.dispatch_seconds.count"]) / iters, 3)
+        row["fused_speedup"] = (
+            round(row["qps_fused"] / row["qps_chained"], 4) if row["qps_chained"] else None)
+        rows.append(row)
+    return {
+        "ladder": ladder,
+        "off_ladder_k": off_k,
+        "max_bucket": cap,
+        "image_size": size,
+        "per_k": rows,
+        "peak_speedup": max(r["fused_speedup"] for r in rows),
+        "cpu_rehearsal_note": _FUSED_CPU_CAVEAT,
+    }
 
 
 _CHAOS_CLASS_MIX = {"interactive": 0.5, "batch": 0.3, "best_effort": 0.2}
@@ -319,7 +392,8 @@ def _chaos_ab(engine, image_sizes, direct_rows, *, seed, n_requests, target_qps,
 
 
 def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_inflight, with_bf16,
-            chaos_requests=0, chaos_qps=0.0, chaos_fault_rate=0.05, chaos_seed=0):
+            chaos_requests=0, chaos_qps=0.0, chaos_fault_rate=0.05, chaos_seed=0,
+            fuse_ladder=(), fused_iters=8):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -348,10 +422,14 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
     )
     bundle = InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
 
-    def make_engine(dtype):
+    def make_engine(dtype, fuse=()):
         return InferenceEngine(bundle, buckets=buckets, compute_dtype=dtype,
-                               image_size=base_size, image_sizes=image_sizes)
+                               image_size=base_size, image_sizes=image_sizes,
+                               fuse_ladder=fuse)
 
+    # the baseline engine stays CHAINED (fuse_ladder=()) so direct /
+    # concurrent / chaos rows keep their r01-r03 meaning; the fused engine
+    # below exists only for the chained-vs-fused A/B
     engine = make_engine("float32")
     t0 = time.perf_counter()
     engine.warmup()
@@ -400,6 +478,10 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
             "parity_atol": BF16_PARITY_ATOL,
             "parity_ok": delta <= BF16_PARITY_ATOL,
         }
+    if fuse_ladder:
+        eng_fused = make_engine("float32", fuse=fuse_ladder)
+        eng_fused.warmup()
+        ab["fused_vs_chained"] = _fused_ab(engine, eng_fused, base_size, fused_iters, rng)
     chaos = None
     if chaos_requests > 0:
         chaos = _chaos_ab(
@@ -436,6 +518,13 @@ def main(argv=None) -> int:
                     help="pipelined window; 1 = pure double buffering (stage||compute, no "
                          "concurrent executions — best when host and device share cores)")
     ap.add_argument("--no-bf16", action="store_true", help="skip the fp32-vs-bf16 A/B")
+    ap.add_argument("--fused", action="store_true",
+                    help="run the chained-vs-fused A/B (whole requests of K max-bucket "
+                         "chunks; per-chunk dispatch loop vs ONE fused lax.scan dispatch)")
+    ap.add_argument("--fuse-ladder", default="2,4",
+                    help="chunk-count ladder for the fused engine (serve.fuse_chunks.ladder)")
+    ap.add_argument("--fused-iters", type=int, default=8,
+                    help="timed whole-request predicts per K and mode in the fused A/B")
     ap.add_argument("--chaos-requests", type=int, default=80,
                     help="open-loop Poisson requests per chaos round (healthy + faulty)")
     ap.add_argument("--chaos-qps", type=float, default=0.0,
@@ -466,7 +555,9 @@ def main(argv=None) -> int:
                     max(1, args.max_inflight), not args.no_bf16,
                     chaos_requests=0 if args.no_chaos else max(1, args.chaos_requests),
                     chaos_qps=args.chaos_qps, chaos_fault_rate=args.chaos_fault_rate,
-                    chaos_seed=args.chaos_seed)
+                    chaos_seed=args.chaos_seed,
+                    fuse_ladder=tuple(int(k) for k in args.fuse_ladder.split(",")) if args.fused else (),
+                    fused_iters=max(1, args.fused_iters))
         out.update(m)
         out["value"] = m["peak_qps"]
     except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0

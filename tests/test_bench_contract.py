@@ -26,6 +26,33 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _assert_fused_ab(fz):
+    """The chained-vs-fused A/B contract (shared by the tiny fast run and
+    the checked-in r04 rehearsal artifact): one row per ladder K plus one
+    off-ladder K, bitwise parity everywhere, and the STRUCTURAL claim —
+    dispatches per request is exactly 1 for on-ladder K (vs K chained) and
+    strictly fewer than chained for the off-ladder decomposition. Speedup
+    magnitude is NOT asserted: on the 1-core rehearsal box it may be ~flat,
+    and the artifact must record that caveat the way r02 did."""
+    assert fz["ladder"] and fz["max_bucket"] >= 1
+    assert fz["off_ladder_k"] not in fz["ladder"]
+    assert [r["k"] for r in fz["per_k"]] == fz["ladder"] + [fz["off_ladder_k"]]
+    for r in fz["per_k"]:
+        assert r["bitwise_ok"], r
+        assert r["rows"] == r["k"] * fz["max_bucket"]
+        assert r["p99_ms_chained"] >= r["p50_ms_chained"] > 0
+        assert r["p99_ms_fused"] >= r["p50_ms_fused"] > 0
+        assert r["qps_chained"] > 0 and r["qps_fused"] > 0
+        assert r["dispatches_per_request_chained"] == r["k"]
+        if r["on_ladder"]:
+            assert r["dispatches_per_request_fused"] == 1, r
+        else:
+            assert 1 <= r["dispatches_per_request_fused"] < r["dispatches_per_request_chained"], r
+        assert r["fused_speedup"] == pytest.approx(r["qps_fused"] / r["qps_chained"], rel=1e-3)
+    assert fz["peak_speedup"] == max(r["fused_speedup"] for r in fz["per_k"])
+    assert "cpu_rehearsal" in fz["cpu_rehearsal_note"]  # the caveat is recorded
+
+
 @pytest.mark.slow
 def test_bench_dead_tunnel_emits_parsed_cpu_fallback():
     # clean env: conftest.py mutates JAX_PLATFORMS/XLA_FLAGS for the pytest
@@ -80,7 +107,7 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
          "--arch", "tiny", "--image-sizes", "24,32", "--buckets", "2,4", "--iters", "3",
-         "--concurrent-iters", "2", "--ab-iters", "2",
+         "--concurrent-iters", "2", "--ab-iters", "2", "--fused", "--fused-iters", "3",
          "--chaos-requests", "40", "--chaos-fault-rate", "0.3", "--out", str(out_path)],
         capture_output=True, text=True, timeout=420, cwd=REPO,
     )
@@ -121,6 +148,7 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     assert bf["peak_qps_bf16"] > 0 and bf["peak_qps_fp32"] > 0
     assert bf["max_abs_logit_delta"] >= 0
     assert bf["parity_ok"] and bf["max_abs_logit_delta"] <= bf["parity_atol"]
+    _assert_fused_ab(out["ab"]["fused_vs_chained"])
     # chaos A/B: open-loop Poisson rounds with mixed priorities/sizes — the
     # books must balance per class and NOTHING may hang (unresolved == 0);
     # the healthy round must be failure-free (injected-fault counts are
@@ -247,6 +275,21 @@ def test_serve_bench_r03_chaos_rehearsal_artifact():
         for cls, s in rnd["classes"].items():
             if s["completed"]:
                 assert s["p99_ms"] >= s["p50_ms"] > 0, (cls, s)
+
+
+def test_serve_bench_r04_fused_rehearsal_artifact():
+    """The r04 cpu_rehearsal artifact pins the fused-dispatch acceptance:
+    whole requests of K max-bucket chunks served in ONE dispatch for
+    on-ladder K (vs K chained dispatches), bitwise-identical logits, the
+    off-ladder K decomposing into fewer dispatches than chained — and the
+    1-core caveat recorded in the artifact (speedup may be ~flat there; the
+    dispatch-count drop is the structural win, the throughput claim is the
+    ROADMAP hardware rung), exactly the r02 caveat discipline."""
+    with open(os.path.join(REPO, "BENCH_SERVE_r04_cpu_rehearsal.json")) as f:
+        out = json.load(f)
+    assert out["platform"] == "cpu" and "error" not in out
+    assert out["value"] is not None and out["value"] > 0
+    _assert_fused_ab(out["ab"]["fused_vs_chained"])
 
 
 def test_serve_bench_checked_in_rehearsal_artifact():

@@ -184,7 +184,9 @@ def test_engine_bucket_padding_bitwise(tmp_path):
     bundle = _bundle(tmp_path)
     eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24)
     eng.warmup()
-    assert set(eng._compiled) == {(2, 24), (4, 24)}  # warmup precompiled every (bucket, size)
+    # warmup precompiled every (bucket, size, K): per-chunk pairs plus the
+    # fused (max-bucket, size, K) scan for each K on the default fuse ladder
+    assert set(eng._compiled) == {(2, 24, 1), (4, 24, 1), (4, 24, 2), (4, 24, 4)}
     x = np.random.RandomState(0).normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
     full = eng.predict(x)  # exact bucket, no padding
     part = eng.predict(x[:3])  # 3 -> padded to 4
@@ -264,9 +266,9 @@ def test_engine_mixed_size_ladder_no_postwarmup_compile(tmp_path):
     serve.compile_seconds counter is the recompile-cliff alarm)."""
     bundle = _bundle(tmp_path)
     eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24,
-                          image_sizes=(24, 32))
+                          image_sizes=(24, 32), fuse_ladder=())
     eng.warmup()
-    assert set(eng._compiled) == {(2, 24), (4, 24), (2, 32), (4, 32)}
+    assert set(eng._compiled) == {(2, 24, 1), (4, 24, 1), (2, 32, 1), (4, 32, 1)}
     reg = get_registry()
     before = reg.snapshot()["serve.compile_seconds.count"]
     rs = np.random.RandomState(3)
@@ -292,9 +294,9 @@ def test_engine_staging_buffer_is_reused(tmp_path):
     x = rs.normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
     full = eng.predict(x)
     eng.predict(x[:2])
-    buf = eng._staging[(4, 24)]
+    buf = eng._staging[(4, 24, 1)]
     got = eng.predict(x[:3])
-    assert eng._staging[(4, 24)] is buf  # same buffer, not reallocated
+    assert eng._staging[(4, 24, 1)] is buf  # same buffer, not reallocated
     np.testing.assert_array_equal(got, full[:3])  # and stale rows were re-zeroed out of play
 
 
@@ -310,6 +312,224 @@ def test_engine_bf16_parity_within_pinned_tolerance(tmp_path):
     assert a.dtype == b.dtype == np.float32  # logits are fp32 on both paths
     delta = float(np.max(np.abs(a - b)))
     assert 0 < delta <= BF16_PARITY_ATOL  # >0: bf16 genuinely computed in bf16
+
+
+# ---------------------------------------------------------------------------
+# fused multi-chunk dispatch: whole-request inference in one dispatch
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_delta(reg, before):
+    return reg.snapshot().get("serve.dispatch_seconds.count", 0) - before.get(
+        "serve.dispatch_seconds.count", 0
+    )
+
+
+def test_fused_bitwise_parity_across_k(tmp_path):
+    """Fused logits == per-chunk logits BITWISE for K in {1, 2, 4} and an
+    off-ladder K (3 -> one 2-piece + one chunk): the scan body compiles the
+    same forward at the same (bucket, size), so fusion changes the dispatch
+    count, never a bit of the answer. On-ladder K is ONE dispatch."""
+    bundle = _bundle(tmp_path)
+    chained = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=())
+    fused = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(2, 4))
+    chained.warmup()
+    fused.warmup()
+    rs = np.random.RandomState(21)
+    reg = get_registry()
+    fused_base = reg.snapshot().get("serve.fused_dispatches", 0)
+    # (chunk count, rows, expected fused-path dispatches)
+    for k, n, want in [(1, 4, 1), (2, 8, 1), (4, 16, 1), (3, 12, 2)]:
+        x = rs.normal(0, 1, (n, 24, 24, 3)).astype(np.float32)
+        ref = chained.predict(x)
+        before = reg.snapshot()
+        got = fused.predict(x)
+        np.testing.assert_array_equal(got, ref)
+        assert _dispatch_delta(reg, before) == want, (k, n)
+    snap = reg.snapshot()
+    assert snap["serve.fused_dispatches"] - fused_base == 3  # K=2, K=4, and 3's 2-piece
+    assert snap["serve.fused_chunks"] >= 2 + 4 + 2
+
+
+def test_fused_tail_handling_bitwise(tmp_path):
+    """Mixed tails: a tail that pads up to the max bucket joins the fused
+    piece (same bucket => same executable compute => parity holds); a tail
+    that fits a smaller bucket dispatches per-chunk into it, exactly as the
+    chained path does. Both bitwise-equal to chained."""
+    bundle = _bundle(tmp_path)
+    chained = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=())
+    fused = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(2, 4))
+    chained.warmup()
+    fused.warmup()
+    rs = np.random.RandomState(29)
+    reg = get_registry()
+    # n=15: 4 chunks, tail of 3 pads to bucket 4 -> ONE fused K=4 dispatch
+    # n=10: 3 chunks, tail of 2 fits bucket 2    -> K=2 piece + per-chunk tail
+    for n, want in [(15, 1), (10, 2)]:
+        x = rs.normal(0, 1, (n, 24, 24, 3)).astype(np.float32)
+        ref = chained.predict(x)
+        before = reg.snapshot()
+        got = fused.predict(x)
+        np.testing.assert_array_equal(got, ref)
+        assert _dispatch_delta(reg, before) == want, n
+
+
+def test_fused_bf16_bitwise_vs_chained_bf16(tmp_path):
+    """The fused path is dtype-transparent: fused bf16 == chained bf16
+    bitwise (and both stay within the pinned tolerance of fp32)."""
+    bundle = _bundle(tmp_path, atom=True)
+    chained = InferenceEngine(bundle, buckets=(4,), compute_dtype="bfloat16",
+                              image_size=24, fuse_ladder=())
+    fused = InferenceEngine(bundle, buckets=(4,), compute_dtype="bfloat16",
+                            image_size=24, fuse_ladder=(2,))
+    fp32 = InferenceEngine(bundle, buckets=(4,), image_size=24, fuse_ladder=(2,))
+    x = np.random.RandomState(31).normal(0, 1, (8, 24, 24, 3)).astype(np.float32)
+    ref = chained.predict(x)
+    got = fused.predict(x)
+    np.testing.assert_array_equal(got, ref)
+    assert float(np.max(np.abs(fp32.predict(x) - got))) <= BF16_PARITY_ATOL
+
+
+def test_fused_async_and_staging_reuse(tmp_path):
+    """Fused predict_async == fused predict bitwise with handles pending
+    concurrently, and padded fused dispatches reuse one (K, bucket, size)
+    staging buffer (donation-discipline smoke: donate_input stays on)."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(2,))
+    eng.warmup()
+    rs = np.random.RandomState(33)
+    x = rs.normal(0, 1, (7, 24, 24, 3)).astype(np.float32)  # K=2 fused, 1 pad row
+    y = rs.normal(0, 1, (8, 24, 24, 3)).astype(np.float32)  # K=2 fused, exact
+    sync_x, sync_y = eng.predict(x.copy()), eng.predict(y.copy())
+    hx = eng.predict_async(x)
+    hy = eng.predict_async(y)  # both fused dispatches pending at once
+    np.testing.assert_array_equal(hy.result(), sync_y)
+    np.testing.assert_array_equal(hx.result(), sync_x)
+    buf = eng._staging[(4, 24, 2)]
+    got = eng.predict(x)
+    assert eng._staging[(4, 24, 2)] is buf  # same fused buffer, not reallocated
+    np.testing.assert_array_equal(got, sync_x)
+
+
+def test_batchers_route_oversized_coalesced_batch_to_fused(tmp_path):
+    """Both batchers hand an oversized coalesced batch to the engine whole,
+    and the engine serves it as ONE fused dispatch — continuous batching
+    composes with fusion instead of falling back to the chunk loop."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(1, 4), image_size=24, fuse_ladder=(2,))
+    eng.warmup()
+    reg = get_registry()
+    rs = np.random.RandomState(17)
+    imgs = rs.normal(0, 1, (8, 24, 24, 3)).astype(np.float32)
+    ref = eng.predict(imgs)
+    for make in (
+        lambda: MicroBatcher(eng.predict, max_batch=8, max_wait_ms=500.0),
+        lambda: PipelinedBatcher(eng, max_inflight=2, max_batch=8, max_wait_ms=500.0),
+    ):
+        b = make().start()
+        try:
+            before = reg.snapshot()
+            futs = [b.submit(imgs[i]) for i in range(8)]
+            rows = [f.result(timeout=30) for f in futs]
+        finally:
+            b.stop()
+        # 8 rows over max bucket 4 = 2 chunks = ONE K=2 fused dispatch
+        assert _dispatch_delta(reg, before) == 1
+        assert reg.snapshot()["serve.fused_dispatches"] - before.get(
+            "serve.fused_dispatches", 0) == 1
+        np.testing.assert_array_equal(np.stack(rows), ref)
+
+
+def test_cold_compile_does_not_block_warm_dispatch(tmp_path):
+    """Satellite regression: an off-ladder lazy compile used to run while
+    holding the dispatch lock, stalling ALL traffic for the full compile.
+    Now a warm-size dispatch completes while a cold-size compile is still
+    in progress on another thread."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2,), image_size=24, fuse_ladder=())
+    eng.warmup()
+    gate = threading.Event()
+    entered = threading.Event()
+    real_build = eng._build
+
+    def slow_build(bucket, size, k):
+        if size == 16:  # the cold size hangs in "compile" until released
+            entered.set()
+            assert gate.wait(10)
+        return real_build(bucket, size, k)
+
+    eng._build = slow_build  # type: ignore[method-assign]
+    cold_out = []
+    t = threading.Thread(
+        target=lambda: cold_out.append(eng.predict(np.zeros((2, 16, 16, 3), np.float32))),
+        daemon=True,
+    )
+    try:
+        t.start()
+        assert entered.wait(10)  # cold compile underway, NOT holding dispatch
+        warm = eng.predict(np.random.RandomState(1).normal(0, 1, (2, 24, 24, 3)).astype(np.float32))
+        assert warm.shape == (2, 10)
+        assert t.is_alive()  # the cold compile was still blocked: no stall
+    finally:
+        gate.set()
+    t.join(30)
+    assert not t.is_alive() and cold_out[0].shape == (2, 10)
+
+
+def test_pending_prediction_result_thread_safe(tmp_path):
+    """Satellite regression: concurrent result() callers used to race
+    _out/_parts (double device_get, double histogram, or a dropped-parts
+    crash). Now one thread syncs and every caller shares the cached array."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24)
+    eng.warmup()
+    x = np.random.RandomState(23).normal(0, 1, (10, 24, 24, 3)).astype(np.float32)
+    ref = eng.predict(x.copy())
+    reg = get_registry()
+    h = eng.predict_async(x)
+    before = reg.snapshot()["serve.run_seconds.count"]
+    outs = [None] * 8
+    barrier = threading.Barrier(8)
+
+    def grab(i):
+        barrier.wait()
+        outs[i] = h.result()
+
+    threads = [threading.Thread(target=grab, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    first = outs[0]
+    assert all(o is first for o in outs)  # one sync; everyone shares the cache
+    np.testing.assert_array_equal(first, ref)
+    assert reg.snapshot()["serve.run_seconds.count"] - before == 1  # observed once
+
+
+def test_offladder_lru_bounds_caches(tmp_path):
+    """Satellite regression: a size-scanning client used to grow _compiled
+    and _staging without bound. Off-ladder entries now live in a small LRU
+    (on-ladder keys pinned), evictions counted."""
+    bundle = _bundle(tmp_path)
+    eng = InferenceEngine(bundle, buckets=(2,), image_size=24, fuse_ladder=(),
+                          offladder_cache=2)
+    eng.warmup()
+    reg = get_registry()
+    base = reg.snapshot().get("serve.evicted_executables", 0)
+    for s in (8, 12, 16, 20):  # adversarial off-ladder size scan
+        out = eng.predict(np.zeros((1, s, s, 3), np.float32))  # padded -> staging too
+        assert out.shape == (1, 10)
+    assert (2, 24, 1) in eng._compiled  # the ladder executable is pinned
+    off = sorted(k[1] for k in eng._compiled if k[1] != 24)
+    assert off == [16, 20]  # LRU kept the two most recent scan sizes
+    assert reg.snapshot()["serve.evicted_executables"] - base == 2
+    assert all(k[1] in (24, 16, 20) for k in eng._staging)  # staging evicts too
+    # an LRU hit refreshes recency: 16 survives the next insertion, 20 goes
+    eng.predict(np.zeros((1, 16, 16, 3), np.float32))
+    eng.predict(np.zeros((1, 28, 28, 3), np.float32))
+    assert sorted(k[1] for k in eng._compiled if k[1] != 24) == [16, 28]
+    with pytest.raises(ValueError, match="offladder_cache"):
+        InferenceEngine(bundle, buckets=(2,), offladder_cache=0)
 
 
 # ---------------------------------------------------------------------------

@@ -250,13 +250,16 @@ def run(cfg: Config) -> dict:
             donate_input=cfg.serve.donate_input,
             image_size=cfg.data.image_size,
             image_sizes=cfg.serve.image_sizes,
+            fuse_ladder=cfg.serve.fuse_chunks.ladder if cfg.serve.fuse_chunks.enable else (),
+            offladder_cache=cfg.serve.offladder_cache,
         )
         if cfg.serve.warmup:
             t0 = time.perf_counter()
             engine.warmup()
             log.log(
-                f"warmup: compiled buckets {engine.buckets} x sizes {engine.image_sizes} "
-                f"in {time.perf_counter() - t0:.1f}s"
+                f"warmup: compiled buckets {engine.buckets} x sizes {engine.image_sizes}"
+                + (f" + fused K {engine.fuse_ladder}" if engine.fuse_ladder else "")
+                + f" in {time.perf_counter() - t0:.1f}s"
             )
         engine = FaultyEngine.from_config(engine, cfg.serve.faults)
         if cfg.serve.faults.enable:

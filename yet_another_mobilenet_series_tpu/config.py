@@ -461,6 +461,22 @@ class FaultsConfig:
 
 
 @dataclass(frozen=True)
+class FuseChunksConfig:
+    """Fused multi-chunk dispatch (serve/engine.py): a request larger than
+    the biggest bucket rolls its chunk loop INTO the compiled program — all
+    chunks stage into one (K, bucket, S, S, 3) buffer, transfer once, and a
+    lax.scan over the chunk axis serves the whole request in ONE dispatch
+    (bitwise-identical to the per-chunk path; docs/SERVING.md)."""
+
+    enable: bool = True
+    # chunk-count ladder: each K gets its own AOT-warmed (bucket, size, K)
+    # executable; an off-ladder chunk count decomposes greedily into ladder
+    # pieces (7 chunks with ladder [2, 4] -> 4+2+1 -> 3 dispatches), worst
+    # case falls back to the per-chunk path
+    ladder: Sequence[int] = (2, 4)
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Inference serving (serve/, docs/SERVING.md): export a checkpoint to a
     folded InferenceBundle and/or serve a bundle through the AOT-batched
@@ -510,6 +526,12 @@ class ServeConfig:
     # DrainTimeout after this long instead of hanging shutdown on a wedged
     # engine. 0 = wait forever (the pre-robustness behavior)
     drain_timeout_s: float = 10.0
+    # bounded LRU for OFF-ladder executables + staging buffers (on-ladder
+    # entries are pinned): a size-scanning client cannot OOM the server;
+    # evictions count serve.evicted_executables
+    offladder_cache: int = 8
+    # fused multi-chunk dispatch: whole-request inference in one dispatch
+    fuse_chunks: FuseChunksConfig = field(default_factory=FuseChunksConfig)
     # HTTP front door / admission control / fault injection sub-blocks
     listen: ListenConfig = field(default_factory=ListenConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
@@ -586,6 +608,7 @@ _SECTION_TYPES = {
     "ListenConfig": ListenConfig,
     "AdmissionConfig": AdmissionConfig,
     "FaultsConfig": FaultsConfig,
+    "FuseChunksConfig": FuseChunksConfig,
     "ServeConfig": ServeConfig,
     "Config": Config,
 }

@@ -7,6 +7,11 @@ of linger, whichever first — on a dedicated dispatch thread, so clients see
 a Future and the engine sees full buckets. A coalesced batch of MIXED image
 sizes partitions by shape and dispatches one engine batch per size, each
 hitting its own (bucket, image_size) executable (serve/engine.py ladder).
+A size group is handed to the engine WHOLE, never split here: one larger
+than the biggest bucket rides the engine's fused multi-chunk path (one
+``lax.scan`` dispatch per ladder piece, ``serve.fuse_chunks``), so
+``max_batch`` above the largest bucket turns coalesced overflow into fused
+whole-batch dispatches instead of a host-side chunk loop.
 
 The collect wait is event-driven, not polled: an idle batcher blocks on the
 queue (zero wakeups/s) and the first request of a burst is picked up the

@@ -1,4 +1,4 @@
-"""AOT-batched inference engine: bucketed shapes, pipelined async dispatch.
+"""AOT-batched inference engine: bucketed shapes, fused whole-request dispatch.
 
 XLA programs are shape-static, so a serving path that jits on the request's
 natural batch size recompiles on every new size — a latency cliff exactly
@@ -12,46 +12,80 @@ folded forward is row-independent (no BN batch statistics — the export fold
 removed BN entirely), so the real rows' logits are BITWISE identical to an
 unpadded run of the same bucket (pinned by tests/test_serve.py).
 
-**Async dispatch** is the pipelining primitive: :meth:`predict_async` stages
-and dispatches every chunk of a request and returns a
-:class:`PendingPrediction` WITHOUT syncing — JAX's async dispatch keeps the
-device computing while the host pads/stages the next chunk (or the next
-request entirely; serve/pipeline.py builds continuous batching on top).
-Large requests dispatch ALL chunks before the first ``device_get``; the only
-host<->device sync is :meth:`PendingPrediction.result`. ``predict`` is
-literally ``predict_async(...).result()``, so the two paths share one
-executable and are bitwise-identical by construction.
+**Fused multi-chunk dispatch** kills the per-chunk dispatch boundary for
+oversized requests (PAPERS.md "Kernel Looping", arXiv 2410.23668:
+inter-call synchronization, not compute, caps inference throughput). A
+request larger than the biggest bucket used to be N per-chunk dispatches
+with host pad/stage/enqueue between every pair; now the chunk loop rolls
+INTO the compiled program: all K chunks stage into one ``(K, bucket, S, S,
+3)`` host buffer, transfer once, and a ``lax.scan`` over the leading chunk
+axis runs the folded forward K times device-side — ONE dispatch, one
+transfer, one ``device_get`` for the whole request. Fused executables are
+keyed ``(bucket, image_size, K)`` on a small chunk-count ladder
+(``fuse_ladder``, default 2/4, AOT-warmed like everything else); an
+off-ladder chunk count decomposes greedily into ladder pieces (7 chunks =
+4+2+1 → 3 dispatches, not 7), and the worst case degrades to the per-chunk
+path. The scan body is the same forward the per-chunk executables compile
+at the same ``(bucket, size)``, so fused logits are **bitwise identical**
+to the chunked path (pinned by tests across K, tails, and bf16). The
+per-chunk path (K=1) is unchanged and remains the mesh / fallback route.
 
-Tail padding writes into a **reused per-(bucket, size) staging buffer**
+**Async dispatch** is the pipelining primitive: :meth:`predict_async` stages
+and dispatches every piece of a request and returns a
+:class:`PendingPrediction` WITHOUT syncing — JAX's async dispatch keeps the
+device computing while the host pads/stages the next piece (or the next
+request entirely; serve/pipeline.py builds continuous batching on top).
+The only host<->device sync is :meth:`PendingPrediction.result`, which is
+safe under concurrent callers (a once-latch: one thread syncs, the rest get
+the cached array). ``predict`` is literally ``predict_async(...).result()``,
+so the two paths share one executable cache and are bitwise-identical by
+construction.
+
+Tail padding writes into a **reused per-(bucket, size, K) staging buffer**
 instead of ``np.concatenate([chunk, pad])``: no allocation per dispatch, and
 only the pad rows are re-zeroed. Reuse right after dispatch is safe because
 ``jnp.asarray`` copies the host buffer synchronously (the device array never
 aliases the staging memory); the multi-chunk bitwise-parity tests would
 catch any backend that broke that assumption.
 
+**Compilation never blocks warm traffic**: a cold (off-ladder) key compiles
+under a dedicated compile lock with a double-checked insert, OUTSIDE the
+dispatch lock — while one thread pays a cold compile, concurrent warm-size
+dispatches proceed (a regression test pins it; the old behavior stalled ALL
+traffic for the full compile). Off-ladder executables and staging buffers
+live in a bounded LRU (``offladder_cache`` entries; on-ladder keys are
+never evicted) so a size-scanning client cannot grow the caches without
+bound — evictions count ``serve.evicted_executables``.
+
 Input buffers are donated to the executable (``donate_argnums``): the padded
 batch is engine-private and dead after the call, so XLA may overwrite it
 in-place instead of allocating — on TPU that removes one HBM buffer per
 in-flight request batch. The donated device array must never be read after
 dispatch (yamt-lint YAMT008 exists to catch exactly that class of bug;
-tests/fixtures/lint/yamt008/clean/async_engine_ok.py pins this engine's
-dispatch shape as clean).
+tests/fixtures/lint/yamt008/clean/async_engine_ok.py and
+fused_scan_ok.py pin this engine's dispatch shapes as clean).
 
 Optional data parallelism: pass a ``parallel/mesh`` mesh and every bucket is
 sharded over its 'data' axis (params replicated) — the eval forward has no
-collectives, so partitioning is pure SPMD batch splitting.
+collectives, so partitioning is pure SPMD batch splitting. The fused path
+is bypassed under a mesh (device_put sharding semantics differ; the
+per-chunk path serves every chunk exactly as before).
 
 Instrumentation (obs/): ``serve.dispatch_seconds`` (host stage+dispatch per
-chunk), ``serve.dispatch_to_complete_seconds`` (first dispatch -> logits on
+piece), ``serve.dispatch_to_complete_seconds`` (first dispatch -> logits on
 host), ``serve.run_seconds`` (predict start -> result done),
+``serve.fused_dispatches`` / ``serve.fused_chunks`` (fused pieces and the
+chunks they covered), ``serve.evicted_executables``,
 ``serve.infer_images`` / ``serve.padded_rows`` / per-bucket hit counters;
-``serve/stage`` + ``serve/dispatch`` + ``serve/complete`` spans.
+``serve/stage`` + ``serve/dispatch`` + ``serve/dispatch_fused`` +
+``serve/complete`` spans.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Sequence
 
 import jax
@@ -79,13 +113,15 @@ def _dtype(name: str):
 class PendingPrediction:
     """Device-side handle returned by :meth:`InferenceEngine.predict_async`.
 
-    Holds the dispatched-but-unsynced logits of every chunk; ``result()`` is
+    Holds the dispatched-but-unsynced logits of every piece; ``result()`` is
     the ONE host<->device sync (device_get, slice off pad rows, concat) and
-    caches its value, so calling it twice is free. Until then the device is
+    caches its value, so calling it twice is free. It is thread-safe: a
+    once-latch serializes concurrent callers, exactly one performs the sync
+    and everyone gets the same cached array. Until the sync the device is
     free to still be computing — that's the point.
     """
 
-    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out")
+    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out", "_lock")
 
     def __init__(self, engine: "InferenceEngine", parts, t_start: float, t_dispatched: float):
         self._engine = engine
@@ -93,30 +129,41 @@ class PendingPrediction:
         self._t_start = t_start
         self._t_dispatched = t_dispatched
         self._out: np.ndarray | None = None
+        # once-latch: two threads racing result() must not double-sync the
+        # histograms or read _parts after the winner cleared it
+        self._lock = threading.Lock()
 
     def result(self) -> np.ndarray:
-        """Block until every chunk's logits are on host; (N, num_classes)."""
-        if self._out is None:
-            reg = self._engine._reg
-            with obs_trace.get_tracer().span("serve/complete", "serve", chunks=len(self._parts)):
-                outs = [np.asarray(jax.device_get(dev))[:rows] for dev, rows in self._parts]
-            now = time.perf_counter()
-            reg.histogram("serve.dispatch_to_complete_seconds").observe(now - self._t_dispatched)
-            reg.histogram("serve.run_seconds").observe(now - self._t_start)
-            self._out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
-            self._parts = ()  # drop the device references as soon as synced
-        return self._out
+        """Block until every piece's logits are on host; (N, num_classes)."""
+        with self._lock:
+            if self._out is None:
+                reg = self._engine._reg
+                with obs_trace.get_tracer().span("serve/complete", "serve", pieces=len(self._parts)):
+                    outs = []
+                    for dev, rows in self._parts:
+                        arr = np.asarray(jax.device_get(dev))
+                        # fused pieces come back (K, bucket, classes); flatten
+                        # the chunk axis before slicing off the pad rows
+                        outs.append(arr.reshape(-1, arr.shape[-1])[:rows])
+                now = time.perf_counter()
+                reg.histogram("serve.dispatch_to_complete_seconds").observe(now - self._t_dispatched)
+                reg.histogram("serve.run_seconds").observe(now - self._t_start)
+                self._out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+                self._parts = ()  # drop the device references as soon as synced
+            return self._out
 
 
 class InferenceEngine:
     """Compiled serving wrapper around a loaded :class:`InferenceBundle`.
 
     ``predict(images)`` accepts any batch size: requests larger than the
-    biggest bucket are chunked, everything else is padded up to the smallest
-    fitting bucket. ``predict_async`` is the no-sync variant feeding the
-    pipelined batcher. Mixed image sizes hit the ``image_sizes`` ladder's
-    warm executables; a size off the ladder compiles lazily (once) instead
-    of failing, and ``serve.compile_seconds.count`` exposes the cliff.
+    biggest bucket are served by the fused multi-chunk executables (one
+    dispatch per ladder piece; per-chunk fallback), everything else is
+    padded up to the smallest fitting bucket. ``predict_async`` is the
+    no-sync variant feeding the pipelined batcher. Mixed image sizes hit the
+    ``image_sizes`` ladder's warm executables; a size off the ladder
+    compiles lazily (once, without blocking warm traffic) instead of
+    failing, and ``serve.compile_seconds.count`` exposes the cliff.
     """
 
     def __init__(
@@ -129,6 +176,8 @@ class InferenceEngine:
         donate_input: bool = True,
         image_size: int | None = None,
         image_sizes: Sequence[int] | None = None,
+        fuse_ladder: Sequence[int] = (2, 4),
+        offladder_cache: int = 8,
     ):
         if not buckets:
             raise ValueError("engine needs at least one batch bucket")
@@ -140,6 +189,12 @@ class InferenceEngine:
         self.image_sizes = tuple(sorted(set(int(s) for s in (image_sizes or ())) | {self.image_size}))
         if self.image_sizes[0] < 1:
             raise ValueError(f"image sizes must be >= 1, got {self.image_sizes}")
+        # chunk-count ladder for fused dispatch; K=1 (the per-chunk path) is
+        # implicit, so only K >= 2 entries are meaningful. () disables fusion.
+        self.fuse_ladder = tuple(sorted(set(int(k) for k in (fuse_ladder or ()) if int(k) >= 2)))
+        if offladder_cache < 1:
+            raise ValueError(f"offladder_cache must be >= 1, got {offladder_cache}")
+        self._offladder_cap = int(offladder_cache)
         self._compute_dtype = _dtype(compute_dtype)
         self._mesh = mesh
         self._donate = donate_input
@@ -153,19 +208,51 @@ class InferenceEngine:
             self._params = mesh_lib.replicate(bundle.params, mesh)
         else:
             self._params = jax.tree.map(jnp.asarray, bundle.params)
-        # executables and staging buffers are keyed (bucket, image_size)
-        self._compiled: dict[tuple[int, int], jax.stages.Compiled] = {}
-        self._staging: dict[tuple[int, int], np.ndarray] = {}
+        # executables and staging buffers are keyed (bucket, image_size, K);
+        # K == 1 is the plain per-chunk executable, K >= 2 the fused scan
+        self._compiled: dict[tuple[int, int, int], jax.stages.Compiled] = {}
+        self._staging: dict[tuple[int, int, int], np.ndarray] = {}
+        # off-ladder keys live in a bounded LRU (on-ladder keys are pinned):
+        # a size-scanning client must not grow the caches without bound
+        self._offladder: OrderedDict[tuple[int, int, int], None] = OrderedDict()
         # one dispatcher at a time: staging buffers are reused across calls
         self._dispatch_lock = threading.Lock()
+        # compiles serialize with each other but NOT with dispatch: a cold
+        # key must never stall concurrent warm traffic (double-checked
+        # insert in _ensure_compiled)
+        self._compile_lock = threading.Lock()
+        # guards _compiled/_staging/_offladder mutation + LRU bookkeeping
+        self._cache_lock = threading.Lock()
         self._reg = get_registry()
 
     # -- compilation --------------------------------------------------------
 
-    def _build(self, bucket: int, size: int):
-        def run(params, x):
+    def _on_ladder(self, key: tuple[int, int, int]) -> bool:
+        bucket, size, k = key
+        return (
+            bucket in self.buckets
+            and size in self.image_sizes
+            and (k == 1 or k in self.fuse_ladder)
+        )
+
+    def _build(self, bucket: int, size: int, k: int):
+        def run_one(params, x):
             return apply_folded(self.net, params, x, compute_dtype=self._compute_dtype)
 
+        if k == 1:
+            run = run_one
+            x_shape = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.float32)
+        else:
+            # the chunk loop, in-program: scan the SAME per-chunk forward
+            # over the leading chunk axis — one dispatch for K chunks
+            def run(params, xs):
+                def body(carry, x):
+                    return carry, run_one(params, x)
+
+                _, ys = jax.lax.scan(body, None, xs)
+                return ys
+
+            x_shape = jax.ShapeDtypeStruct((k, bucket, size, size, 3), jnp.float32)
         kwargs = {}
         if self._mesh is not None:
             kwargs["in_shardings"] = (
@@ -173,21 +260,52 @@ class InferenceEngine:
                 mesh_lib.batch_sharding(self._mesh),
             )
         fn = jax.jit(run, donate_argnums=(1,) if self._donate else (), **kwargs)
-        x_shape = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.float32)
         t0 = time.perf_counter()
-        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket, image_size=size):
+        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket, image_size=size, k=k):
             compiled = fn.lower(self._params, x_shape).compile()
         self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
         return compiled
 
+    def _ensure_compiled(self, key: tuple[int, int, int]):
+        """Executable for ``key``, compiling on miss WITHOUT holding the
+        dispatch lock (double-checked insert): warm traffic keeps flowing
+        while a cold size pays its compile."""
+        with self._cache_lock:
+            exe = self._compiled.get(key)
+            if exe is not None:
+                if key in self._offladder:
+                    self._offladder.move_to_end(key)
+                return exe
+        with self._compile_lock:
+            with self._cache_lock:
+                exe = self._compiled.get(key)
+            if exe is not None:
+                return exe
+            exe = self._build(*key)
+            with self._cache_lock:
+                self._compiled[key] = exe
+                if not self._on_ladder(key):
+                    self._offladder[key] = None
+                    self._offladder.move_to_end(key)
+                    while len(self._offladder) > self._offladder_cap:
+                        old, _ = self._offladder.popitem(last=False)
+                        self._compiled.pop(old, None)
+                        self._staging.pop(old, None)
+                        self._reg.counter("serve.evicted_executables").inc()
+            return exe
+
     def warmup(self) -> None:
-        """AOT-compile every (bucket, image_size) pair up front so the first
-        request of any size on the ladder hits a ready executable, never a
-        compile stall."""
+        """AOT-compile every ladder executable up front so the first request
+        of any size never hits a compile stall: each (bucket, image_size)
+        pair, plus — when fusion is on — the fused (max-bucket, size, K)
+        scan for every K on the fuse ladder."""
+        cap = self.buckets[-1]
         for s in self.image_sizes:
             for b in self.buckets:
-                if (b, s) not in self._compiled:
-                    self._compiled[(b, s)] = self._build(b, s)
+                self._ensure_compiled((b, s, 1))
+            if self._mesh is None:
+                for k in self.fuse_ladder:
+                    self._ensure_compiled((cap, s, k))
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -197,36 +315,72 @@ class InferenceEngine:
 
     # -- dispatch -----------------------------------------------------------
 
-    def _stage(self, chunk: np.ndarray, bucket: int, size: int) -> np.ndarray:
-        """Bucket-shaped host array for ``chunk``: the chunk itself when it
-        fills the bucket exactly, else the reused per-(bucket, size) staging
-        buffer with the tail rows zeroed. Only the pad rows are re-zeroed —
-        no per-dispatch allocation, no full-buffer copy."""
-        n = chunk.shape[0]
-        if n == bucket:
-            return np.ascontiguousarray(chunk)
-        key = (bucket, size)
-        buf = self._staging.get(key)
-        if buf is None:
-            buf = self._staging[key] = np.zeros((bucket, size, size, 3), np.float32)
-        buf[:n] = chunk
-        buf[n:] = 0.0
-        self._reg.counter("serve.padded_rows").inc(bucket - n)
+    def _plan(self, n: int, size: int) -> list[tuple[int, int, int, int]]:
+        """Split an N-row request into dispatch pieces ``(start, rows,
+        bucket, k)``, in row order. Full max-bucket chunks fuse greedily
+        into the largest ladder K first (7 chunks with ladder {2, 4} ->
+        4+2+1 -> 3 dispatches); the tail chunk joins a fused piece only when
+        it would pad up to the max bucket anyway (same bucket => same
+        executable compute => parity with the per-chunk path is preserved);
+        otherwise it dispatches per-chunk into its own smaller bucket,
+        exactly as before. K=1 pieces are the unchanged per-chunk path."""
+        cap = self.buckets[-1]
+        m = -(-n // cap)  # chunk count, ceil
+        tail = n - (m - 1) * cap
+        fusable = 0
+        if self.fuse_ladder and self._mesh is None and m >= 2:
+            fusable = m if self._bucket_for(tail) == cap else m - 1
+        pieces: list[tuple[int, int, int, int]] = []
+        chunk = 0
+        rem = fusable
+        for k in sorted(self.fuse_ladder, reverse=True):
+            while rem >= k:
+                start = chunk * cap
+                rows = min(n, (chunk + k) * cap) - start
+                pieces.append((start, rows, cap, k))
+                chunk += k
+                rem -= k
+        while chunk < m:
+            start = chunk * cap
+            rows = min(n, start + cap) - start
+            pieces.append((start, rows, self._bucket_for(rows), 1))
+            chunk += 1
+        return pieces
+
+    def _stage(self, rows_arr: np.ndarray, key: tuple[int, int, int]) -> np.ndarray:
+        """Executable-shaped host array for a piece's rows: the rows
+        themselves (reshaped, zero-copy) when they fill the piece exactly,
+        else the reused per-(bucket, size, K) staging buffer with the tail
+        rows zeroed. Only the pad rows are re-zeroed — no per-dispatch
+        allocation, no full-buffer copy."""
+        bucket, size, k = key
+        total = k * bucket
+        n = rows_arr.shape[0]
+        shape = (bucket, size, size, 3) if k == 1 else (k, bucket, size, size, 3)
+        if n == total:
+            return np.ascontiguousarray(rows_arr).reshape(shape)
+        with self._cache_lock:
+            buf = self._staging.get(key)
+            if buf is None:
+                buf = self._staging[key] = np.zeros(shape, np.float32)
+        flat = buf.reshape(total, size, size, 3)
+        flat[:n] = rows_arr
+        flat[n:] = 0.0
+        self._reg.counter("serve.padded_rows").inc(total - n)
         return buf
 
-    def _dispatch_chunk(self, chunk: np.ndarray, size: int):
-        """Stage + dispatch ONE chunk; returns (device_logits, real_rows)
-        without syncing. The device array handed to the executable is
-        donated; it is never read afterwards (YAMT008 discipline)."""
-        n = chunk.shape[0]
-        bucket = self._bucket_for(n)
-        key = (bucket, size)
-        if key not in self._compiled:
-            self._compiled[key] = self._build(bucket, size)
+    def _dispatch_piece(self, images: np.ndarray, piece: tuple[int, int, int, int], size: int):
+        """Stage + dispatch ONE piece (a chunk, or K fused chunks); returns
+        (device_logits, real_rows) without syncing. The device array handed
+        to the executable is donated; it is never read afterwards (YAMT008
+        discipline)."""
+        start, rows, bucket, k = piece
+        key = (bucket, size, k)
+        exe = self._ensure_compiled(key)  # pre-warmed by predict_async; a hit
         tracer = obs_trace.get_tracer()
         t0 = time.perf_counter()
-        with tracer.span("serve/stage", "serve", bucket=bucket, rows=n):
-            staged = self._stage(chunk, bucket, size)
+        with tracer.span("serve/stage", "serve", bucket=bucket, rows=rows, k=k):
+            staged = self._stage(images[start : start + rows], key)
             if self._mesh is not None:
                 # defensive: device_put's host-read timing is backend-defined,
                 # so never hand the reused staging buffer to the sharded path
@@ -237,17 +391,23 @@ class InferenceEngine:
                 # jnp.asarray copies synchronously: the staging buffer is
                 # reusable the moment dispatch returns (parity tests pin it)
                 x = jnp.asarray(staged)
-        with tracer.span("serve/dispatch", "serve", bucket=bucket, image_size=size, rows=n):
-            logits = self._compiled[key](self._params, x)
+        span = "serve/dispatch" if k == 1 else "serve/dispatch_fused"
+        with tracer.span(span, "serve", bucket=bucket, image_size=size, rows=rows, k=k):
+            logits = exe(self._params, x)
         self._reg.histogram("serve.dispatch_seconds").observe(time.perf_counter() - t0)
-        self._reg.counter(f"serve.bucket_hits.{bucket}").inc()
-        return logits, n
+        if k > 1:
+            self._reg.counter("serve.fused_dispatches").inc()
+            self._reg.counter("serve.fused_chunks").inc(k)
+        self._reg.counter(f"serve.bucket_hits.{bucket}").inc(k)
+        return logits, rows
 
     def predict_async(self, images: np.ndarray) -> PendingPrediction:
         """Dispatch without syncing: (N, S, S, 3) float32 -> handle whose
-        ``result()`` yields (N, num_classes) float32 logits. Every chunk of
-        an oversized request is dispatched before the caller can sync, so
-        the device pipeline never drains between chunks."""
+        ``result()`` yields (N, num_classes) float32 logits. An oversized
+        request becomes ONE fused dispatch per ladder piece (a whole
+        on-ladder request is a single dispatch + single transfer); every
+        piece is dispatched before the caller can sync, so the device
+        pipeline never drains between pieces."""
         images = np.asarray(images, np.float32)
         if images.ndim != 4 or images.shape[1] != images.shape[2]:
             raise ValueError(f"predict expects (N, S, S, 3), got shape {images.shape}")
@@ -257,13 +417,18 @@ class InferenceEngine:
         size = int(images.shape[1])
         self._reg.counter("serve.infer_images").inc(n)
         t_start = time.perf_counter()
-        cap = self.buckets[-1]
+        pieces = self._plan(n, size)
+        # compile anything cold BEFORE taking the dispatch lock: a cold size
+        # must not stall concurrent warm-size dispatches
+        for key in {(bucket, size, k) for _, _, bucket, k in pieces}:
+            self._ensure_compiled(key)
         with self._dispatch_lock:
-            parts = [self._dispatch_chunk(images[i : i + cap], size) for i in range(0, n, cap)]
+            parts = [self._dispatch_piece(images, piece, size) for piece in pieces]
         return PendingPrediction(self, parts, t_start, time.perf_counter())
 
     def predict(self, images: np.ndarray) -> np.ndarray:
         """(N, S, S, 3) float32 (already normalized, pipeline semantics) ->
         (N, num_classes) float32 logits. N is unconstrained: > max bucket is
-        served in max-bucket chunks, all dispatched before the single sync."""
+        served fused (one dispatch per ladder piece), all dispatched before
+        the single sync."""
         return self.predict_async(images).result()

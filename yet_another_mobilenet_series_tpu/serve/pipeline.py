@@ -22,7 +22,13 @@ So the NEXT bucket fills and stages while the PREVIOUS one executes on the
 device — continuous batching. While the window is full the collect thread
 keeps TOPPING UP the batch in hand instead of closing it early: dispatch
 cannot proceed anyway, and a partial bucket pads with dead rows the device
-then computes — under saturation every dispatched bucket arrives full.
+then computes — under saturation every dispatched bucket arrives full. A
+topped-up batch larger than the engine's biggest bucket still dispatches
+as ONE ``predict_async`` call (one window slot per size group): the engine
+serves it through the fused multi-chunk executables
+(``serve.fuse_chunks``, one lax.scan dispatch per ladder piece), so
+saturation-driven top-up composes with fusion instead of degrading into a
+per-chunk host loop.
 ``max_inflight`` bounds the number of dispatched-but-unsynced batches, and
 the slot is reserved BEFORE dispatch, so at most ``max_inflight``
 executions are ever enqueued device-side:
