@@ -4,7 +4,13 @@ hang_report.json if present) into a text summary — the post-run half of
 docs/OBSERVABILITY.md. Pure stdlib file reading, no jax/tf import, so it
 runs anywhere (CI after the tier-1 gate, a laptop against rsynced logs).
 
-Usage: python scripts/obs_report.py <log_dir>
+``--requests`` additionally renders the REQUEST view from obs_trace.json:
+per-request waterfalls (queued / in-flight phase durations reconstructed
+from the async ``b``/``e`` events serve/context.py emits, one row per
+request id) and a per-phase quantile table (p50/p95/p99 straight from the
+bucketed registry histograms — the same numbers ``GET /metrics`` exposes).
+
+Usage: python scripts/obs_report.py [--requests] [--max-requests N] <log_dir>
 """
 
 from __future__ import annotations
@@ -25,7 +31,66 @@ def _load_jsonl(path: str) -> list[dict]:
     return rows
 
 
-def summarize(log_dir: str) -> str:
+# histogram-suffix columns for the quantile tables (obs/registry.py snapshot
+# expansion); values are seconds, rendered in ms
+_Q_COLS = ("p50", "p95", "p99", "min", "max")
+
+
+def _quantile_table(snap: dict, names: list[tuple[str, str]]) -> list[str]:
+    """Aligned per-phase quantile rows for every histogram in ``names``
+    ((registry name, label)) that has data."""
+    rows = []
+    header = f"  {'phase':<28} {'count':>7} " + " ".join(f"{c + '_ms':>9}" for c in _Q_COLS)
+    for name, label in names:
+        count = snap.get(f"{name}.count")
+        if not count:
+            continue
+        cells = " ".join(f"{snap.get(f'{name}.{c}', 0.0) * 1e3:>9.3f}" for c in _Q_COLS)
+        rows.append(f"  {label:<28} {count:>7.0f} {cells}")
+    return [header] + rows if rows else []
+
+
+def _request_waterfalls(trace_path: str, max_requests: int) -> list[str]:
+    """Per-request phase waterfalls from the trace's async b/e events."""
+    with open(trace_path) as f:
+        events = json.load(f).get("traceEvents", [])
+    # (id, name) -> [begin_ts, end_ts] in µs; ids are request ids
+    spans: dict[tuple[int, str], list[float | None]] = {}
+    args_by_id: dict[int, dict] = {}
+    tids_by_id: dict[int, set] = {}
+    for e in events:
+        if e.get("ph") not in ("b", "e") or "id" not in e:
+            continue
+        key = (e["id"], e["name"])
+        slot = spans.setdefault(key, [None, None])
+        slot[0 if e["ph"] == "b" else 1] = e["ts"]
+        if e.get("args"):  # "b" carries cls/deadline, "e" carries outcome
+            args_by_id.setdefault(e["id"], {}).update(e["args"])
+        tids_by_id.setdefault(e["id"], set()).add(e["tid"])
+    rids = sorted({rid for rid, _ in spans})
+    if not rids:
+        return ["  no request events in the trace (obs.trace off, or no served load)"]
+    lines = [f"  {len(rids)} request(s) in the trace ring; "
+             f"showing {min(len(rids), max_requests)} "
+             f"(admit -> queued -> in-flight -> done, host µs timestamps)"]
+    for rid in rids[:max_requests]:
+        def _dur(name):
+            b, e = spans.get((rid, name), (None, None))
+            return (e - b) / 1e3 if b is not None and e is not None else None
+        total = _dur("serve/request")
+        queued = _dur("serve/queued")
+        inflight = _dur("serve/inflight")
+        a = args_by_id.get(rid, {})
+        outcome = a.get("outcome", "?")
+        parts = [f"  #{rid:<6} class={a.get('cls', '?'):<12}"]
+        for label, v in (("total", total), ("queued", queued), ("inflight", inflight)):
+            parts.append(f"{label}={v:.2f}ms" if v is not None else f"{label}=?")
+        parts.append(f"threads={len(tids_by_id.get(rid, ()))}")
+        lines.append(" ".join(parts) + (f" [{outcome}]" if outcome != "?" else ""))
+    return lines
+
+
+def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> str:
     lines = [f"# obs report: {log_dir}"]
 
     metrics_path = os.path.join(log_dir, "metrics.jsonl")
@@ -77,8 +142,12 @@ def summarize(log_dir: str) -> str:
                              ("serve.dispatch_to_complete_seconds", "dispatch->complete")):
                 if snap.get(f"{h}.count"):
                     lines.append(
-                        f"  {label}: mean {snap[f'{h}.mean'] * 1e3:.2f} ms, "
-                        f"max {snap[f'{h}.max'] * 1e3:.2f} ms over {snap[f'{h}.count']:.0f}"
+                        f"  {label}: p50 {snap.get(f'{h}.p50', 0) * 1e3:.2f} / "
+                        f"p95 {snap.get(f'{h}.p95', 0) * 1e3:.2f} / "
+                        f"p99 {snap.get(f'{h}.p99', 0) * 1e3:.2f} ms, "
+                        f"min {snap.get(f'{h}.min', 0) * 1e3:.2f} ms, "
+                        f"mean {snap.get(f'{h}.mean', 0) * 1e3:.2f} ms, "
+                        f"max {snap.get(f'{h}.max', 0) * 1e3:.2f} ms over {snap[f'{h}.count']:.0f}"
                     )
             if snap.get("serve.batch_size.count"):
                 lines.append(
@@ -115,8 +184,10 @@ def summarize(log_dir: str) -> str:
                     f"rejected = {snap.get(f'serve.rejected.{cls}', 0):.0f}"
                 )
                 if snap.get(f"{lat}.count"):
-                    row += (f", latency mean {snap[f'{lat}.mean'] * 1e3:.2f} ms "
-                            f"max {snap[f'{lat}.max'] * 1e3:.2f} ms")
+                    row += (f", latency p50 {snap.get(f'{lat}.p50', 0) * 1e3:.2f} / "
+                            f"p99 {snap.get(f'{lat}.p99', 0) * 1e3:.2f} ms "
+                            f"(min {snap.get(f'{lat}.min', 0) * 1e3:.2f}, "
+                            f"max {snap[f'{lat}.max'] * 1e3:.2f})")
                 lines.append(row)
             if classes or snap.get("serve.breaker_opens") or snap.get("serve.retries"):
                 breaker = {0: "closed", 1: "OPEN", 2: "half-open"}.get(
@@ -156,17 +227,45 @@ def summarize(log_dir: str) -> str:
         lines.append(f"\n## span trace: {trace_path} ({n_events} events) — "
                      "open in ui.perfetto.dev or chrome://tracing")
 
+    if requests:
+        lines.append("\n## per-phase quantiles (registry histograms)")
+        snap = {}
+        if os.path.exists(reg_path):
+            with open(reg_path) as f:
+                snap = json.load(f)
+        phase_names = [
+            ("serve.queue_wait_seconds", "queue wait"),
+            ("serve.dispatch_seconds", "stage+dispatch"),
+            ("serve.dispatch_to_complete_seconds", "dispatch->complete"),
+            ("serve.run_seconds", "run (predict->logits)"),
+        ] + [
+            (k[: -len(".count")], f"latency [{k.split('.')[-2]}]")
+            for k in sorted(snap)
+            if k.startswith("serve.latency_seconds.") and k.endswith(".count")
+        ]
+        table = _quantile_table(snap, phase_names)
+        lines.extend(table if table else ["  no serving histograms in the registry snapshot"])
+        lines.append("\n## request waterfalls (trace async events)")
+        if os.path.exists(trace_path):
+            lines.extend(_request_waterfalls(trace_path, max_requests))
+        else:
+            lines.append("  obs_trace.json missing (run with obs.trace=true)")
+
     return "\n".join(lines)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("log_dir", help="a run's train.log_dir")
+    ap.add_argument("--requests", action="store_true",
+                    help="render per-request waterfalls + per-phase quantile tables")
+    ap.add_argument("--max-requests", type=int, default=20,
+                    help="waterfall rows to print (oldest ids first)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.log_dir):
         print(f"obs_report: not a directory: {args.log_dir}", file=sys.stderr)
         return 2
-    print(summarize(args.log_dir))
+    print(summarize(args.log_dir, requests=args.requests, max_requests=args.max_requests))
     return 0
 
 

@@ -71,15 +71,44 @@ def _percentile(sorted_vals, q):
     return sorted_vals[idx]
 
 
+def _hist_delta_quantiles(name, counts_before):
+    """p50/p95/p99 (ms) of one measured WINDOW of a registry histogram:
+    bucket-count deltas against the pre-window snapshot, estimated through
+    the registry's own interpolation (obs.registry.quantiles_from_counts) —
+    the bench reports the same math /metrics scrapes, not its own
+    percentile-of-a-list."""
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry, quantiles_from_counts
+
+    h = get_registry().histogram(name)
+    counts = [a - b for a, b in zip(h.bucket_counts(), counts_before)]
+    p50, p95, p99 = quantiles_from_counts(h.bounds, counts, (0.5, 0.95, 0.99))
+    return {
+        "count": int(sum(counts)),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p95_ms": round(p95 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+    }
+
+
+def _hist_counts(name):
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+
+    return get_registry().histogram(name).bucket_counts()
+
+
 def _direct_row(engine, batch, size, iters, rng):
-    """Exact-bucket engine.predict latency: one untimed page-in, then iters."""
+    """Exact-bucket engine.predict latency: one untimed page-in, then iters.
+    Client-side wall p50/p99 plus the registry's own bucketed quantiles of
+    the same window (serve.run_seconds deltas) ride in every row."""
     x = rng.normal(0, 1, (batch, size, size, 3)).astype("float32")
     engine.predict(x)
+    run_counts0 = _hist_counts("serve.run_seconds")
     lat = []
     for _ in range(iters):
         t1 = time.perf_counter()
         engine.predict(x)
         lat.append(time.perf_counter() - t1)
+    reg_q = _hist_delta_quantiles("serve.run_seconds", run_counts0)
     lat.sort()
     mean = sum(lat) / len(lat)
     return {
@@ -87,6 +116,9 @@ def _direct_row(engine, batch, size, iters, rng):
         "image_size": size,
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
         "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+        "p50_ms_registry": reg_q["p50_ms"],
+        "p95_ms_registry": reg_q["p95_ms"],
+        "p99_ms_registry": reg_q["p99_ms"],
         "qps": round(batch / mean, 2),
     }
 
@@ -285,6 +317,7 @@ def _chaos_round(engine, image_sizes, *, seed, n_requests, target_qps,
     stats = {c: {"submitted": 0, "completed": 0, "rejected": 0, "shed": 0, "failed": 0,
                  "latencies": []} for c in classes}
     pending = []
+    lat_counts0 = {c: _hist_counts(f"serve.latency_seconds.{c}") for c in classes}
     s0 = reg.snapshot()
     t_start = time.perf_counter()
     t_next = t_start
@@ -347,6 +380,10 @@ def _chaos_round(engine, image_sizes, *, seed, n_requests, target_qps,
             **s,
             "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
             "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+            # the same window's quantiles as the registry's bucketed
+            # histograms saw it (admission-side submit->resolution)
+            "registry_quantiles": _hist_delta_quantiles(
+                f"serve.latency_seconds.{cls}", lat_counts0[cls]),
             "qps": round(s["completed"] / wall, 2) if wall else 0.0,
         }
     return out
@@ -489,6 +526,22 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
             seed=chaos_seed, n_requests=chaos_requests,
             target_qps=chaos_qps, fault_rate=chaos_fault_rate,
         )
+    # whole-run quantiles straight from the registry snapshot (the same
+    # .p50/.p95/.p99 columns obs_registry.json and /varz carry): every
+    # serving histogram that saw data, keyed by registry name
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+
+    snap = get_registry().snapshot()
+    registry_quantiles = {
+        k[: -len(".count")]: {
+            "count": snap[k],
+            "p50": snap.get(f"{k[:-len('.count')]}.p50", 0.0),
+            "p95": snap.get(f"{k[:-len('.count')]}.p95", 0.0),
+            "p99": snap.get(f"{k[:-len('.count')]}.p99", 0.0),
+        }
+        for k in snap
+        if k.startswith("serve.") and k.endswith(".count") and snap[k] > 0
+    }
     dev = jax.devices()[0]
     out = {
         "platform": dev.platform,
@@ -498,6 +551,7 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
         "buckets": direct_rows,
         "concurrent": concurrent_rows,
         "ab": ab,
+        "registry_quantiles": registry_quantiles,
         "peak_qps": max([peak_pipe, peak_sync] + [r["qps"] for r in direct_rows]),
     }
     if chaos is not None:

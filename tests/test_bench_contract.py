@@ -127,6 +127,17 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
         (2, 24), (4, 24), (2, 32), (4, 32)]
     for r in out["buckets"]:
         assert r["qps"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0
+        # the same window's quantiles from the registry's bucketed histogram
+        # math (serve.run_seconds deltas) — the bench must report what
+        # /metrics scrapes, not only its own percentile-of-a-list
+        assert r["p99_ms_registry"] >= r["p95_ms_registry"] >= r["p50_ms_registry"] > 0
+    # whole-run registry quantile snapshot: every serving histogram that saw
+    # data carries the p50/p95/p99 columns obs_registry.json and /varz expose
+    rq = out["registry_quantiles"]
+    assert "serve.run_seconds" in rq and "serve.batch_size" in rq
+    for name, v in rq.items():
+        assert v["count"] > 0, name
+        assert v["p99"] >= v["p95"] >= v["p50"] >= 0, (name, v)
     # concurrent-submit A/B: sync and pipelined QPS per (bucket, size); no
     # ordering assertion on magnitude — the tiny preset's sub-ms executables
     # are noise-dominated, the checked-in rehearsal artifact pins the win
@@ -168,6 +179,10 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
             submitted += s["submitted"]
             if s["completed"]:
                 assert s["p99_ms"] >= s["p50_ms"] > 0
+                # per-class registry window quantiles ride every chaos row
+                reg_q = s["registry_quantiles"]
+                assert reg_q["count"] >= 1
+                assert reg_q["p99_ms"] >= reg_q["p95_ms"] >= reg_q["p50_ms"] > 0
         assert submitted == chaos["requests"]
         assert rnd["qps"] > 0
     healthy = chaos["healthy"]

@@ -298,6 +298,128 @@ def test_concurrent_http_clients_route_rows():
 
 
 # ---------------------------------------------------------------------------
+# per-request telemetry: X-Request-Id, /metrics, /varz, trace correlation
+# ---------------------------------------------------------------------------
+
+
+def test_request_id_round_trip():
+    """Every /predict response carries X-Request-Id: minted monotonic ids by
+    default, a client-supplied id echoed back verbatim, and the header rides
+    error responses too (a 429/5xx is exactly when you want the id)."""
+    b, ac, fe = _stack()
+    try:
+        _, _, h1 = _post_image(fe.url, 1)
+        _, _, h2 = _post_image(fe.url, 2)
+        rid1, rid2 = int(h1["X-Request-Id"]), int(h2["X-Request-Id"])
+        assert rid2 > rid1 > 0  # minted, process-monotonic
+        # the body carries it too (clients that drop headers still get it)
+        status, doc, h3 = _post_image(fe.url, 3)
+        assert doc["request_id"] == h3["X-Request-Id"]
+        # client-supplied correlation id is echoed verbatim
+        img = np.full((4, 4, 3), 4.0, np.float32).tolist()
+        status, doc, hdrs = _request(
+            fe.url + "/predict", data=json.dumps({"image": img}).encode(),
+            headers={"Content-Type": "application/json", "X-Request-Id": "client-abc-7"},
+        )
+        assert status == 200 and hdrs["X-Request-Id"] == "client-abc-7"
+        assert doc["request_id"] == "client-abc-7"
+        # errors carry the id as well (unknown class -> 400)
+        status, doc, hdrs = _post_image(fe.url, 5, priority="platinum")
+        assert status == 400 and hdrs.get("X-Request-Id")
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_metrics_and_varz_scrape_surface():
+    """GET /metrics returns Prometheus text exposition with per-class
+    latency bucket + quantile lines; GET /varz the JSON registry snapshot
+    (quantile columns included) plus admission state."""
+    b, ac, fe = _stack()
+    try:
+        assert _post_image(fe.url, 1, priority="batch")[0] == 200
+        req = urllib.request.Request(fe.url + "/metrics")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "# TYPE serve_latency_seconds histogram" in text
+        assert 'serve_latency_seconds_bucket{class="batch",le="+Inf"}' in text
+        assert 'serve_latency_seconds{class="batch",quantile="0.99"}' in text
+        assert 'serve_requests{class="batch"}' in text
+        status, varz, _ = _request(fe.url + "/varz")
+        assert status == 200
+        assert varz["metrics"]["serve.latency_seconds.batch.count"] >= 1
+        assert "serve.latency_seconds.batch.p99" in varz["metrics"]
+        assert varz["metrics"]["serve.latency_seconds.batch.min"] > 0
+        assert varz["admission"]["breaker"] == "closed"
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_quantile_deadline_predictor():
+    """predictor="quantile": once the class histogram has data, the wait
+    prediction is the configured latency quantile (tail-aware) and feeds
+    reject-on-arrival exactly like the EWMA mode."""
+    class _Slow(_EchoEngine):
+        def predict_async(self, images):
+            time.sleep(0.05)
+            return super().predict_async(images)
+
+    get_registry().reset()  # the class histogram must start empty here
+    b = PipelinedBatcher(_Slow(), max_batch=1, max_wait_ms=1.0,
+                         queue_depth=64, drain_timeout_s=2.0).start()
+    ac = AdmissionController(b, predictor="quantile", predictor_quantile=0.95)
+    try:
+        assert ac.predicted_wait_s("interactive") == 0.0  # no data yet
+        fut = ac.submit(np.zeros((4, 4, 3), np.float32))
+        fut.result(timeout=30)
+        wait = ac.predicted_wait_s("interactive")
+        assert wait > 0.01  # learned the ~50 ms tail from the histogram
+        from yet_another_mobilenet_series_tpu.serve.admission import DeadlineUnmeetable
+        with pytest.raises(DeadlineUnmeetable):
+            ac.submit(np.zeros((4, 4, 3), np.float32), deadline_ms=1.0)
+        assert ac.state()["predictor"] == "quantile"
+    finally:
+        b.stop()
+    with pytest.raises(ValueError, match="predictor"):
+        AdmissionController(b, predictor="p99ish")
+
+
+def test_trace_correlates_one_request_across_threads():
+    """The tentpole invariant, in-process: one request id appears in async
+    (b/e) AND flow (s/t/f) events emitted from at least two distinct
+    threads — handler, collect, completion — so Perfetto renders the
+    request as one correlated waterfall."""
+    from yet_another_mobilenet_series_tpu.obs import trace as obs_trace
+
+    prev = obs_trace.get_tracer()
+    tr = obs_trace.configure(enabled=True, ring_size=4096)
+    try:
+        b, ac, fe = _stack()
+        try:
+            status, _, hdrs = _post_image(fe.url, 3)
+            assert status == 200
+            rid = int(hdrs["X-Request-Id"])
+        finally:
+            fe.stop()
+            b.stop()
+        evts = [e for e in tr.to_chrome_trace()["traceEvents"] if e.get("id") == rid]
+        phases = {e["ph"] for e in evts}
+        assert {"b", "e"} <= phases, phases  # async waterfall edges
+        assert {"s", "f"} <= phases, phases  # flow arrows
+        assert len({e["tid"] for e in evts}) >= 2  # across threads
+        names = {e["name"] for e in evts}
+        assert {"serve/request", "serve/queued", "serve/inflight", "serve/req"} <= names
+        # the envelope records the outcome
+        env_end = next(e for e in evts if e["ph"] == "e" and e["name"] == "serve/request")
+        assert env_end["args"]["outcome"] == "completed"
+    finally:
+        obs_trace._TRACER = prev
+
+
+# ---------------------------------------------------------------------------
 # the full front door: cli/serve.py --listen + SIGTERM drain (subprocess)
 # ---------------------------------------------------------------------------
 
@@ -334,7 +456,7 @@ def test_cli_listen_end_to_end_sigterm_drain(tmp_path):
     proc = subprocess.Popen(
         [sys.executable, "-c", _LISTEN_DRIVER, "--listen",
          f"serve.bundle={bundle_dir}", "serve.buckets=[1,4]", "data.image_size=24",
-         "serve.drain_timeout_s=10", f"train.log_dir={log_dir}"],
+         "serve.drain_timeout_s=10", "obs.trace=true", f"train.log_dir={log_dir}"],
         env=dict(os.environ, PYTHONPATH=REPO),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
@@ -348,11 +470,20 @@ def test_cli_listen_end_to_end_sigterm_drain(tmp_path):
         addr = json.loads(open(addr_path).read())
         base = f"http://{addr['host']}:{addr['port']}"
 
-        status, doc, _ = _post_image(base, 2, priority="interactive", deadline_ms=30000)
+        status, doc, hdrs = _post_image(base, 2, priority="interactive", deadline_ms=30000)
         assert status == 200 and len(doc["logits"]) == 4
+        request_id = int(hdrs["X-Request-Id"])
         status, health, _ = _request(base + "/healthz")
         assert status == 200 and health["breaker"] == "closed"
         assert health["classes"]["interactive"]["quota"] >= 1
+        # the live scrape surface: Prometheus exposition with per-class
+        # latency bucket + quantile lines (the acceptance criterion)
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            metrics_text = r.read().decode()
+        assert 'serve_latency_seconds_bucket{class="interactive",le="+Inf"}' in metrics_text
+        assert 'serve_latency_seconds{class="interactive",quantile="0.99"}' in metrics_text
+        status, varz, _ = _request(base + "/varz")
+        assert status == 200 and varz["metrics"]["serve.latency_seconds.interactive.count"] >= 1
 
         proc.send_signal(signal.SIGTERM)
         t0 = time.time()
@@ -366,6 +497,19 @@ def test_cli_listen_end_to_end_sigterm_drain(tmp_path):
         assert snap["serve.requests.interactive"] >= 1
         assert snap["serve.http_requests"] >= 1
         assert snap["serve.breaker_state"] == 0
+        assert snap["serve.latency_seconds.interactive.p99"] > 0
+        # the trace correlates the served request's id across threads:
+        # async (b/e) waterfall edges AND flow (s/t/f) arrows from at least
+        # two distinct tids (HTTP handler / collect / completion)
+        trace = json.loads(open(os.path.join(log_dir, "obs_trace.json")).read())
+        corr = [e for e in trace["traceEvents"] if e.get("id") == request_id]
+        phases = {e["ph"] for e in corr}
+        assert {"b", "e"} <= phases and ({"s", "t", "f"} & phases), phases
+        assert len({e["tid"] for e in corr}) >= 2
+        assert {"serve/request", "serve/queued", "serve/inflight"} <= {e["name"] for e in corr}
+        thread_rows = {e["args"]["name"] for e in trace["traceEvents"]
+                       if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"serve-collect", "serve-complete", "serve-http"} <= thread_rows
     finally:
         if proc.poll() is None:
             proc.kill()

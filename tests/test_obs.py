@@ -76,6 +76,111 @@ def test_registry_reset():
 
 
 # ---------------------------------------------------------------------------
+# bucketed histograms: quantiles, min, concurrency, Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_min_reported():
+    """The satellite fix: vmin was tracked under the lock but never
+    reported — it must reach summary(), snapshot(), and stay correct."""
+    reg = MetricsRegistry()
+    h = reg.histogram("t.wait")
+    for v in (0.2, 0.005, 0.07):
+        h.observe(v)
+    s = h.summary()
+    assert s["min"] == 0.005 and s["max"] == 0.2
+    snap = reg.snapshot()
+    assert snap["t.wait.min"] == 0.005
+    # empty histogram reports zeros, never inf
+    assert reg.histogram("t.empty").summary()["min"] == 0.0
+
+
+def test_histogram_bucketed_quantiles_vs_sorted_reference():
+    """Bucketed p50/p95/p99 must land within one bucket width of the exact
+    sorted-sample quantile (the estimator interpolates inside the bucket
+    that crosses the target rank)."""
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    samples = np.exp(rng.uniform(np.log(2e-4), np.log(20.0), 4000))
+    h = MetricsRegistry().histogram("t.lat")
+    for v in samples:
+        h.observe(float(v))
+    for q in (0.5, 0.95, 0.99):
+        ref = float(np.quantile(samples, q))
+        est = h.quantile(q)
+        # one bucket on the default quarter-decade ladder is a 10**0.25
+        # (~1.78x) span: the estimate must stay inside the ref's bucket
+        assert ref / (10 ** 0.25) <= est <= ref * (10 ** 0.25), (q, ref, est)
+    # quantiles are monotone and clamped to the observed range
+    s = h.summary()
+    assert s["min"] <= s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+
+
+def test_histogram_concurrent_observe_consistent():
+    import threading
+
+    h = MetricsRegistry().histogram("t.conc")
+    n_threads, per_thread = 8, 500
+
+    def worker(i):
+        for j in range(per_thread):
+            h.observe(1e-3 * (1 + (i * per_thread + j) % 97))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n_threads * per_thread
+    assert sum(h.bucket_counts()) == h.count  # no lost bucket increments
+    assert h.summary()["p50"] > 0
+
+
+def test_histogram_custom_default_buckets():
+    """set_default_buckets (the obs.histogram_buckets config knob) applies
+    to histograms created AFTER the call; existing ladders are untouched."""
+    reg = MetricsRegistry()
+    before = reg.histogram("a")
+    reg.set_default_buckets([0.1, 1.0, 10.0])
+    after = reg.histogram("b")
+    assert after.bounds == (0.1, 1.0, 10.0)
+    assert before.bounds != after.bounds
+    assert reg.histogram("a") is before  # get-or-create keeps the old ladder
+
+
+def test_render_prometheus_golden():
+    """Exposition golden: counter/gauge samples, a labeled per-class
+    histogram with cumulative buckets + quantile lines, TYPE lines once per
+    family — the exact text GET /metrics serves."""
+    reg = MetricsRegistry()
+    reg.counter("serve.requests").inc(5)
+    reg.counter("serve.requests.interactive").inc(3)
+    reg.gauge("serve.inflight").set(2)
+    h = reg.histogram("serve.latency_seconds.interactive", bounds=[0.01, 0.1, 1.0])
+    for v in (0.005, 0.05, 0.5):
+        h.observe(v)
+    golden = "\n".join([
+        '# TYPE serve_inflight gauge',
+        'serve_inflight 2',
+        '# TYPE serve_latency_seconds histogram',
+        'serve_latency_seconds_bucket{class="interactive",le="0.01"} 1',
+        'serve_latency_seconds_bucket{class="interactive",le="0.1"} 2',
+        'serve_latency_seconds_bucket{class="interactive",le="1"} 3',
+        'serve_latency_seconds_bucket{class="interactive",le="+Inf"} 3',
+        'serve_latency_seconds_sum{class="interactive"} 0.555',
+        'serve_latency_seconds_count{class="interactive"} 3',
+        'serve_latency_seconds{class="interactive",quantile="0.5"} 0.055',
+        'serve_latency_seconds{class="interactive",quantile="0.95"} 0.44',
+        'serve_latency_seconds{class="interactive",quantile="0.99"} 0.488',
+        '# TYPE serve_requests counter',
+        'serve_requests 5',
+        'serve_requests{class="interactive"} 3',
+    ]) + "\n"
+    assert reg.render_prometheus() == golden
+
+
+# ---------------------------------------------------------------------------
 # tracer
 # ---------------------------------------------------------------------------
 
@@ -141,6 +246,62 @@ def test_tracer_open_spans_readout():
             assert [s["name"] for s in open_now] == ["outer", "inner"]
             assert all(s["open_for_s"] >= 0 for s in open_now)
     assert tr.open_spans() == []
+
+
+def test_tracer_misnested_exit_recovered_and_counted():
+    """The satellite fix: an out-of-order exit must remove the span by
+    identity (not leave it stuck in _open polluting every later hang
+    report) and count obs.misnested_spans."""
+    reg = get_registry()
+    base = reg.snapshot().get("obs.misnested_spans", 0)
+    tr = SpanTracer(ring_size=16)
+    outer = tr.span("outer", "serve")
+    inner = tr.span("inner", "serve")
+    outer.__enter__()
+    inner.__enter__()
+    outer.__exit__(None, None, None)  # parent closed before child: misnested
+    assert reg.snapshot()["obs.misnested_spans"] == base + 1
+    # the child is still tracked (it was not the misnested one)...
+    assert [s["name"] for s in tr.open_spans()] == ["inner"]
+    inner.__exit__(None, None, None)
+    # ...and a clean close leaves nothing behind: no phantom open spans
+    assert tr.open_spans() == []
+    assert [e["name"] for e in _x_events(tr)] == ["outer", "inner"]
+    assert reg.snapshot()["obs.misnested_spans"] == base + 1  # clean pop uncounted
+
+
+def test_tracer_async_flow_events_and_thread_names():
+    """Async (b/e) + flow (s/t/f) events carry the correlation id; registered
+    worker threads get Perfetto thread_name metadata rows."""
+    import threading
+
+    tr = SpanTracer(ring_size=64)
+    tr.async_begin("serve/request", 42, cls="interactive")
+    tr.flow_start("serve/req", 42)
+
+    def worker():
+        tr.register_thread("serve-worker-x")
+        tr.flow_step("serve/req", 42)
+        tr.flow_end("serve/req", 42, outcome="completed")
+        tr.async_end("serve/request", 42)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    doc = tr.to_chrome_trace()
+    evts = doc["traceEvents"]
+    corr = [e for e in evts if e.get("id") == 42]
+    assert [e["ph"] for e in corr] == ["b", "s", "t", "f", "e"]
+    assert len({e["tid"] for e in corr}) == 2  # two threads, one id
+    flow_end = next(e for e in corr if e["ph"] == "f")
+    assert flow_end["bp"] == "e" and flow_end["args"]["outcome"] == "completed"
+    names = {e["args"]["name"] for e in evts if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "serve-worker-x" in names
+    # disabled tracer: marks are no-ops
+    off = SpanTracer(ring_size=4, enabled=False)
+    off.async_begin("x", 1)
+    off.register_thread("nope")
+    assert [e for e in off.to_chrome_trace()["traceEvents"] if e.get("id")] == []
 
 
 def test_tracer_module_singleton_configure():
@@ -282,6 +443,12 @@ def test_watchdog_serving_report_from_live_batcher(tmp_path):
         assert serving["inflight"] >= 1  # the wedged batch occupies the window
         assert serving["admission"]["breaker"] == "closed"
         assert serving["admission"]["classes"]["interactive"]["in_queue"] >= 1
+        # the report NAMES the wedged request: id, class, age, phase
+        oldest = serving["oldest_request"]
+        assert oldest is not None
+        assert oldest["class"] == "interactive"
+        assert oldest["age_s"] >= 0.0 and oldest["id"] >= 1
+        assert oldest["phase"] in ("queued", "dispatched")
         # the wedged request is also visible in the dumped thread stacks
         assert any("serve-complete" in name for name in rep["threads"])
     finally:
@@ -445,3 +612,40 @@ def test_obs_report_renders_summary(tmp_path, capsys):
 
 def test_obs_report_missing_dir(capsys):
     assert _obs_report_mod().main(["/definitely/not/a/dir"]) == 2
+
+
+def test_obs_report_requests_waterfalls_and_quantiles(tmp_path, capsys):
+    """--requests renders per-request waterfalls from the trace's async
+    events and a per-phase quantile table from the registry snapshot."""
+    us = 1000.0  # µs timestamps in the trace
+    events = [
+        # request 17: queued 2 ms, in-flight 3 ms, across two threads
+        {"name": "serve/request", "ph": "b", "id": 17, "tid": 1, "ts": 0,
+         "args": {"cls": "interactive", "deadline_ms": 50.0}},
+        {"name": "serve/queued", "ph": "b", "id": 17, "tid": 1, "ts": 0},
+        {"name": "serve/queued", "ph": "e", "id": 17, "tid": 2, "ts": 2 * us},
+        {"name": "serve/inflight", "ph": "b", "id": 17, "tid": 2, "ts": 2 * us},
+        {"name": "serve/inflight", "ph": "e", "id": 17, "tid": 3, "ts": 5 * us},
+        {"name": "serve/request", "ph": "e", "id": 17, "tid": 3, "ts": 5.2 * us,
+         "args": {"outcome": "completed"}},
+        # a flow step rides along and must not confuse the waterfall parse
+        {"name": "serve/req", "ph": "t", "id": 17, "tid": 2, "ts": 2 * us},
+    ]
+    (tmp_path / "obs_trace.json").write_text(json.dumps({"traceEvents": events}))
+    (tmp_path / "obs_registry.json").write_text(json.dumps({
+        "serve.queue_wait_seconds.count": 4.0,
+        "serve.queue_wait_seconds.p50": 0.002, "serve.queue_wait_seconds.p95": 0.003,
+        "serve.queue_wait_seconds.p99": 0.0031, "serve.queue_wait_seconds.min": 0.001,
+        "serve.queue_wait_seconds.max": 0.0032,
+        "serve.latency_seconds.interactive.count": 4.0,
+        "serve.latency_seconds.interactive.p50": 0.005,
+        "serve.latency_seconds.interactive.p99": 0.009,
+    }))
+    rc = _obs_report_mod().main([str(tmp_path), "--requests"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "#17" in out and "class=interactive" in out
+    assert "total=5.20ms" in out and "queued=2.00ms" in out and "inflight=3.00ms" in out
+    assert "[completed]" in out
+    assert "queue wait" in out and "latency [interactive]" in out
+    assert "p50_ms" in out and "p99_ms" in out

@@ -148,8 +148,11 @@ def _make_batcher(cfg: Config, engine) -> MicroBatcher:
 
 def _serving_info(batcher, admission) -> dict:
     """The watchdog hang-report 'serving' section: worker thread liveness,
-    in-flight window occupancy, breaker + per-class queue state."""
-    info: dict = {"admission": admission.state()}
+    in-flight window occupancy, breaker + per-class queue state, and the
+    OLDEST in-flight request's id/class/age/phase — a wedged window names
+    whose request is stuck and which hop it is stuck at."""
+    info: dict = {"admission": admission.state(),
+                  "oldest_request": admission.oldest_inflight()}
     if hasattr(batcher, "worker_threads"):
         info["batcher_threads"] = batcher.worker_threads()
         info["inflight"] = batcher.inflight()
@@ -207,7 +210,7 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
         os.makedirs(cfg.train.log_dir, exist_ok=True)
         with open(os.path.join(cfg.train.log_dir, "listen_addr.json"), "w") as f:
             json.dump(addr, f)
-    log.log(f"listening on {frontend.url} (POST /predict, GET /healthz)")
+    log.log(f"listening on {frontend.url} (POST /predict, GET /healthz|/metrics|/varz)")
     try:
         stop_event.wait()
     finally:
@@ -226,6 +229,9 @@ def run(cfg: Config) -> dict:
     is_coord = mesh_lib.is_coordinator()
     log = Logger(cfg.train.log_dir, enabled=is_coord, tensorboard=False)
     reg = obs_registry.get_registry()
+    if cfg.obs.histogram_buckets:
+        # before any serving histogram exists: the ladder applies at creation
+        reg.set_default_buckets(cfg.obs.histogram_buckets)
     log.set_registry(reg)
     tracer = obs_trace.configure(enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size)
     result: dict = {}

@@ -420,6 +420,9 @@ def run(cfg: Config) -> dict:
     # registry snapshots ride into every scalars row; the span tracer and
     # stall watchdog are coordinator-only opt-ins (cfg.obs)
     reg = obs_registry.get_registry()
+    if cfg.obs.histogram_buckets:
+        # before any training histogram exists: the ladder applies at creation
+        reg.set_default_buckets(cfg.obs.histogram_buckets)
     log.set_registry(reg)
     tracer = obs_trace.configure(
         enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size

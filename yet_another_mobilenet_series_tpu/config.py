@@ -381,6 +381,12 @@ class ObsConfig:
     # a ~100-byte tuple, so the default retains the last few thousand events
     # of a multi-day run for bounded memory
     trace_ring_size: int = 4096
+    # histogram bucket ladder (upper bounds, seconds) for registry
+    # histograms created after startup; () = the built-in quarter-decade
+    # log ladder 100µs..~56s (obs/registry.py DEFAULT_BUCKET_BOUNDS). The
+    # ladder sets quantile-estimate resolution: p50/p95/p99 interpolate
+    # inside one bucket, so error is bounded by that bucket's width.
+    histogram_buckets: Sequence[float] = ()
     # no train-loop heartbeat (step / eval / checkpoint / rematerialize
     # progress) for this long -> hang_report.json in log_dir. 0 = off.
     # Must exceed the slowest legitimate gap: the first step's compile and
@@ -434,6 +440,13 @@ class AdmissionConfig:
     # reject-on-arrival when the predicted wait already exceeds the request's
     # deadline: cheaper than shedding it after it burned a queue slot
     reject_unmeetable: bool = True
+    # wait predictor feeding reject_unmeetable: "ewma" (smoothed mean — the
+    # original; tracks the center, blind to the tail) or "quantile" (the
+    # predictor_quantile of the class's bucketed serve.latency_seconds
+    # histogram — deadline decisions keyed on measured TAIL latency; falls
+    # back to the EWMA until the class histogram has data)
+    predictor: str = "ewma"
+    predictor_quantile: float = 0.9
 
 
 @dataclass(frozen=True)

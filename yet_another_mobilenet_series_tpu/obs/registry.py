@@ -8,6 +8,21 @@ Producers anywhere in the process register/update metrics by name;
 ``Logger.scalars`` snapshots the whole registry into every metrics row, so
 one ``metrics.jsonl`` stream carries every signal.
 
+Histograms are BUCKETED: every observation lands in a fixed log-spaced
+bucket ladder (``DEFAULT_BUCKET_BOUNDS``, overridable per registry via
+``set_default_buckets`` — the ``obs.histogram_buckets`` config knob — or per
+histogram at creation), so online p50/p95/p99 estimates come out of
+``snapshot()`` without keeping samples: the quantile is linearly
+interpolated inside the bucket that crosses the target rank, clamped to the
+tracked min/max. Error is bounded by one bucket width (~1.78x per rung on
+the default quarter-decade ladder) — tests/test_obs.py pins the estimate
+against a sorted-sample reference. ``render_prometheus()`` emits the same
+state as Prometheus text exposition (``GET /metrics`` on the serving
+frontend): histogram families get cumulative ``_bucket{le=...}`` lines plus
+``quantile=`` samples, and dotted per-class/per-bucket metric names
+(``serve.latency_seconds.interactive``) fold into one labeled family
+(``serve_latency_seconds{class="interactive"}``) via ``PROM_LABEL_FAMILIES``.
+
 Thread-safety: metric updates are single bytecode-level mutations guarded by
 a lock only where a read-modify-write races (counter inc, histogram
 observe); ``snapshot()`` may be called from the watchdog thread at any time.
@@ -18,8 +33,34 @@ lazily at snapshot time instead of being pushed per batch.
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Callable
+from typing import Callable, Sequence
+
+# Quarter-decade log ladder from 100 µs to ~56 s (24 bounds + overflow):
+# wide enough for queue waits and whole-request latencies, fine enough that
+# a one-bucket quantile error is ~1.78x — the SLO question is "is p99 5 ms
+# or 50 ms", not "5.0 or 5.2". Durations in seconds by convention.
+DEFAULT_BUCKET_BOUNDS: tuple[float, ...] = tuple(
+    round(1e-4 * (10.0 ** 0.25) ** i, 10) for i in range(24)
+)
+
+# Rendered quantiles: snapshot()/render_prometheus() columns and the serving
+# frontend's /varz payload all agree on this set.
+QUANTILES: tuple[float, ...] = (0.5, 0.95, 0.99)
+
+# Dotted families whose last segment is a label value, not part of the
+# metric name: "serve.latency_seconds.interactive" is one sample of the
+# serve_latency_seconds family at class="interactive" in the exposition.
+PROM_LABEL_FAMILIES: dict[str, str] = {
+    "serve.latency_seconds": "class",
+    "serve.requests": "class",
+    "serve.completed": "class",
+    "serve.rejected": "class",
+    "serve.retries": "class",
+    "serve.shed_deadline": "class",
+    "serve.bucket_hits": "bucket",
+}
 
 
 class Counter:
@@ -73,18 +114,25 @@ class Gauge:
 
 
 class Histogram:
-    """Streaming summary stats (count/sum/min/max) — enough to read "how
-    many, how long, worst case" for durations like checkpoint barrier waits
-    without keeping samples."""
+    """Streaming summary stats (count/sum/min/max) plus fixed log-spaced
+    bucket counts, so online quantile estimates (p50/p95/p99) come out of a
+    snapshot without keeping samples — "how many, how long, worst case, AND
+    where the tail sits" for durations like request latencies."""
 
-    __slots__ = ("name", "count", "total", "vmin", "vmax", "_lock")
+    __slots__ = ("name", "count", "total", "vmin", "vmax", "bounds", "_bucket_counts", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, bounds: Sequence[float] = DEFAULT_BUCKET_BOUNDS):
         self.name = name
         self.count = 0
         self.total = 0.0
         self.vmin = float("inf")
         self.vmax = float("-inf")
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        if not self.bounds:
+            raise ValueError(f"histogram {name!r} needs at least one bucket bound")
+        # bucket i counts values <= bounds[i] (and > bounds[i-1]); the last
+        # slot is the +Inf overflow bucket
+        self._bucket_counts = [0] * (len(self.bounds) + 1)
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -94,31 +142,118 @@ class Histogram:
             self.total += v
             self.vmin = min(self.vmin, v)
             self.vmax = max(self.vmax, v)
+            self._bucket_counts[bisect.bisect_left(self.bounds, v)] += 1
+
+    def bucket_counts(self) -> tuple[int, ...]:
+        """Per-bucket counts (NOT cumulative), one per bound + the overflow
+        slot. Consistent snapshot: taken under the observe lock."""
+        with self._lock:
+            return tuple(self._bucket_counts)
+
+    def _quantiles_locked(self, qs: Sequence[float]) -> list[float]:
+        return quantiles_from_counts(
+            self.bounds, self._bucket_counts, qs, vmin=self.vmin, vmax=self.vmax
+        )
+
+    def quantile(self, q: float) -> float:
+        """Bucketed estimate of the q-quantile (0 when empty). Error is
+        bounded by the width of the bucket the true quantile lands in."""
+        with self._lock:
+            return self._quantiles_locked((q,))[0]
 
     def summary(self) -> dict[str, float]:
-        if not self.count:
-            return {"count": 0.0, "sum": 0.0, "mean": 0.0, "max": 0.0}
-        return {
-            "count": float(self.count),
-            "sum": self.total,
-            "mean": self.total / self.count,
-            "max": self.vmax,
-        }
+        with self._lock:
+            if not self.count:
+                return {"count": 0.0, "sum": 0.0, "mean": 0.0, "min": 0.0, "max": 0.0,
+                        **{_q_key(q): 0.0 for q in QUANTILES}}
+            est = self._quantiles_locked(QUANTILES)
+            return {
+                "count": float(self.count),
+                "sum": self.total,
+                "mean": self.total / self.count,
+                "min": self.vmin,
+                "max": self.vmax,
+                **{_q_key(q): v for q, v in zip(QUANTILES, est)},
+            }
+
+
+def _q_key(q: float) -> str:
+    return "p" + format(q * 100, "g").replace(".", "_")  # 0.5 -> p50, 0.99 -> p99
+
+
+def quantiles_from_counts(
+    bounds: Sequence[float],
+    counts: Sequence[int],
+    qs: Sequence[float],
+    *,
+    vmin: float | None = None,
+    vmax: float | None = None,
+) -> list[float]:
+    """Quantile estimates from per-bucket counts (len(bounds) + 1 slots, the
+    last being overflow): walk the cumulative counts to the bucket that
+    crosses each target rank and interpolate linearly inside it, clamped to
+    the observed [vmin, vmax]. Shared by :class:`Histogram` and any consumer
+    working from bucket-count DELTAS (scripts/serve_bench.py measures one
+    round's quantiles as counts_after - counts_before through this exact
+    function, so bench math and registry math cannot drift apart)."""
+    total = sum(counts)
+    if not total:
+        return [0.0 for _ in qs]
+    lo_clamp = 0.0 if vmin is None or vmin == float("inf") else vmin
+    hi_clamp = bounds[-1] if vmax is None or vmax == float("-inf") else vmax
+    out = []
+    for q in qs:
+        target = q * total
+        cum = 0.0
+        est = hi_clamp
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo = bounds[i - 1] if i > 0 else lo_clamp
+                hi = bounds[i] if i < len(bounds) else hi_clamp
+                lo = max(lo, lo_clamp)
+                hi = min(max(hi, lo), hi_clamp)
+                est = lo + (hi - lo) * (target - cum) / c
+                break
+            cum += c
+        out.append(min(max(est, lo_clamp), hi_clamp))
+    return out
+
+
+def _prom_name(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+def _prom_family(name: str) -> tuple[str, str]:
+    """(family, label-clause) for one registry name: a known labeled family
+    folds its last segment into a label, everything else is label-less."""
+    if "." in name:
+        fam, suffix = name.rsplit(".", 1)
+        label = PROM_LABEL_FAMILIES.get(fam)
+        if label is not None:
+            return _prom_name(fam), f'{label}="{suffix}"'
+    return _prom_name(name), ""
+
+
+def _fmt(v: float) -> str:
+    return format(float(v), ".10g")
 
 
 class MetricsRegistry:
     """Name -> typed metric, get-or-create semantics. Re-requesting a name
     with a different type is a programming error and fails loudly."""
 
-    def __init__(self):
+    def __init__(self, default_buckets: Sequence[float] = DEFAULT_BUCKET_BOUNDS):
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._default_buckets = tuple(default_buckets)
         self._lock = threading.Lock()
 
-    def _get(self, name: str, cls):
+    def _get(self, name: str, cls, *args):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = self._metrics[name] = cls(name)
+                m = self._metrics[name] = cls(name, *args)
             elif not isinstance(m, cls):
                 raise ValueError(
                     f"metric {name!r} already registered as {type(m).__name__}, "
@@ -132,12 +267,23 @@ class MetricsRegistry:
     def gauge(self, name: str) -> Gauge:
         return self._get(name, Gauge)
 
-    def histogram(self, name: str) -> Histogram:
-        return self._get(name, Histogram)
+    def histogram(self, name: str, bounds: Sequence[float] | None = None) -> Histogram:
+        """Get-or-create; ``bounds`` applies only at creation (an existing
+        histogram keeps its ladder — bucket counts are not re-binnable)."""
+        return self._get(name, Histogram, tuple(bounds) if bounds else self._default_buckets)
+
+    def set_default_buckets(self, bounds: Sequence[float]) -> None:
+        """Bucket ladder for histograms created AFTER this call (the
+        ``obs.histogram_buckets`` config knob, applied at CLI startup before
+        any serving histogram exists)."""
+        if not bounds:
+            return
+        self._default_buckets = tuple(sorted(float(b) for b in bounds))
 
     def snapshot(self) -> dict[str, float]:
         """Flat {name: float} view of every metric; histograms expand to
-        ``name.count/.sum/.mean/.max``. Safe to call from any thread."""
+        ``name.count/.sum/.mean/.min/.max/.p50/.p95/.p99``. Safe to call
+        from any thread."""
         with self._lock:
             metrics = dict(self._metrics)
         out: dict[str, float] = {}
@@ -149,6 +295,49 @@ class MetricsRegistry:
             else:
                 out[name] = float(m.value)
         return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4) of the whole registry
+        — the body behind ``GET /metrics`` (serve/frontend.py). Histograms
+        emit cumulative ``_bucket{le=...}``/``_sum``/``_count`` plus
+        ``quantile=`` estimate samples; counters/gauges one sample each.
+        Stdlib-only, no client library."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        lines: list[str] = []
+        typed: set[str] = set()
+
+        def _type_line(fam: str, kind: str) -> None:
+            if fam not in typed:
+                typed.add(fam)
+                lines.append(f"# TYPE {fam} {kind}")
+
+        for name in sorted(metrics):
+            m = metrics[name]
+            fam, label = _prom_family(name)
+            if isinstance(m, Histogram):
+                _type_line(fam, "histogram")
+                s = m.summary()
+                cum = 0
+                for bound, c in zip(m.bounds, m.bucket_counts()):
+                    cum += c
+                    sep = "," if label else ""
+                    lines.append(f'{fam}_bucket{{{label}{sep}le="{_fmt(bound)}"}} {cum}')
+                sep = "," if label else ""
+                lines.append(f'{fam}_bucket{{{label}{sep}le="+Inf"}} {int(s["count"])}')
+                lines.append(f"{fam}_sum{{{label}}} {_fmt(s['sum'])}" if label
+                             else f"{fam}_sum {_fmt(s['sum'])}")
+                lines.append(f"{fam}_count{{{label}}} {int(s['count'])}" if label
+                             else f"{fam}_count {int(s['count'])}")
+                for q in QUANTILES:
+                    lines.append(
+                        f'{fam}{{{label}{sep}quantile="{format(q, "g")}"}} {_fmt(s[_q_key(q)])}'
+                    )
+            else:
+                _type_line(fam, "counter" if isinstance(m, Counter) else "gauge")
+                lines.append(f"{fam}{{{label}}} {_fmt(m.value)}" if label
+                             else f"{fam} {_fmt(m.value)}")
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         """Drop every metric (tests; never called by production code — the

@@ -10,9 +10,30 @@ no device sync), tracing adds no host<->device round trips: an input-bound
 step shows a fat ``data/next`` span, a dispatch-bound one a fat
 ``dispatch/*`` span, and a wedged tunnel an open span in the hang report.
 
+Beyond duration ("X") spans the tracer emits the Chrome-trace event kinds
+that correlate ONE request across threads (serve/context.py threads them
+through the serving stack):
+
+- **async events** (``ph: b``/``e``, keyed by ``id``): a request's
+  admit -> queue -> in-flight -> complete phases render as one nested
+  waterfall row per request id in Perfetto, regardless of which thread
+  emitted each edge;
+- **flow events** (``ph: s``/``t``/``f``, same ``id``): arrows stitching
+  the handler thread's submit to the collect thread's dispatch to the
+  completion thread's sync;
+- **metadata** (``ph: M``): ``thread_name`` rows for registered worker
+  threads (``register_thread``), so Perfetto shows ``serve-collect`` /
+  ``serve-complete``, not raw thread ids.
+
 The buffer is a fixed-size ring (``collections.deque(maxlen=...)``): a
-multi-day run keeps the last N spans, never unbounded memory. Completed
-spans are plain tuples; JSON rendering happens only at ``write()``.
+multi-day run keeps the last N events, never unbounded memory. Completed
+events are plain tuples; JSON rendering happens only at ``write()``.
+
+A span exited OUT OF ORDER (an exception path closing a parent before a
+child, a handle resolved on a different thread) is removed from its stack
+by identity wherever it sits and counted in ``obs.misnested_spans`` —
+before this, the stale entry sat in ``_open`` forever and every later hang
+report carried phantom "open" spans.
 
 Categories are load-bearing (docs/OBSERVABILITY.md span taxonomy): ``data``,
 ``dispatch``, ``sync``, ``prune``, ``eval``, ``ckpt``, ``rebuild``,
@@ -26,6 +47,8 @@ import json
 import os
 import threading
 import time
+
+from .registry import get_registry
 
 
 class _NullSpan:
@@ -67,11 +90,15 @@ class SpanTracer:
     def __init__(self, ring_size: int = 4096, enabled: bool = True):
         self.enabled = enabled
         self.ring_size = ring_size
-        # completed spans: (name, cat, t0_ns, dur_ns, tid, args)
+        # completed events: (ph, name, cat, t0_ns, dur_ns, tid, args, ev_id)
+        # — ph "X" for duration spans (dur_ns set), "b"/"e" async and
+        # "s"/"t"/"f" flow events (ev_id set, dur 0)
         self._events: collections.deque = collections.deque(maxlen=max(ring_size, 1))
         # open-span stacks keyed by thread id; each thread pushes/pops only
         # its own stack (GIL-atomic list ops), the watchdog reads copies
         self._open: dict[int, list[_Span]] = {}
+        # tid -> human name for Perfetto thread_name metadata rows
+        self._thread_names: dict[int, str] = {}
         self._origin_ns = time.perf_counter_ns()
         self._pid = os.getpid()
 
@@ -97,8 +124,64 @@ class SpanTracer:
         stack = self._open.get(threading.get_ident())
         if stack and stack[-1] is span:
             stack.pop()
+        else:
+            # out-of-order exit: remove by identity wherever it sits (its
+            # own stack first, any other thread's second) so the entry can
+            # never pollute later hang reports as a phantom open span.
+            # list() snapshots _open: another thread registering its first
+            # span mid-scan must not blow up this thread's span exit
+            found = False
+            for st in ([stack] if stack else []) + [
+                s for s in list(self._open.values()) if s is not stack
+            ]:
+                for i in range(len(st) - 1, -1, -1):
+                    if st[i] is span:
+                        del st[i]
+                        found = True
+                        break
+                if found:
+                    break
+            if found:
+                get_registry().counter("obs.misnested_spans").inc()
         self._events.append(
-            (span.name, span.cat, span.t0_ns, t1_ns - span.t0_ns, threading.get_ident(), span.args)
+            ("X", span.name, span.cat, span.t0_ns, t1_ns - span.t0_ns,
+             threading.get_ident(), span.args, None)
+        )
+
+    def _mark(self, ph: str, name: str, cat: str, ev_id: int, args: dict | None) -> None:
+        if not self.enabled:
+            return
+        self._events.append(
+            (ph, name, cat, time.perf_counter_ns(), 0, threading.get_ident(), args, ev_id)
+        )
+
+    # async (nestable, per-id waterfall rows) -------------------------------
+
+    def async_begin(self, name: str, ev_id: int, cat: str = "serve", **args) -> None:
+        self._mark("b", name, cat, ev_id, args or None)
+
+    def async_end(self, name: str, ev_id: int, cat: str = "serve", **args) -> None:
+        self._mark("e", name, cat, ev_id, args or None)
+
+    # flow (cross-thread arrows) --------------------------------------------
+
+    def flow_start(self, name: str, ev_id: int, cat: str = "serve", **args) -> None:
+        self._mark("s", name, cat, ev_id, args or None)
+
+    def flow_step(self, name: str, ev_id: int, cat: str = "serve", **args) -> None:
+        self._mark("t", name, cat, ev_id, args or None)
+
+    def flow_end(self, name: str, ev_id: int, cat: str = "serve", **args) -> None:
+        self._mark("f", name, cat, ev_id, args or None)
+
+    def register_thread(self, name: str | None = None) -> None:
+        """Name the CALLING thread's Perfetto row (``thread_name`` metadata
+        event at ``to_chrome_trace``). Worker loops call this once at entry;
+        default is the Python thread's own name (``serve-collect``, ...)."""
+        if not self.enabled:
+            return
+        self._thread_names[threading.get_ident()] = (
+            name or threading.current_thread().name
         )
 
     # -- readout ------------------------------------------------------------
@@ -123,7 +206,8 @@ class SpanTracer:
 
     def to_chrome_trace(self) -> dict:
         """Chrome trace-event JSON object (load via chrome://tracing or
-        https://ui.perfetto.dev). Complete ("X") events, ts/dur in µs."""
+        https://ui.perfetto.dev). Complete ("X"), async ("b"/"e"), flow
+        ("s"/"t"/"f"), and metadata ("M") events, ts/dur in µs."""
         events: list[dict] = [
             {
                 "name": "process_name",
@@ -134,16 +218,32 @@ class SpanTracer:
                 "args": {"name": "yamt coordinator"},
             }
         ]
-        for name, cat, t0_ns, dur_ns, tid, args in list(self._events):
+        for tid, name in sorted(self._thread_names.items()):
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": self._pid,
+                    "tid": tid,
+                    "ts": 0,
+                    "args": {"name": name},
+                }
+            )
+        for ph, name, cat, t0_ns, dur_ns, tid, args, ev_id in list(self._events):
             ev = {
                 "name": name,
                 "cat": cat,
-                "ph": "X",
+                "ph": ph,
                 "ts": (t0_ns - self._origin_ns) / 1e3,
-                "dur": dur_ns / 1e3,
                 "pid": self._pid,
                 "tid": tid,
             }
+            if ph == "X":
+                ev["dur"] = dur_ns / 1e3
+            else:
+                ev["id"] = ev_id
+                if ph == "f":
+                    ev["bp"] = "e"  # bind the arrow head to the enclosing slice
             if args:
                 ev["args"] = args
             events.append(ev)

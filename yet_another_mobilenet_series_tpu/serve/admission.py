@@ -56,6 +56,7 @@ from concurrent.futures import Future, InvalidStateError
 
 from ..obs.registry import get_registry
 from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
+from .context import RequestContext
 
 # the QoS taxonomy, cheapest-to-shed last; weights align with this order
 CLASSES = ("interactive", "batch", "best_effort")
@@ -166,9 +167,9 @@ class _Pending:
     """Admission-side bookkeeping for one in-system request (survives
     retries — the class quota slot is held until final resolution)."""
 
-    __slots__ = ("cls", "image", "t_submit", "t_deadline", "retries_left", "probe", "attempt")
+    __slots__ = ("cls", "image", "t_submit", "t_deadline", "retries_left", "probe", "attempt", "ctx")
 
-    def __init__(self, cls, image, deadline_s, retries_left, probe):
+    def __init__(self, cls, image, deadline_s, retries_left, probe, ctx):
         self.cls = cls
         self.image = image
         self.t_submit = time.perf_counter()
@@ -176,6 +177,7 @@ class _Pending:
         self.retries_left = retries_left
         self.probe = probe
         self.attempt = 0
+        self.ctx = ctx
 
 
 class AdmissionController:
@@ -199,9 +201,13 @@ class AdmissionController:
         breaker_cooldown_s: float = 1.0,
         ewma_alpha: float = 0.2,
         reject_unmeetable: bool = True,
+        predictor: str = "ewma",
+        predictor_quantile: float = 0.9,
         seed: int = 0,
         heartbeat=None,
     ):
+        if predictor not in ("ewma", "quantile"):
+            raise ValueError(f"predictor must be 'ewma' or 'quantile', got {predictor!r}")
         if len(weights) != len(CLASSES):
             raise ValueError(f"need one weight per class {CLASSES}, got {weights}")
         if default_class not in CLASSES:
@@ -213,6 +219,8 @@ class AdmissionController:
         self._jitter = retry_jitter
         self._alpha = ewma_alpha
         self._reject_unmeetable = reject_unmeetable
+        self._predictor = predictor
+        self._predictor_q = float(predictor_quantile)
         self._heartbeat = heartbeat  # e.g. StallWatchdog.arm — beats per completion
         self._rng = random.Random(seed)
         self.breaker = CircuitBreaker(breaker_threshold, breaker_cooldown_s)
@@ -225,6 +233,9 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._in_queue = {cls: 0 for cls in CLASSES}
         self._ewma_s: float | None = None
+        # rid -> RequestContext for every request currently in the system:
+        # the hang report's "whose request is wedged" section reads this
+        self._inflight_ctx: dict[int, RequestContext] = {}
         self._reg = get_registry()
 
     # -- the arrival-time wait predictor ------------------------------------
@@ -237,24 +248,46 @@ class AdmissionController:
                 else self._alpha * latency_s + (1 - self._alpha) * self._ewma_s
             )
 
-    def predicted_wait_s(self) -> float:
-        """Expected time-to-answer for a request admitted NOW: the latency
-        EWMA scaled by the backlog in units of engine batches. 0 until the
-        first completion lands (no data — admit optimistically)."""
+    def predicted_wait_s(self, cls: str | None = None) -> float:
+        """Expected time-to-answer for a request admitted NOW: a per-request
+        latency estimate scaled by the backlog in units of engine batches.
+        0 until the first completion lands (no data — admit optimistically).
+
+        Two estimators (``predictor`` config): ``ewma`` (the original
+        smoothed mean — tracks the center, blind to the tail) and
+        ``quantile`` (the ``predictor_quantile`` of the class's bucketed
+        ``serve.latency_seconds.<class>`` histogram — a p90-based predictor
+        sheds on TAIL latency, which is what deadlines are actually about;
+        FLASH/LANA: decide on measured latency, not a proxy). The quantile
+        mode falls back to the EWMA until the class histogram has data."""
         with self._lock:
             ewma = self._ewma_s
             backlog = sum(self._in_queue.values())
-        if ewma is None:
+        per_request = ewma
+        if self._predictor == "quantile":
+            hist = self._reg.histogram(f"serve.latency_seconds.{cls or self._default_class}")
+            if hist.count:
+                per_request = hist.quantile(self._predictor_q)
+        if per_request is None:
             return 0.0
         per_batch = max(getattr(self._batcher, "_max_batch", 1), 1)
-        return ewma * (1.0 + backlog / per_batch)
+        return per_request * (1.0 + backlog / per_batch)
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, image, *, priority: str | None = None, deadline_ms: float | None = None) -> Future:
+    def submit(
+        self,
+        image,
+        *,
+        priority: str | None = None,
+        deadline_ms: float | None = None,
+        ctx: RequestContext | None = None,
+    ) -> Future:
         cls = priority or self._default_class
         if cls not in CLASSES:
             raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
+        if ctx is None:  # direct callers get an id too; the frontend mints its own
+            ctx = RequestContext.mint(cls, deadline_ms)
         admit, probe = self.breaker.allow()
         if not admit:
             self._reject(cls, "serve.rejected_breaker")
@@ -263,7 +296,7 @@ class AdmissionController:
             )
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else None
         if self._reject_unmeetable and deadline_s is not None:
-            wait = self.predicted_wait_s()
+            wait = self.predicted_wait_s(cls)
             if wait > deadline_s:
                 if probe:
                     self.breaker.cancel_probe()  # probe slot not consumed
@@ -284,10 +317,10 @@ class AdmissionController:
             raise ClassQueueFull(
                 f"class {cls!r} at its weighted queue share ({self._quota[cls]})"
             )
-        ctx = _Pending(cls, image, deadline_s, self._max_retries, probe)
+        pending = _Pending(cls, image, deadline_s, self._max_retries, probe, ctx)
         outer: Future = Future()
         try:
-            inner = self._batcher.submit(image, deadline_ms=deadline_ms, priority=cls)
+            inner = self._batcher.submit(image, deadline_ms=deadline_ms, priority=cls, ctx=ctx)
         except Exception:
             self._release(cls)
             if probe:
@@ -295,7 +328,10 @@ class AdmissionController:
             self._reject(cls, None)  # rejected_full already counted by the batcher
             raise
         self._reg.counter(f"serve.requests.{cls}").inc()
-        inner.add_done_callback(lambda fut: self._on_done(ctx, outer, fut))
+        ctx.open_envelope()
+        with self._lock:
+            self._inflight_ctx[ctx.rid] = ctx
+        inner.add_done_callback(lambda fut: self._on_done(pending, outer, fut))
         return outer
 
     def _reject(self, cls: str, cause_counter: str | None) -> None:
@@ -310,7 +346,10 @@ class AdmissionController:
 
     # -- completion side (runs on batcher worker / timer threads) -----------
 
-    def _resolve(self, outer: Future, value=None, exc: Exception | None = None) -> None:
+    def _resolve(self, pending: _Pending, outer: Future, value=None, exc: Exception | None = None) -> None:
+        with self._lock:
+            self._inflight_ctx.pop(pending.ctx.rid, None)
+        pending.ctx.close_envelope()
         try:
             if exc is not None:
                 outer.set_exception(exc)
@@ -321,62 +360,75 @@ class AdmissionController:
         if self._heartbeat is not None:
             self._heartbeat()
 
-    def _on_done(self, ctx: _Pending, outer: Future, inner: Future) -> None:
+    def _on_done(self, pending: _Pending, outer: Future, inner: Future) -> None:
         exc = inner.exception()
         if exc is None:
-            self.breaker.on_success(ctx.probe)
-            self._observe(ctx.cls, time.perf_counter() - ctx.t_submit)
-            self._reg.counter(f"serve.completed.{ctx.cls}").inc()
-            self._release(ctx.cls)
-            self._resolve(outer, value=inner.result())
+            self.breaker.on_success(pending.probe)
+            self._observe(pending.cls, time.perf_counter() - pending.t_submit)
+            self._reg.counter(f"serve.completed.{pending.cls}").inc()
+            self._release(pending.cls)
+            self._resolve(pending, outer, value=inner.result())
             return
         if isinstance(exc, (DeadlineExceeded, DrainTimeout)):
             # sheds are policy, not engine health: no breaker, no retry
-            self._release(ctx.cls)
-            self._resolve(outer, exc=exc)
+            self._release(pending.cls)
+            self._resolve(pending, outer, exc=exc)
             return
         # engine failure: breaker accounting, then bounded retry
         self._reg.counter("serve.engine_failures").inc()
-        self.breaker.on_failure(ctx.probe)
-        ctx.probe = False  # the probe verdict is spent; a retry is ordinary traffic
-        if ctx.retries_left <= 0 or self.breaker.state == BREAKER_OPEN or (
-            ctx.t_deadline is not None and time.perf_counter() >= ctx.t_deadline
+        self.breaker.on_failure(pending.probe)
+        pending.probe = False  # the probe verdict is spent; a retry is ordinary traffic
+        if pending.retries_left <= 0 or self.breaker.state == BREAKER_OPEN or (
+            pending.t_deadline is not None and time.perf_counter() >= pending.t_deadline
         ):
-            self._release(ctx.cls)
-            self._resolve(outer, exc=exc)
+            self._release(pending.cls)
+            self._resolve(pending, outer, exc=exc)
             return
-        ctx.retries_left -= 1
-        ctx.attempt += 1
-        delay = self._backoff_s * (2 ** (ctx.attempt - 1))
+        pending.retries_left -= 1
+        pending.attempt += 1
+        delay = self._backoff_s * (2 ** (pending.attempt - 1))
         delay *= 1.0 + self._jitter * self._rng.uniform(-1.0, 1.0)
         self._reg.counter("serve.retries").inc()
-        self._reg.counter(f"serve.retries.{ctx.cls}").inc()
-        timer = threading.Timer(max(delay, 0.0), self._retry, args=(ctx, outer, exc))
+        self._reg.counter(f"serve.retries.{pending.cls}").inc()
+        pending.ctx.phase = "retrying"  # re-enters "queued" on the retry submit
+        timer = threading.Timer(max(delay, 0.0), self._retry, args=(pending, outer, exc))
         timer.daemon = True
         timer.start()
 
-    def _retry(self, ctx: _Pending, outer: Future, prev_exc: Exception) -> None:
-        if ctx.t_deadline is not None and time.perf_counter() >= ctx.t_deadline:
-            self._release(ctx.cls)
-            self._resolve(outer, exc=DeadlineExceeded("deadline passed during retry backoff"))
+    def _retry(self, pending: _Pending, outer: Future, prev_exc: Exception) -> None:
+        if pending.t_deadline is not None and time.perf_counter() >= pending.t_deadline:
+            self._release(pending.cls)
+            self._resolve(pending, outer, exc=DeadlineExceeded("deadline passed during retry backoff"))
             return
         if self.breaker.state == BREAKER_OPEN:
-            self._release(ctx.cls)
-            self._resolve(outer, exc=prev_exc)
+            self._release(pending.cls)
+            self._resolve(pending, outer, exc=prev_exc)
             return
         remaining_ms = (
-            None if ctx.t_deadline is None
-            else max((ctx.t_deadline - time.perf_counter()) * 1e3, 0.0)
+            None if pending.t_deadline is None
+            else max((pending.t_deadline - time.perf_counter()) * 1e3, 0.0)
         )
         try:
-            inner = self._batcher.submit(ctx.image, deadline_ms=remaining_ms, priority=ctx.cls)
+            inner = self._batcher.submit(
+                pending.image, deadline_ms=remaining_ms, priority=pending.cls, ctx=pending.ctx
+            )
         except Exception as e:  # noqa: BLE001 — stopped batcher / QueueFull: final answer
-            self._release(ctx.cls)
-            self._resolve(outer, exc=e)
+            self._release(pending.cls)
+            self._resolve(pending, outer, exc=e)
             return
-        inner.add_done_callback(lambda fut: self._on_done(ctx, outer, fut))
+        inner.add_done_callback(lambda fut: self._on_done(pending, outer, fut))
 
     # -- introspection (healthz / hang reports) ------------------------------
+
+    def oldest_inflight(self) -> dict | None:
+        """The oldest in-system request's {id, class, deadline_ms, age_s,
+        phase} — the "whose request is wedged" line in hang reports and
+        /varz. None when the system is idle."""
+        with self._lock:
+            if not self._inflight_ctx:
+                return None
+            oldest = min(self._inflight_ctx.values(), key=lambda c: c.t_arrival)
+        return oldest.as_dict()
 
     def state(self) -> dict:
         """JSON-safe snapshot: breaker, per-class occupancy/quota, predictor."""
@@ -387,7 +439,9 @@ class AdmissionController:
             "breaker": self.breaker.state_name,
             "breaker_state": self.breaker.state,
             "ewma_latency_s": ewma,
+            "predictor": self._predictor,
             "predicted_wait_s": self.predicted_wait_s(),
+            "oldest_request": self.oldest_inflight(),
             "queued_total": sum(in_queue.values()),
             "classes": {
                 cls: {
@@ -413,6 +467,8 @@ class AdmissionController:
             breaker_cooldown_s=ac.breaker_cooldown_s,
             ewma_alpha=ac.ewma_alpha,
             reject_unmeetable=ac.reject_unmeetable,
+            predictor=ac.predictor,
+            predictor_quantile=ac.predictor_quantile,
             seed=seed,
             heartbeat=heartbeat,
         )

@@ -68,6 +68,7 @@ from typing import Callable
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
 
@@ -91,14 +92,22 @@ class DrainTimeout(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_enqueue", "t_deadline", "priority")
+    __slots__ = ("image", "future", "t_enqueue", "t_deadline", "priority", "ctx")
 
-    def __init__(self, image: np.ndarray, deadline_s: float | None, priority: str | None = None):
+    def __init__(self, image: np.ndarray, deadline_s: float | None, priority: str | None = None,
+                 ctx=None):
         self.image = image
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
         self.t_deadline = None if deadline_s is None else self.t_enqueue + deadline_s
         self.priority = priority
+        # RequestContext (serve/context.py) when the caller threads identity
+        # through; phase advances ride the request across the thread hops
+        self.ctx = ctx
+
+    def _advance(self, phase: str) -> None:
+        if self.ctx is not None:
+            self.ctx.advance(phase)
 
 
 def _group_by_shape(reqs: list["_Request"]) -> list[list["_Request"]]:
@@ -215,6 +224,7 @@ class MicroBatcher:
     def _finish_ok(self, req: _Request, row) -> bool:
         with self._live_lock:
             self._live.discard(req)
+        req._advance("completed")  # no-op when the engine already marked it
         try:
             req.future.set_result(row)
             return True
@@ -224,6 +234,7 @@ class MicroBatcher:
     def _finish_err(self, req: _Request, exc: Exception) -> bool:
         with self._live_lock:
             self._live.discard(req)
+        req._advance("failed")  # no-op when already shed/completed
         try:
             req.future.set_exception(exc)
             return True
@@ -238,16 +249,19 @@ class MicroBatcher:
         *,
         deadline_ms: float | None = None,
         priority: str | None = None,
+        ctx=None,
     ) -> Future:
         """Enqueue one (H, W, 3) image; returns a Future resolving to its
         logits row. Raises :class:`QueueFull` when the bounded queue is at
         capacity (the caller's backpressure signal). ``priority`` tags the
         request with its QoS class (serve/admission.py) for per-class shed
-        attribution; the batcher itself stays FIFO."""
+        attribution; the batcher itself stays FIFO. ``ctx`` is the optional
+        :class:`~.context.RequestContext` correlating this request's trace
+        events across the thread hops."""
         if self._thread is None:
             raise RuntimeError("batcher not started")
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
-        req = _Request(np.asarray(image, np.float32), deadline_s, priority)
+        req = _Request(np.asarray(image, np.float32), deadline_s, priority, ctx)
         with self._live_lock:
             self._live.add(req)
         try:
@@ -258,6 +272,7 @@ class MicroBatcher:
             self._reg.counter("serve.rejected_full").inc()
             raise QueueFull(f"request queue at capacity ({self._q.maxsize})") from None
         self._reg.counter("serve.requests").inc()  # accepted only, after the enqueue
+        req._advance("queued")  # flow start + queued async edge, submit thread
         return req.future
 
     # -- dispatch thread ----------------------------------------------------
@@ -304,6 +319,7 @@ class MicroBatcher:
         self._reg.counter("serve.shed_deadline").inc()
         if req.priority:
             self._reg.counter(f"serve.shed_deadline.{req.priority}").inc()
+        req._advance("shed")
         self._finish_err(req, exc)
 
     def _thread_crash(self, exc: Exception) -> None:
@@ -316,6 +332,7 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         try:
+            obs_trace.get_tracer().register_thread()  # "serve-batcher" Perfetto row
             self._loop_inner()
         except Exception as e:  # noqa: BLE001 — terminal: contain, don't hang clients
             self._thread_crash(e)
@@ -336,6 +353,8 @@ class MicroBatcher:
         live = self._shed_expired(batch)
         for group in _group_by_shape(live):
             self._reg.histogram("serve.batch_size").observe(len(group))
+            for req in group:  # queued -> in-flight edge, dispatch thread
+                req._advance("dispatched")
             try:
                 logits = self._predict(np.stack([r.image for r in group]))
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients

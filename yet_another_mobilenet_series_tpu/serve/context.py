@@ -1,0 +1,119 @@
+"""Per-request identity threaded through the serving stack.
+
+A request crosses four threads on its way to logits — the HTTP handler
+(serve/frontend.py), the admission edge (serve/admission.py), the batcher's
+collect/dispatch thread (serve/batcher.py, serve/pipeline.py), and the
+completion thread that syncs the engine's handle (serve/engine.py
+``PendingPrediction.result``). Before this module those hops were
+anonymous: spans were flat per-thread events and a hang report could say
+"the window is occupied" but not WHOSE request occupied it.
+
+:class:`RequestContext` is the identity that survives the hops: a
+process-monotonic request id (echoed to HTTP clients as ``X-Request-Id``),
+the QoS class, the deadline, the arrival time, and the current ``phase``.
+:meth:`advance` is the ONE place phase transitions emit trace events, so
+the producers just call ``ctx.advance("dispatched")`` at the right moment
+and the Chrome-trace async waterfall (``serve/request`` envelope with
+``serve/queued`` and ``serve/inflight`` sub-phases, ``ph: b``/``e``) plus
+the cross-thread flow arrows (``serve/req``, ``ph: s``/``t``/``f``) stay
+consistent by construction — all keyed ``id = rid``, so Perfetto renders
+one correlated row per request across every thread.
+
+Phases (terminal states never regress — a late duplicate ``advance`` is a
+no-op, so the idempotent future-resolution paths in the batcher stay safe):
+
+``arrived`` -> ``queued`` -> ``dispatched`` -> ``completed`` | ``shed`` |
+``failed``, then ``resolved`` once the admission future is delivered.
+
+The context is cheap enough to mint per request unconditionally (a counter
+increment and a clock read); the trace emission inside ``advance`` is
+no-op'd by a disabled tracer exactly like spans.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+
+from ..obs import trace as obs_trace
+
+_IDS = itertools.count(1)
+
+TERMINAL_PHASES = ("completed", "shed", "failed", "resolved")
+
+
+class RequestContext:
+    """Identity + QoS + phase for one in-system serving request."""
+
+    __slots__ = ("rid", "cls", "deadline_ms", "client_tag", "t_arrival", "phase")
+
+    def __init__(self, rid: int, cls: str, deadline_ms: float | None, client_tag: str | None = None):
+        self.rid = rid
+        self.cls = cls
+        self.deadline_ms = deadline_ms
+        # a client-supplied X-Request-Id is echoed back verbatim; the
+        # internal rid stays monotonic (trace ids must be process-unique)
+        self.client_tag = client_tag
+        self.t_arrival = time.perf_counter()
+        self.phase = "arrived"
+
+    @classmethod
+    def mint(cls, qos_class: str, deadline_ms: float | None = None,
+             client_tag: str | None = None) -> "RequestContext":
+        return cls(next(_IDS), qos_class, deadline_ms, client_tag)
+
+    @property
+    def wire_id(self) -> str:
+        """The value echoed as ``X-Request-Id``."""
+        return self.client_tag or str(self.rid)
+
+    def age_s(self) -> float:
+        return time.perf_counter() - self.t_arrival
+
+    def as_dict(self) -> dict:
+        """JSON-safe view for hang reports / varz (watchdog serving info)."""
+        return {
+            "id": self.rid,
+            "class": self.cls,
+            "deadline_ms": self.deadline_ms,
+            "age_s": self.age_s(),
+            "phase": self.phase,
+        }
+
+    # -- the one trace-emission point ---------------------------------------
+
+    def advance(self, phase: str) -> None:
+        """Move to ``phase``, emitting the async/flow trace edges for the
+        transition. Duplicate and post-terminal advances are no-ops, so
+        every resolution path may call this defensively."""
+        prev = self.phase
+        if phase == prev or prev in TERMINAL_PHASES:
+            return
+        self.phase = phase
+        tr = obs_trace.get_tracer()
+        if not tr.enabled:
+            return
+        if phase == "queued":
+            tr.async_begin("serve/queued", self.rid)
+            tr.flow_start("serve/req", self.rid, cls=self.cls)
+        elif phase == "dispatched":
+            tr.async_end("serve/queued", self.rid)
+            tr.async_begin("serve/inflight", self.rid)
+            tr.flow_step("serve/req", self.rid)
+        elif phase in ("completed", "shed", "failed"):
+            # close whichever sub-phase the request died in (a reject can
+            # fail straight out of "queued"; a shed can happen either side)
+            tr.async_end("serve/inflight" if prev == "dispatched" else "serve/queued", self.rid)
+            tr.flow_end("serve/req", self.rid, outcome=phase)
+
+    def open_envelope(self) -> None:
+        """Async envelope begin (admission, once per admitted request)."""
+        obs_trace.get_tracer().async_begin(
+            "serve/request", self.rid, cls=self.cls,
+            deadline_ms=self.deadline_ms if self.deadline_ms is not None else 0.0,
+        )
+
+    def close_envelope(self) -> None:
+        """Async envelope end (admission, at final future resolution)."""
+        obs_trace.get_tracer().async_end("serve/request", self.rid, outcome=self.phase)
+        self.phase = "resolved"

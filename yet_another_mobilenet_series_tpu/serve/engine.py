@@ -121,14 +121,16 @@ class PendingPrediction:
     free to still be computing — that's the point.
     """
 
-    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out", "_lock")
+    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out", "_lock", "_ctxs")
 
-    def __init__(self, engine: "InferenceEngine", parts, t_start: float, t_dispatched: float):
+    def __init__(self, engine: "InferenceEngine", parts, t_start: float, t_dispatched: float,
+                 ctxs=()):
         self._engine = engine
         self._parts = parts  # [(device_logits, real_rows), ...]
         self._t_start = t_start
         self._t_dispatched = t_dispatched
         self._out: np.ndarray | None = None
+        self._ctxs = tuple(ctxs)  # RequestContexts riding this handle (may be empty)
         # once-latch: two threads racing result() must not double-sync the
         # histograms or read _parts after the winner cleared it
         self._lock = threading.Lock()
@@ -145,6 +147,10 @@ class PendingPrediction:
                         # fused pieces come back (K, bucket, classes); flatten
                         # the chunk axis before slicing off the pad rows
                         outs.append(arr.reshape(-1, arr.shape[-1])[:rows])
+                    # completed edge emitted INSIDE the complete span so the
+                    # flow arrow binds to this slice on the sync thread
+                    for c in self._ctxs:
+                        c.advance("completed")
                 now = time.perf_counter()
                 reg.histogram("serve.dispatch_to_complete_seconds").observe(now - self._t_dispatched)
                 reg.histogram("serve.run_seconds").observe(now - self._t_start)
@@ -369,11 +375,13 @@ class InferenceEngine:
         self._reg.counter("serve.padded_rows").inc(total - n)
         return buf
 
-    def _dispatch_piece(self, images: np.ndarray, piece: tuple[int, int, int, int], size: int):
+    def _dispatch_piece(self, images: np.ndarray, piece: tuple[int, int, int, int], size: int,
+                        ctxs=()):
         """Stage + dispatch ONE piece (a chunk, or K fused chunks); returns
         (device_logits, real_rows) without syncing. The device array handed
         to the executable is donated; it is never read afterwards (YAMT008
-        discipline)."""
+        discipline). ``ctxs`` are the piece's request contexts: their ids
+        land on the dispatch span and their flow steps bind inside it."""
         start, rows, bucket, k = piece
         key = (bucket, size, k)
         exe = self._ensure_compiled(key)  # pre-warmed by predict_async; a hit
@@ -392,8 +400,14 @@ class InferenceEngine:
                 # reusable the moment dispatch returns (parity tests pin it)
                 x = jnp.asarray(staged)
         span = "serve/dispatch" if k == 1 else "serve/dispatch_fused"
-        with tracer.span(span, "serve", bucket=bucket, image_size=size, rows=rows, k=k):
+        span_args = dict(bucket=bucket, image_size=size, rows=rows, k=k)
+        if ctxs:
+            span_args["rids"] = [c.rid for c in ctxs[:16]]  # keep args tiny
+        with tracer.span(span, "serve", **span_args):
             logits = exe(self._params, x)
+            for c in ctxs:  # in-span: the flow arrow binds to this slice
+                c.advance("dispatched")
+                tracer.flow_step("serve/req", c.rid)
         self._reg.histogram("serve.dispatch_seconds").observe(time.perf_counter() - t0)
         if k > 1:
             self._reg.counter("serve.fused_dispatches").inc()
@@ -401,19 +415,25 @@ class InferenceEngine:
         self._reg.counter(f"serve.bucket_hits.{bucket}").inc(k)
         return logits, rows
 
-    def predict_async(self, images: np.ndarray) -> PendingPrediction:
+    def predict_async(self, images: np.ndarray, ctxs=None) -> PendingPrediction:
         """Dispatch without syncing: (N, S, S, 3) float32 -> handle whose
         ``result()`` yields (N, num_classes) float32 logits. An oversized
         request becomes ONE fused dispatch per ladder piece (a whole
         on-ladder request is a single dispatch + single transfer); every
         piece is dispatched before the caller can sync, so the device
-        pipeline never drains between pieces."""
+        pipeline never drains between pieces.
+
+        ``ctxs`` (optional) are the batch rows' RequestContexts
+        (serve/context.py): their ids ride the dispatch spans and their
+        phase/flow trace edges fire inside the engine's spans, so one
+        request correlates from HTTP handler to completion thread."""
         images = np.asarray(images, np.float32)
         if images.ndim != 4 or images.shape[1] != images.shape[2]:
             raise ValueError(f"predict expects (N, S, S, 3), got shape {images.shape}")
         n = images.shape[0]
         if n == 0:
             raise ValueError("empty batch")
+        ctxs = tuple(ctxs or ())
         size = int(images.shape[1])
         self._reg.counter("serve.infer_images").inc(n)
         t_start = time.perf_counter()
@@ -422,13 +442,23 @@ class InferenceEngine:
         # must not stall concurrent warm-size dispatches
         for key in {(bucket, size, k) for _, _, bucket, k in pieces}:
             self._ensure_compiled(key)
+        # row i <-> ctxs[i] only when the caller submitted one ctx per row
+        # (the batcher's coalesced single-image requests); otherwise the
+        # whole batch belongs to every ctx (a multi-row client request)
+        per_row = len(ctxs) == n
         with self._dispatch_lock:
-            parts = [self._dispatch_piece(images, piece, size) for piece in pieces]
-        return PendingPrediction(self, parts, t_start, time.perf_counter())
+            parts = [
+                self._dispatch_piece(
+                    images, piece, size,
+                    ctxs=ctxs[piece[0] : piece[0] + piece[1]] if per_row else ctxs,
+                )
+                for piece in pieces
+            ]
+        return PendingPrediction(self, parts, t_start, time.perf_counter(), ctxs=ctxs)
 
-    def predict(self, images: np.ndarray) -> np.ndarray:
+    def predict(self, images: np.ndarray, ctxs=None) -> np.ndarray:
         """(N, S, S, 3) float32 (already normalized, pipeline semantics) ->
         (N, num_classes) float32 logits. N is unconstrained: > max bucket is
         served fused (one dispatch per ladder piece), all dispatched before
         the single sync."""
-        return self.predict_async(images).result()
+        return self.predict_async(images, ctxs=ctxs).result()

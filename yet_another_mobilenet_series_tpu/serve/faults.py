@@ -43,6 +43,7 @@ the wrapper is drop-in anywhere an engine goes.
 
 from __future__ import annotations
 
+import inspect
 import random
 import threading
 import time
@@ -112,6 +113,13 @@ class FaultyEngine:
         self._idx = 0
         self._lock = threading.Lock()
         self._reg = get_registry()
+        # forward request contexts (serve/context.py) only when the wrapped
+        # engine speaks the extension — test doubles with predict_async(images)
+        # stay drop-in
+        try:
+            self._takes_ctxs = "ctxs" in inspect.signature(engine.predict_async).parameters
+        except (TypeError, ValueError):
+            self._takes_ctxs = False
 
     def _decide(self, n_rows: int) -> tuple[int, bool, float, bool]:
         """(dispatch index, fail?, delay_s, hang?) — one locked draw pair per
@@ -133,7 +141,7 @@ class FaultyEngine:
             hang = self._hang_at is not None and idx == self._hang_at
         return idx, fail, delay, hang
 
-    def predict_async(self, images):
+    def predict_async(self, images, ctxs=None):
         idx, fail, delay, hang = self._decide(int(images.shape[0]))
         if hang:
             self._reg.counter("serve.faults.hangs").inc()
@@ -145,11 +153,14 @@ class FaultyEngine:
             return _FaultyHandle(self, images, None, delay, idx + 1, hang=False)
         if delay > 0:
             self._reg.counter("serve.faults.delays").inc()
-        inner = self._engine.predict_async(images)
+        if self._takes_ctxs:
+            inner = self._engine.predict_async(images, ctxs=ctxs)
+        else:
+            inner = self._engine.predict_async(images)
         return _FaultyHandle(self, images, inner, delay, 0, hang=False)
 
-    def predict(self, images):
-        return self.predict_async(images).result()
+    def predict(self, images, ctxs=None):
+        return self.predict_async(images, ctxs=ctxs).result()
 
     def __getattr__(self, name):
         # everything not fault-related (buckets, warmup, image_sizes, ...)

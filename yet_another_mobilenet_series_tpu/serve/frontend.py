@@ -46,10 +46,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
 from .admission import BreakerOpen, DeadlineUnmeetable, BREAKER_OPEN
 from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
+from .context import RequestContext
 
 # exception type -> (HTTP status, wire error tag); anything else is a 500
 _ERROR_MAP = [
@@ -97,12 +99,19 @@ class _Handler(BaseHTTPRequestHandler):
         get_registry().counter("serve.http_errors").inc()
         self._send_json(status, {"error": tag, "message": message}, headers)
 
-    # -- GET /healthz -------------------------------------------------------
+    # -- GET /healthz, /metrics, /varz --------------------------------------
 
     def do_GET(self):  # noqa: N802 — stdlib method name
-        if self.path != "/healthz":
+        if self.path == "/healthz":
+            self._get_healthz()
+        elif self.path == "/metrics":
+            self._get_metrics()
+        elif self.path == "/varz":
+            self._get_varz()
+        else:
             self._send_error_json(404, "not_found", f"no route {self.path}")
-            return
+
+    def _get_healthz(self) -> None:
         fe = self.frontend
         state = fe.admission.state()
         state["inflight"] = int(get_registry().gauge("serve.inflight").value)
@@ -110,6 +119,30 @@ class _Handler(BaseHTTPRequestHandler):
         status = 503 if state["breaker_state"] == BREAKER_OPEN else 200
         state["ok"] = status == 200 and not fe._draining
         self._send_json(status, state)
+
+    def _get_metrics(self) -> None:
+        """Prometheus text exposition of the whole obs registry — the scrape
+        surface a multi-replica deployment's collector reads. Histograms emit
+        cumulative bucket + quantile lines (obs/registry.py), so
+        ``serve_latency_seconds{class="interactive",quantile="0.99"}`` is
+        p99 straight off the replica."""
+        body = get_registry().render_prometheus().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _get_varz(self) -> None:
+        """JSON twin of /metrics for humans and tests: the full registry
+        snapshot (histograms expanded with min/max/p50/p95/p99) plus the
+        admission state and the oldest in-flight request."""
+        fe = self.frontend
+        self._send_json(200, {
+            "metrics": get_registry().snapshot(),
+            "admission": fe.admission.state(),
+            "draining": fe._draining,
+        })
 
     # -- POST /predict ------------------------------------------------------
 
@@ -152,14 +185,29 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._send_error_json(400, "bad_request", str(e))
             return
+        # request identity: a process-monotonic id minted HERE, echoed on
+        # every response as X-Request-Id (a client-supplied value is echoed
+        # back verbatim as the wire id; the internal id stays monotonic —
+        # trace correlation needs process-unique ids) and threaded through
+        # admission -> batcher -> engine as the trace correlation key
+        ctx = RequestContext.mint(
+            priority or fe.admission._default_class, deadline_ms,
+            client_tag=self.headers.get("X-Request-Id") or None,
+        )
+        rid_hdr = {"X-Request-Id": ctx.wire_id}
         try:
-            fut = fe.admission.submit(image, priority=priority, deadline_ms=deadline_ms)
+            with obs_trace.get_tracer().span("serve/submit", "serve", rid=ctx.rid):
+                fut = fe.admission.submit(
+                    image, priority=priority, deadline_ms=deadline_ms, ctx=ctx
+                )
         except ValueError as e:  # unknown priority class
-            self._send_error_json(400, "bad_request", str(e))
+            self._send_error_json(400, "bad_request", str(e), rid_hdr)
             return
         except Exception as e:  # noqa: BLE001 — typed arrival rejections
             status, tag = _classify(e)
-            headers = {"Retry-After": f"{fe.retry_after_s:.0f}"} if status == 503 else None
+            headers = dict(rid_hdr)
+            if status == 503:
+                headers["Retry-After"] = f"{fe.retry_after_s:.0f}"
             self._send_error_json(status, tag, str(e), headers)
             return
         # the handler thread is this request's only waiter: a deadline
@@ -169,16 +217,18 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             logits = fut.result(timeout=timeout_s)
         except (TimeoutError, FutureTimeout):
-            self._send_error_json(504, "timeout", f"no result within {timeout_s:.1f}s")
+            self._send_error_json(504, "timeout", f"no result within {timeout_s:.1f}s", rid_hdr)
             return
         except Exception as e:  # noqa: BLE001 — typed shed/failure outcomes
             status, tag = _classify(e)
-            self._send_error_json(status, tag, str(e))
+            self._send_error_json(status, tag, str(e), rid_hdr)
             return
         self._send_json(
             200,
             {"logits": np.asarray(logits, np.float64).tolist(),
-             "priority": priority or fe.admission._default_class},
+             "priority": priority or fe.admission._default_class,
+             "request_id": ctx.wire_id},
+            rid_hdr,
         )
 
 
@@ -226,6 +276,7 @@ class Frontend:
 
     def _serve(self) -> None:
         try:
+            obs_trace.get_tracer().register_thread()  # "serve-http" Perfetto row
             self._server.serve_forever(poll_interval=0.1)
         except Exception as e:  # noqa: BLE001 — YAMT011: never die silently
             get_registry().counter("serve.thread_crashes").inc()

@@ -69,12 +69,14 @@ push/pop) plus everything the engine and shared batcher record —
 
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 import time
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from .batcher import _STOP, DeadlineExceeded, MicroBatcher, _Request, _group_by_shape
 
 # in-flight window sentinel: collect thread -> completion thread shutdown
@@ -113,6 +115,14 @@ class PipelinedBatcher(MicroBatcher):
         )
         self._engine = engine
         self._max_inflight = max_inflight
+        # thread request identity into the engine when it speaks the ctxs
+        # extension (InferenceEngine/FaultyEngine do; bare test doubles with
+        # predict_async(images) keep working — the batcher's own phase
+        # advances cover them)
+        try:
+            self._engine_takes_ctxs = "ctxs" in inspect.signature(engine.predict_async).parameters
+        except (TypeError, ValueError):
+            self._engine_takes_ctxs = False
         # dispatched-but-unsynced budget, acquired BEFORE each dispatch so
         # at most max_inflight executions are ever enqueued device-side
         self._window = threading.BoundedSemaphore(max_inflight)
@@ -166,6 +176,7 @@ class PipelinedBatcher(MicroBatcher):
 
     def _collect_loop(self) -> None:
         try:
+            obs_trace.get_tracer().register_thread()  # "serve-collect" Perfetto row
             self._collect_loop_inner()
         except Exception as e:  # noqa: BLE001 — terminal: contain, don't hang clients
             self._thread_crash(e)
@@ -219,8 +230,16 @@ class PipelinedBatcher(MicroBatcher):
             if i:
                 self._window.acquire()
             self._reg.histogram("serve.batch_size").observe(len(group))
+            for req in group:  # queued -> in-flight edge, collect thread
+                req._advance("dispatched")
             try:
-                handle = self._engine.predict_async(np.stack([r.image for r in group]))
+                stacked = np.stack([r.image for r in group])
+                if self._engine_takes_ctxs:
+                    handle = self._engine.predict_async(
+                        stacked, ctxs=[r.ctx for r in group if r.ctx is not None]
+                    )
+                else:
+                    handle = self._engine.predict_async(stacked)
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
                 self._window.release()
                 for req in group:
@@ -233,6 +252,7 @@ class PipelinedBatcher(MicroBatcher):
 
     def _complete_loop(self) -> None:
         try:
+            obs_trace.get_tracer().register_thread()  # "serve-complete" Perfetto row
             self._complete_loop_inner()
         except Exception as e:  # noqa: BLE001 — terminal: contain, don't hang clients
             self._thread_crash(e)
