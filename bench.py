@@ -89,6 +89,47 @@ REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 TUNING_PATH = os.environ.get("BENCH_TUNING_PATH") or os.path.join(REPO_DIR, "BENCH_TUNING.json")
 
 
+def provenance(cpu_rehearsal: bool | None = None) -> dict:
+    """Shared bench-artifact provenance stamp: jax/jaxlib versions, python,
+    platform/device kind, and the cpu-rehearsal flag — every bench/table
+    artifact (serve_bench, train_chaos, latency_table, the headline worker)
+    carries this block so a number can always be attributed to the software
+    and hardware that produced it.
+
+    Version lookup goes through importlib.metadata, NOT ``import jax`` — the
+    bench supervisors (and train_chaos's parent) must never touch a backend.
+    Platform/device fields are filled only when the calling process already
+    imported jax; ``cpu_rehearsal`` defaults to "the backend is cpu" and can
+    be forced by callers that know (train_chaos pins True)."""
+    from importlib import metadata
+
+    info: dict = {"python": ".".join(str(v) for v in sys.version_info[:3])}
+    for pkg in ("jax", "jaxlib"):
+        try:
+            info[f"{pkg}_version"] = metadata.version(pkg)
+        except metadata.PackageNotFoundError:
+            info[f"{pkg}_version"] = None
+    j = sys.modules.get("jax")
+    if j is not None:
+        try:
+            devs = j.devices()
+            info["platform"] = j.default_backend()
+            info["device_kind"] = devs[0].device_kind
+            info["n_devices"] = len(devs)
+        except Exception as e:  # noqa: BLE001 — a dead backend must not kill the stamp
+            info["platform_error"] = f"{type(e).__name__}: {e}"
+    if cpu_rehearsal is None:
+        cpu_rehearsal = info.get("platform") == "cpu"
+    info["cpu_rehearsal"] = bool(cpu_rehearsal)
+    return info
+
+
+def stamp_provenance(artifact: dict, cpu_rehearsal: bool | None = None) -> dict:
+    """Attach the provenance block in place (and return the artifact)."""
+    artifact["provenance"] = provenance(cpu_rehearsal)
+    return artifact
+
+
 def partition_flags(flags_str: str) -> tuple[str, str]:
     """Split a flag string into (XLA_FLAGS part, LIBTPU_INIT_ARGS part).
 
@@ -429,6 +470,7 @@ def _worker_body(force_cpu: bool):
             "libtpu_init_args_env": os.environ.get("LIBTPU_INIT_ARGS", ""),
         },
         "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "provenance": provenance(),
     }))
 
 

@@ -203,6 +203,41 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
             hits = {k.rsplit(".", 1)[-1]: v for k, v in snap.items() if k.startswith("serve.bucket_hits.")}
             if hits:
                 lines.append("  bucket hits: " + ", ".join(f"{b}: {v:.0f}" for b, v in sorted(hits.items(), key=lambda kv: int(kv[0]))))
+        if snap.get("obs.compiles"):
+            # device telemetry (obs/device.py, docs/OBSERVABILITY.md "Device
+            # telemetry"): compile events, per-executable cost accounting,
+            # dispatch efficiency, memory gauges
+            lines.append("\n## device (compile / cost / memory)")
+            lines.append(
+                f"  compiles = {snap['obs.compiles']:.0f}, compile time "
+                f"p50 {snap.get('obs.compile_seconds.p50', 0):.2f}s / "
+                f"max {snap.get('obs.compile_seconds.max', 0):.2f}s "
+                f"(sum {snap.get('obs.compile_seconds.sum', 0):.1f}s)"
+            )
+            for k in sorted(snap):
+                if k.startswith("obs.cost_flops."):
+                    key = k[len("obs.cost_flops."):]
+                    lines.append(
+                        f"  [{key}] {snap[k] / 1e9:.3f} GFLOP, "
+                        f"{snap.get(f'obs.cost_bytes.{key}', 0) / 1e6:.1f} MB accessed"
+                    )
+            if snap.get("serve.achieved_flops_per_s"):
+                lines.append(
+                    f"  dispatch efficiency: {snap['serve.achieved_flops_per_s'] / 1e9:.2f} "
+                    f"achieved GFLOP/s (cost FLOPs / measured serve.run_seconds)"
+                )
+            mem = []
+            if snap.get("host.rss_bytes"):
+                mem.append(f"host rss {snap['host.rss_bytes'] / 1e6:.0f} MB")
+            if "device.live_buffer_bytes" in snap:
+                mem.append(f"live device buffers {snap['device.live_buffer_bytes'] / 1e6:.1f} MB")
+            for k in sorted(snap):
+                if k.startswith("device.bytes_in_use."):
+                    d = k.rsplit(".", 1)[-1]
+                    peak = snap.get(f"device.peak_bytes_in_use.{d}", 0)
+                    mem.append(f"{d} in-use {snap[k] / 1e6:.0f} MB (peak {peak / 1e6:.0f})")
+            if mem:
+                lines.append("  memory: " + ", ".join(mem))
     else:
         lines.append("\n## registry snapshot: missing (run predates obs/ or crashed before flush)")
 

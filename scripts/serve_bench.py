@@ -542,11 +542,16 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
         for k in snap
         if k.startswith("serve.") and k.endswith(".count") and snap[k] > 0
     }
+    from bench import provenance
+
     dev = jax.devices()[0]
     out = {
         "platform": dev.platform,
         "device_kind": dev.device_kind,
         "n_chips": len(jax.devices()),
+        # shared bench provenance stamp (bench.py): jax/jaxlib versions +
+        # cpu-rehearsal flag, so every serving artifact is attributable
+        "provenance": provenance(),
         "warmup_compile_s": round(warmup_s, 2),
         "buckets": direct_rows,
         "concurrent": concurrent_rows,

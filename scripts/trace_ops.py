@@ -3,8 +3,17 @@
 The sandbox's tensorboard_plugin_profile can't convert xplane dumps (protobuf
 generation mismatch), so this reads the XSpace proto directly and prints the
 op-level breakdown the Pallas/optimization decisions need (VERDICT r1 #4).
+Works on the train CLI's step-indexed window AND on the serving frontend's
+HTTP-triggered capture (``POST /profile/start|stop`` — docs/SERVING.md).
+
+``--check-table LATENCY_TABLE.json`` cross-checks a measured-latency table
+(scripts/latency_table.py) against the trace: the table's predicted
+per-image block total next to the trace's aggregated op time, so a table
+whose provenance doesn't match the traced hardware shows up as a gross
+ratio mismatch instead of silently mis-weighting the NAS penalty.
 
 Usage: python scripts/trace_ops.py /path/to/trace_dir [top_n]
+           [--check-table LATENCY_TABLE_r01_cpu_rehearsal.json]
 (finds the newest */vm.xplane.pb under the dir)
 """
 
@@ -12,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import glob
+import json
 import os
 import re
 import sys
@@ -24,113 +34,182 @@ def op_kind(name: str) -> str:
     return re.split(r"[.\d]", name, maxsplit=1)[0].lstrip("%")
 
 
+def load_xspace(root: str):
+    """Newest ``*.xplane.pb`` under ``root`` as a parsed XSpace proto;
+    returns (xspace, path). Raises FileNotFoundError when none exists."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    files = sorted(glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True), key=os.path.getmtime)
+    if not files:
+        raise FileNotFoundError(f"no .xplane.pb under {root}")
+    xs = xplane_pb2.XSpace()
+    with open(files[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs, files[-1]
+
+
+def aggregate_device(plane) -> dict | None:
+    """Synchronous-op aggregation of one ``/device:TPU*`` plane: total ps,
+    per-op and per-kind sums, async DMA windows (overlapping — tracked
+    separately, NOT occupancy), and XLA-module execution stats."""
+    events_meta = plane.event_metadata
+    modules = []
+    for line in plane.lines:
+        if "XLA Modules" in line.name:
+            durs = sorted(ev.duration_ps / 1e9 for ev in line.events)
+            if durs:
+                modules.append({"line": line.name, "n": len(durs),
+                                "total_ms": sum(durs), "durs_ms": durs})
+    per_op: collections.Counter = collections.Counter()
+    per_cat: collections.Counter = collections.Counter()
+    async_cat: collections.Counter = collections.Counter()
+    total_ps = 0
+    n_events = 0
+    for line in plane.lines:
+        if "XLA Ops" not in line.name:
+            continue
+        for ev in line.events:
+            meta = events_meta.get(ev.metadata_id)
+            name = meta.name if meta else "?"
+            kind = op_kind(name)
+            dur = ev.duration_ps
+            n_events += 1
+            if kind.endswith("-start"):
+                # async DMA window, overlaps compute: not occupancy —
+                # summing these reported 85% 'copy' on a step that is
+                # actually reduce-bound
+                async_cat[kind] += dur
+                continue
+            total_ps += dur
+            per_op[name] += dur
+            per_cat[kind] += dur
+    if not per_op:
+        return None
+    return {"plane": plane.name, "n_events": n_events,
+            # all-zero-duration sync events would divide by zero downstream
+            "total_ps": max(total_ps, 1),
+            "per_op": per_op, "per_cat": per_cat, "async_cat": async_cat,
+            "modules": modules}
+
+
+def aggregate_host(xs) -> dict:
+    """XLA-CPU fallback: thunk events on the ``/host:CPU`` client threadpool
+    lines (thread-summed host time, not a device timeline — rehearsal sanity
+    and rough op ranking only, never TPU decisions). Client line names vary
+    by jaxlib vintage — ``XLAEigen``, ``PjRtCpuClient``, ``tf_XLATfrtCpuClient``
+    — so anything carrying ``CpuClient`` or ``XLAEigen`` counts; the old
+    exact-two-names match silently aggregated ZERO events on jaxlib 0.4.36."""
+    per_cat: collections.Counter = collections.Counter()
+    n_events = 0
+    for plane in xs.planes:
+        if plane.name != "/host:CPU":
+            continue
+        events_meta = plane.event_metadata
+        for line in plane.lines:
+            if "CpuClient" not in line.name and "XLAEigen" not in line.name:
+                continue
+            for ev in line.events:
+                meta = events_meta.get(ev.metadata_id)
+                name = meta.name if meta else "?"
+                if name.startswith(("end:", "ThunkExecutor", "ThreadpoolListener")):
+                    continue  # paired markers / executor bookkeeping
+                if ev.duration_ps <= 0:
+                    continue
+                per_cat[op_kind(name)] += ev.duration_ps
+                n_events += 1
+    return {"per_cat": per_cat, "n_events": n_events,
+            "total_ps": max(sum(per_cat.values()), 1)}
+
+
+def table_prediction(table_path: str) -> dict:
+    """Predicted per-image latency of a LATENCY_TABLE artifact at full width
+    (sum over entries), plus its provenance — the cross-check baseline."""
+    with open(table_path) as f:
+        doc = json.load(f)
+    entries = doc.get("entries", [])
+    total_s = 0.0
+    for e in entries:
+        ch = e["alive_channels"]
+        lat = e["latency_s"]
+        # full-width point: the ladder's largest alive-channel measurement
+        total_s += lat[max(range(len(ch)), key=lambda i: ch[i])]
+    return {"entries": len(entries), "blocks_total_ms": total_s * 1e3,
+            "provenance": doc.get("provenance", {})}
+
+
 def print_ranked(per_cat: collections.Counter, total_ps: int, top_n: int) -> None:
     for k, v in per_cat.most_common(top_n):
         print(f"  {k:<40} {v/total_ps*100:6.2f}%  {v/1e12*1000:8.3f} ms")
 
 
-def main():
-    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_trace"
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 40
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    table_path = ""
+    if "--check-table" in argv:
+        i = argv.index("--check-table")
+        table_path = argv[i + 1]
+        del argv[i : i + 2]
+    root = argv[0] if argv else "/tmp/tpu_trace"
+    top_n = int(argv[1]) if len(argv) > 1 else 40
 
-    files = sorted(glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True), key=os.path.getmtime)
-    if not files:
-        sys.exit(f"no .xplane.pb under {root}")
-    xs = xplane_pb2.XSpace()
-    with open(files[-1], "rb") as f:
-        xs.ParseFromString(f.read())
-
+    xs, path = load_xspace(root)
+    measured_ms = None
     printed_any = False
     for plane in xs.planes:
         if not plane.name.startswith("/device:TPU"):
             continue
-        printed_any = True
-        events_meta = plane.event_metadata
-
-        for line in plane.lines:
-            if "XLA Modules" in line.name:
-                durs = sorted(ev.duration_ps / 1e9 for ev in line.events)
-                if durs:
-                    import statistics
-
-                    print(
-                        f"-- {line.name}: {len(durs)} module executions, "
-                        f"median {statistics.median(durs):.2f} ms, total {sum(durs):.2f} ms"
-                    )
-
-        per_op = collections.Counter()
-        per_cat = collections.Counter()
-        async_cat = collections.Counter()
-        total_ps = 0
-        n_events = 0
-        for line in plane.lines:
-            if "XLA Ops" not in line.name:
-                continue
-            for ev in line.events:
-                meta = events_meta.get(ev.metadata_id)
-                name = meta.name if meta else "?"
-                kind = op_kind(name)
-                dur = ev.duration_ps
-                n_events += 1
-                if kind.endswith("-start"):
-                    # async DMA window, overlaps compute: not occupancy —
-                    # summing these reported 85% 'copy' on a step that is
-                    # actually reduce-bound
-                    async_cat[kind] += dur
-                    continue
-                total_ps += dur
-                per_op[name] += dur
-                per_cat[kind] += dur
-        if not per_op:
+        agg = aggregate_device(plane)
+        if agg is None:
             continue
-        # all-zero-duration sync events would divide by zero below
-        total_ps = max(total_ps, 1)
-        print(f"\n== {plane.name}: {n_events} op events, {total_ps/1e12*1000:.2f} ms synchronous device op time")
+        printed_any = True
+        import statistics
+
+        for m in agg["modules"]:
+            print(f"-- {m['line']}: {m['n']} module executions, "
+                  f"median {statistics.median(m['durs_ms']):.2f} ms, total {m['total_ms']:.2f} ms")
+        total_ps = agg["total_ps"]
+        measured_ms = total_ps / 1e12 * 1000
+        print(f"\n== {agg['plane']}: {agg['n_events']} op events, "
+              f"{measured_ms:.2f} ms synchronous device op time")
         print("\n-- by op kind (sync only) --")
-        print_ranked(per_cat, total_ps, 20)
+        print_ranked(agg["per_cat"], total_ps, 20)
         print("\n-- async DMA windows (overlapping; not occupancy) --")
-        for k, v in async_cat.most_common(5):
+        for k, v in agg["async_cat"].most_common(5):
             print(f"  {k:<40} {'':8}{v/1e12*1000:10.3f} ms")
         print(f"\n-- top {top_n} individual sync ops --")
-        for k, v in per_op.most_common(top_n):
+        for k, v in agg["per_op"].most_common(top_n):
             print(f"  {k[:98]:<100} {v/total_ps*100:6.2f}%  {v/1e12*1000:8.3f} ms")
     if not printed_any:
-        # CPU-backend traces (the watcher's --cpu-rehearsal) have no
-        # /device:TPU plane; XLA-CPU ops run inside Eigen threadpool host
-        # lines. Those thunk events DO carry durations, so aggregate them —
-        # clearly labeled: thread-summed host time, not a device timeline,
-        # and on another backend entirely (useful for rehearsal sanity and
-        # rough op ranking only, never for TPU decisions). The planes list
-        # stays in the output so a trace with NO recognizable plane (GPU
-        # backend, malformed dump) is still diagnosable, not a silent zero.
-        print(f"no /device:TPU plane in {os.path.basename(files[-1])} — "
+        # CPU-backend traces (the watcher's --cpu-rehearsal, the serving
+        # frontend's capture on this box) have no /device:TPU plane. The
+        # planes list stays in the output so a trace with NO recognizable
+        # plane (GPU backend, malformed dump) is still diagnosable, not a
+        # silent zero.
+        print(f"no /device:TPU plane in {os.path.basename(path)} — "
               f"falling back to HOST-thread XLA-CPU op times "
               f"(thread-summed, CPU backend; not comparable to TPU ranks); "
               f"planes present: {[p.name for p in xs.planes]}")
-        per_cat = collections.Counter()
-        n_events = 0
-        for plane in xs.planes:
-            if plane.name != "/host:CPU":
-                continue
-            events_meta = plane.event_metadata
-            for line in plane.lines:
-                if "XLAEigen" not in line.name and "PjRtCpuClient" not in line.name:
-                    continue
-                for ev in line.events:
-                    meta = events_meta.get(ev.metadata_id)
-                    name = meta.name if meta else "?"
-                    if name.startswith(("end:", "ThunkExecutor", "ThreadpoolListener")):
-                        continue  # paired markers / executor bookkeeping
-                    if ev.duration_ps <= 0:
-                        continue
-                    per_cat[op_kind(name)] += ev.duration_ps
-                    n_events += 1
-        total_ps = max(sum(per_cat.values()), 1)
-        print(f"\n== /host:CPU: {n_events} thunk events, "
-              f"{total_ps/1e12*1000:.2f} ms summed host op time")
-        print_ranked(per_cat, total_ps, top_n)
+        host = aggregate_host(xs)
+        measured_ms = host["total_ps"] / 1e12 * 1000
+        print(f"\n== /host:CPU: {host['n_events']} thunk events, "
+              f"{measured_ms:.2f} ms summed host op time")
+        print_ranked(host["per_cat"], host["total_ps"], top_n)
+
+    if table_path:
+        pred = table_prediction(table_path)
+        prov = pred["provenance"]
+        print(f"\n-- latency-table cross-check ({os.path.basename(table_path)}) --")
+        print(f"  table: {pred['entries']} entries, predicted "
+              f"{pred['blocks_total_ms']:.3f} ms/image over all blocks at full width "
+              f"(measured on {prov.get('device_kind', '?')}, "
+              f"cpu_rehearsal={prov.get('cpu_rehearsal', '?')})")
+        if measured_ms is not None:
+            print(f"  trace: {measured_ms:.3f} ms aggregated op time "
+                  f"(whole window — divide by traced image count before judging)")
+        print("  a gross ratio mismatch means the table's provenance does not "
+              "match the traced hardware — regenerate before searching on it")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

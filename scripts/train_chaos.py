@@ -169,6 +169,8 @@ def main(argv=None) -> int:
 
         log_dir = tempfile.mkdtemp(prefix="yamt_train_chaos_")
 
+    from bench import provenance
+
     artifact = {
         "metric": "train_chaos_recovered_steps",
         "value": None,
@@ -176,6 +178,10 @@ def main(argv=None) -> int:
         "vs_baseline": None,
         "platform": "cpu",
         "log_dir": log_dir,
+        # shared bench provenance stamp (bench.py). cpu_rehearsal is pinned:
+        # the children run under JAX_PLATFORMS=cpu and this parent process
+        # never imports jax, so the stamp cannot infer it
+        "provenance": provenance(cpu_rehearsal=True),
     }
     try:
         chaos = run_chaos(log_dir, args.timeout_s)

@@ -1,0 +1,11 @@
+"""YAMT013 bad fixture: a capture window with no finally — any exception in
+the profiled region leaks the trace (and wedges the next start on TPU)."""
+
+import jax
+
+
+def capture_window(step_fn, batches):
+    jax.profiler.start_trace("/tmp/trace")
+    for batch in batches:
+        step_fn(batch)
+    jax.profiler.stop_trace()

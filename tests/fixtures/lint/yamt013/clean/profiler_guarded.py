@@ -1,0 +1,27 @@
+"""YAMT013 clean fixture: both sanctioned guard shapes — the canonical
+``start(); try: ... finally: stop()`` idiom, and a start inside a try whose
+(outer) finally flushes a still-open window."""
+
+import jax
+
+
+def capture_window(step_fn, batches):
+    jax.profiler.start_trace("/tmp/trace")
+    try:
+        for batch in batches:
+            step_fn(batch)
+    finally:
+        jax.profiler.stop_trace()
+
+
+def capture_loop(step_fn, batches, start_at):
+    active = False
+    try:
+        for i, batch in enumerate(batches):
+            if i == start_at:
+                jax.profiler.start_trace("/tmp/trace")
+                active = True
+            step_fn(batch)
+    finally:
+        if active:
+            jax.profiler.stop_trace()

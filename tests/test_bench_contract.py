@@ -121,6 +121,12 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     assert out["unit"] == "images/sec"
     assert out["vs_baseline"] is None  # no serving reference divisor exists
     assert out["platform"]
+    # the shared provenance stamp (bench.py): every bench artifact is
+    # version/hardware attributable
+    prov = out["provenance"]
+    assert prov["jax_version"] and prov["jaxlib_version"] and prov["python"]
+    assert prov["platform"] == out["platform"]
+    assert prov["cpu_rehearsal"] == (out["platform"] == "cpu")
     assert out["image_sizes"] == [24, 32]
     # direct rows: one per (bucket, image_size), latency quantiles ordered
     assert [(r["batch"], r["image_size"]) for r in out["buckets"]] == [
@@ -222,6 +228,11 @@ def test_train_chaos_emits_parsed_artifact(tmp_path):
     assert "error" not in out, out.get("error")
     assert out["value"] is not None and out["value"] > 0
     assert out["unit"] == "steps" and out["vs_baseline"] is None
+    # provenance stamped WITHOUT importing jax in the parent (versions via
+    # importlib.metadata; cpu_rehearsal pinned by the caller)
+    prov = out["provenance"]
+    assert prov["jax_version"] and prov["cpu_rehearsal"] is True
+    assert "platform" not in prov  # the parent never touched a backend
 
     chaos, resume = out["chaos"], out["resume"]
     # preemption: clean exit, marker written, one preemption counted

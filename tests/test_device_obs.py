@@ -1,0 +1,203 @@
+"""obs/device.py — the device-side telemetry layer (compile/cost accounting,
+memory gauges, dispatch efficiency, profiler capture) and its wiring through
+the serve engine (every warmed executable cost-accounted in the snapshot)
+and the watchdog hang report."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.config import ModelConfig
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.obs import device as obs_device
+from yet_another_mobilenet_series_tpu.obs.registry import MetricsRegistry, get_registry
+from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.export import InferenceBundle, fold_network
+
+
+def _tiny_bundle(num_classes=8, image_size=24):
+    mc = ModelConfig(arch="mobilenet_v2", num_classes=num_classes, dropout=0.0,
+                     block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}])
+    net = get_model(mc, image_size)
+    params, state = net.init(jax.random.PRNGKey(0))
+    return InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
+
+
+# ---------------------------------------------------------------------------
+# timed_compile / record_cost primitives
+# ---------------------------------------------------------------------------
+
+
+def test_timed_compile_records_time_and_cost():
+    reg = MetricsRegistry()
+    lowered = jax.jit(lambda x: jnp.tanh(x @ x)).lower(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    exe = obs_device.timed_compile(lowered, "t_unit_matmul", registry=reg)
+    # the wrapper returns a runnable executable
+    y = exe(jnp.ones((8, 8), jnp.float32))
+    assert y.shape == (8, 8)
+    snap = reg.snapshot()
+    assert snap["obs.compiles"] == 1.0
+    assert snap["obs.compile_seconds.count"] == 1.0 and snap["obs.compile_seconds.sum"] > 0
+    # XLA knows this program's FLOPs: 8x8x8 matmul -> 2*512 plus the tanh
+    assert snap["obs.cost_flops.t_unit_matmul"] >= 2 * 8 * 8 * 8
+    assert snap["obs.cost_bytes.t_unit_matmul"] > 0
+    rep = obs_device.compile_report()["t_unit_matmul"]
+    assert rep["flops"] == snap["obs.cost_flops.t_unit_matmul"]
+    assert rep["compile_seconds"] > 0
+    assert obs_device.flops_for("t_unit_matmul") == rep["flops"]
+    assert obs_device.flops_for("never_compiled") == 0.0
+
+
+def test_record_cost_survives_broken_stage():
+    """Cost analysis is telemetry: a stage whose cost_analysis raises (or
+    returns garbage) records nothing and never raises."""
+    reg = MetricsRegistry()
+
+    class _Broken:
+        def cost_analysis(self):
+            raise RuntimeError("backend says no")
+
+    class _Garbage:
+        def cost_analysis(self):
+            return "not a dict"
+
+    assert obs_device.record_cost("t_broken", _Broken(), registry=reg) == {}
+    assert obs_device.record_cost("t_garbage", _Garbage(), registry=reg) == {}
+    snap = reg.snapshot()
+    assert "obs.cost_flops.t_broken" not in snap
+    # the report still names the executable (empty cost), so a hang report
+    # shows the compile happened even when the backend hid its cost
+    assert obs_device.compile_report()["t_broken"] == {}
+
+
+def test_extract_cost_merges_list_of_modules():
+    """Compiled.cost_analysis returns a LIST of per-module dicts on some
+    backends — entries must merge additively."""
+    raw = [{"flops": 10.0, "bytes accessed": 5.0}, {"flops": 2.0}]
+    assert obs_device._extract_cost(raw) == {"flops": 12.0, "bytes": 5.0}
+    assert obs_device._extract_cost(None) == {}
+    assert obs_device._extract_cost({"utilization": 1.0}) == {}
+
+
+# ---------------------------------------------------------------------------
+# memory gauges + build info
+# ---------------------------------------------------------------------------
+
+
+def test_memory_gauges_pull_without_device_sync():
+    reg = MetricsRegistry()
+    obs_device._MEM_INSTALLED = False  # idempotence latch: reset for the test
+    obs_device.install_memory_gauges(reg)
+    obs_device.install_memory_gauges(reg)  # idempotent: no double-install error
+    snap = reg.snapshot()
+    assert snap["host.rss_bytes"] > 1e6  # a live python process
+    assert snap["device.live_buffer_bytes"] >= 0
+
+
+def test_build_info_fields_and_exposition():
+    info = obs_device.build_info()
+    assert info["jax_version"] == jax.__version__
+    assert info["platform"] == jax.default_backend()
+    assert len(info["git_sha"]) >= 7  # a real checkout sha (this repo is one)
+    reg = MetricsRegistry()
+    reg.set_build_info(info)
+    text = reg.render_prometheus()
+    assert "# TYPE build_info gauge" in text
+    line = next(l for l in text.splitlines() if l.startswith("build_info{"))
+    assert f'jax_version="{jax.__version__}"' in line
+    assert f'git_sha="{info["git_sha"]}"' in line
+    assert line.endswith("} 1")
+    assert reg.build_info == info
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: warmed executables cost-accounted, dispatch efficiency
+# ---------------------------------------------------------------------------
+
+
+def test_engine_warmup_cost_accounts_every_executable():
+    """The acceptance claim: every warmed serve executable reports
+    cost_analysis flops/bytes in the obs snapshot, dispatches feed the
+    dispatched-FLOPs counter, and the achieved-FLOPS gauge derives from
+    cost / measured run seconds."""
+    reg = get_registry()
+    engine = InferenceEngine(_tiny_bundle(), buckets=(2, 4), image_size=24,
+                             fuse_ladder=(2,))
+    engine.warmup()
+    snap = reg.snapshot()
+    for bucket, size, k in [(2, 24, 1), (4, 24, 1), (4, 24, 2)]:
+        key = f"serve_b{bucket}_s{size}_k{k}"
+        assert snap[f"obs.cost_flops.{key}"] > 0, key
+        assert snap[f"obs.cost_bytes.{key}"] > 0, key
+    assert snap["obs.compiles"] >= 3
+
+    flops0 = snap.get("serve.dispatched_flops", 0.0)
+    x = np.random.RandomState(0).normal(0, 1, (3, 24, 24, 3)).astype(np.float32)
+    engine.predict(x)
+    snap = reg.snapshot()
+    # a 3-row request pads into the 4-bucket: its executable's full cost hit
+    # the device regardless of padding
+    assert snap["serve.dispatched_flops"] - flops0 == pytest.approx(
+        snap["obs.cost_flops.serve_b4_s24_k1"])
+    assert snap["serve.achieved_flops_per_s"] > 0
+    # fused dispatch: k chunks account k x the per-chunk cost (XLA costs a
+    # scan body once; the program runs it k times)
+    flops1 = snap["serve.dispatched_flops"]
+    x8 = np.random.RandomState(1).normal(0, 1, (8, 24, 24, 3)).astype(np.float32)
+    engine.predict(x8)
+    snap = reg.snapshot()
+    assert snap["serve.dispatched_flops"] - flops1 == pytest.approx(
+        2 * snap["obs.cost_flops.serve_b4_s24_k1"])
+
+
+def test_hang_report_carries_executable_costs(tmp_path):
+    """The watchdog hang report names every compiled executable with its
+    cost — a hang right after a compile is attributable."""
+    from yet_another_mobilenet_series_tpu.obs.watchdog import StallWatchdog
+
+    reg = MetricsRegistry()
+    lowered = jax.jit(lambda x: x * 2).lower(jax.ShapeDtypeStruct((4,), jnp.float32))
+    obs_device.timed_compile(lowered, "t_hang_probe", registry=reg)
+    wd = StallWatchdog(str(tmp_path), deadline_s=0.2, poll_s=0.05, registry=reg)
+    wd.start()
+    wd.arm(step=1)
+    deadline = time.time() + 10
+    report_path = tmp_path / "hang_report.json"
+    while time.time() < deadline and not report_path.exists():
+        time.sleep(0.05)
+    wd.stop()
+    rep = json.loads(report_path.read_text())
+    assert "t_hang_probe" in rep["executables"]
+    assert rep["executables"]["t_hang_probe"]["compile_seconds"] > 0
+
+
+# ---------------------------------------------------------------------------
+# profiler capture
+# ---------------------------------------------------------------------------
+
+
+def test_profiler_capture_single_flight(tmp_path):
+    cap = obs_device.ProfilerCapture(str(tmp_path / "trace"))
+    assert not cap.active
+    out = cap.start()
+    assert cap.active and out["trace_dir"].endswith("trace")
+    with pytest.raises(RuntimeError, match="already active"):
+        cap.start()
+    jnp.square(jnp.arange(128.0)).block_until_ready()  # something to capture
+    out = cap.stop()
+    assert not cap.active and out["captured_s"] >= 0
+    with pytest.raises(RuntimeError, match="no profiler capture"):
+        cap.stop()
+    # the xplane dump landed where trace_ops reads
+    assert list((tmp_path / "trace").rglob("*.xplane.pb"))
+    # stop_if_active on an idle capture is a no-op, on an open one it closes
+    cap.stop_if_active()
+    cap.start()
+    cap.stop_if_active()
+    assert not cap.active

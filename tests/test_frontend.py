@@ -353,9 +353,66 @@ def test_metrics_and_varz_scrape_surface():
         assert "serve.latency_seconds.batch.p99" in varz["metrics"]
         assert varz["metrics"]["serve.latency_seconds.batch.min"] > 0
         assert varz["admission"]["breaker"] == "closed"
+        # device-telemetry surfaces ride /varz too: build identity + the
+        # per-executable compile/cost table (dict; empty for this host-double
+        # engine, populated by any real warmed engine in this process)
+        assert isinstance(varz["build_info"], dict)
+        assert isinstance(varz["executables"], dict)
     finally:
         fe.stop()
         b.stop()
+
+
+def test_metrics_build_info_family():
+    """/metrics carries the build_info version-attribution family once the
+    CLI stamps it (cli/serve.py run() does at startup)."""
+    from yet_another_mobilenet_series_tpu.obs import device as obs_device
+
+    get_registry().set_build_info(obs_device.build_info())
+    b, ac, fe = _stack()
+    try:
+        with urllib.request.urlopen(fe.url + "/metrics", timeout=30) as r:
+            text = r.read().decode()
+        line = next(l for l in text.splitlines() if l.startswith("build_info{"))
+        assert "git_sha=" in line and "jax_version=" in line and "platform=" in line
+        assert line.endswith("} 1")
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_profiler_capture_endpoints(tmp_path):
+    """POST /profile/start|stop: 200 with the trace dir, 409 on double
+    start/stop, xplane dump on disk for trace_ops, 404 when unconfigured."""
+    from yet_another_mobilenet_series_tpu.obs import device as obs_device
+
+    b, ac, _fe = _stack()
+    _fe.stop()  # rebuild with a profiler attached (same admission stack)
+    cap = obs_device.ProfilerCapture(str(tmp_path / "trace"))
+    fe = Frontend(ac, port=0, profiler=cap).start()
+    try:
+        status, body, _ = _request(fe.url + "/profile/start", data=b"", method="POST")
+        assert status == 200 and body["ok"] and body["trace_dir"].endswith("trace")
+        status, body, _ = _request(fe.url + "/profile/start", data=b"", method="POST")
+        assert status == 409 and body["error"] == "profiler_state"
+        # capture real serving traffic inside the window
+        assert _post_image(fe.url, 3)[0] == 200
+        status, body, _ = _request(fe.url + "/profile/stop", data=b"", method="POST")
+        assert status == 200 and body["captured_s"] >= 0
+        assert list((tmp_path / "trace").rglob("*.xplane.pb"))
+        status, body, _ = _request(fe.url + "/profile/stop", data=b"", method="POST")
+        assert status == 409
+    finally:
+        fe.stop()
+        b.stop()
+    # no profiler configured -> 404, never a crash
+    b2, ac2, fe2 = _stack()
+    try:
+        status, body, _ = _request(fe2.url + "/profile/start", data=b"", method="POST")
+        assert status == 404
+    finally:
+        fe2.stop()
+        b2.stop()
 
 
 def test_quantile_deadline_predictor():

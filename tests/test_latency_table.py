@@ -1,0 +1,177 @@
+"""Measured-latency NAS cost table (nas/latency.py + scripts/latency_table.py
++ the prune.cost="latency_table" penalty mode — ROADMAP item 3) and the
+checked-in LATENCY_TABLE_r01_cpu_rehearsal.json artifact contract."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from yet_another_mobilenet_series_tpu.config import ModelConfig, PruneConfig
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.nas import latency, masking, penalty
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "LATENCY_TABLE_r01_cpu_rehearsal.json")
+
+
+def _latency_table_mod():
+    spec = importlib.util.spec_from_file_location(
+        "latency_table", os.path.join(REPO, "scripts", "latency_table.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _supernet(image_size=24):
+    mc = ModelConfig(
+        arch="atomnas_supernet", num_classes=4, dropout=0.0,
+        block_specs=(
+            {"t": 1, "c": 8, "n": 1, "s": 1, "k": [3]},        # non-prunable (t=1)
+            {"t": 4, "c": 8, "n": 1, "s": 2, "k": [3, 5]},
+            {"t": 4, "c": 16, "n": 1, "s": 2, "k": [3, 5]},
+        ),
+    )
+    return get_model(mc, image_size=image_size)
+
+
+@pytest.fixture(scope="module")
+def tiny_table(tmp_path_factory):
+    """A real measured table for the tiny supernet, built through the actual
+    bench path (2 widths, 2 iters — seconds on CPU), written as an artifact
+    and loaded back: the end-to-end path the pinned penalty A/B rides."""
+    net = _supernet()
+    mod = _latency_table_mod()
+    entries = mod.build_table(net, [24], (0.5, 1.0), batch=2, iters=2)
+    path = tmp_path_factory.mktemp("latbl") / "LATENCY_TABLE_test.json"
+    path.write_text(json.dumps({"entries": entries}))
+    return net, str(path), entries
+
+
+def test_block_key_and_input_sizes():
+    net = _supernet()
+    sizes = latency.block_input_sizes(net, 24)
+    assert len(sizes) == len(net.blocks)
+    assert sizes[0] == 12  # stem stride 2 on 24
+    assert sizes[2] == 6   # block 1 stride 2
+    key = latency.block_key(net.blocks[1], sizes[1])
+    assert key.startswith("in8_out8_e32_k3.5_s2_se0_hw12")
+    # width override changes the e field only
+    assert latency.block_key(net.blocks[1], sizes[1], expanded=16).split("_")[2] == "e16"
+
+
+def test_table_build_load_and_atom_costs(tiny_table):
+    net, path, entries = tiny_table
+    # one entry per DISTINCT block signature, each with the width ladder
+    assert len(entries) == len({e["key"] for e in entries}) == 3
+    for e in entries:
+        assert len(e["alive_channels"]) == len(e["latency_s"]) == 2
+        assert all(v > 0 for v in e["latency_s"])
+        assert all(f > 0 for f in e["cost_flops"])
+    table = latency.LatencyTable.load(path)
+    costs, total = table.atom_cost_table(net, set(masking.prunable_blocks(net)))
+    assert set(costs) == set(masking.prunable_blocks(net))
+    assert total > 0
+    for i, c in costs.items():
+        assert c.shape == (net.blocks[i].expanded_channels,)
+        assert np.all(c > 0)  # the slope floor keeps every atom's cost positive
+    # block_latency interpolates at full width == the measured full point
+    e = entries[1]
+    blk = next(b for i, b in enumerate(net.blocks)
+               if latency.block_key(b, latency.block_input_sizes(net, 24)[i]) == e["key"])
+    hw = int(e["key"].rsplit("hw", 1)[1])
+    assert table.block_latency(blk, hw) == pytest.approx(max(
+        lat for ch, lat in zip(e["alive_channels"], e["latency_s"])
+        if ch == max(e["alive_channels"])))
+
+
+def test_missing_block_is_a_hard_error(tiny_table):
+    """A net the table was not built for must fail loudly — silently falling
+    back to FLOPs would un-measure the search objective."""
+    _, path, _ = tiny_table
+    table = latency.LatencyTable.load(path)
+    other = _supernet(image_size=32)  # different input resolutions -> new keys
+    with pytest.raises(KeyError, match="no latency measurement"):
+        table.atom_cost_table(other)
+
+
+def test_table_validation_rejects_malformed(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"entries": []}))
+    with pytest.raises(ValueError, match="no entries"):
+        latency.LatencyTable.load(str(bad))
+    bad.write_text(json.dumps({"entries": [
+        {"key": "k", "alive_channels": [4], "latency_s": [1e-3]}]}))
+    with pytest.raises(ValueError, match=">=2"):
+        latency.LatencyTable.load(str(bad))
+    bad.write_text(json.dumps({"entries": [
+        {"key": "k", "alive_channels": [4, 8], "latency_s": [1e-3, 0.0]}]}))
+    with pytest.raises(ValueError, match="non-positive"):
+        latency.LatencyTable.load(str(bad))
+
+
+def test_penalty_latency_mode_differs_from_flops_pinned(tiny_table):
+    """THE pinned acceptance: prune.cost='latency_table' produces a
+    different (measured-cost) penalty vector than FLOPs mode — and a working
+    penalty_fn — while the flag-gated default stays bit-identical to the
+    FLOPs path."""
+    net, path, _ = tiny_table
+    flops_cfg = PruneConfig(enable=True, rho=1.0)
+    lat_cfg = PruneConfig(enable=True, rho=1.0, cost="latency_table", latency_table=path)
+    flops_costs = penalty.atom_cost_table(net, flops_cfg)
+    lat_costs = penalty.atom_cost_table(net, lat_cfg)
+    assert set(flops_costs) == set(lat_costs)
+    # both normalized (resolution-independent rho), so the vectors are
+    # comparable — and MEASURABLY different: measured latency is not a
+    # rescaled copy of analytic MACs (the whole point, PAPERS.md FLASH/LANA)
+    diffs = [
+        np.max(np.abs(lat_costs[k] - flops_costs[k])) / np.max(flops_costs[k])
+        for k in flops_costs
+    ]
+    assert max(diffs) > 0.01, f"latency costs indistinguishable from FLOPs: {diffs}"
+    # the penalty fn builds and evaluates finite in table mode
+    params, _ = net.init(jax.random.PRNGKey(0))
+    masks = masking.init_masks(net)
+    pen = penalty.make_penalty_fn(net, lat_cfg)(params, masks)
+    assert np.isfinite(float(pen)) and float(pen) > 0
+    # default config never touches the table path
+    assert PruneConfig().cost == "flops"
+
+
+def test_penalty_cost_mode_validation():
+    net = _supernet()
+    with pytest.raises(ValueError, match="prune.latency_table"):
+        penalty.atom_cost_table(net, PruneConfig(enable=True, cost="latency_table"))
+    with pytest.raises(ValueError, match="unknown prune.cost"):
+        penalty.atom_cost_table(net, PruneConfig(enable=True, cost="bogus"))
+
+
+def test_checked_in_rehearsal_artifact_contract():
+    """LATENCY_TABLE_r01_cpu_rehearsal.json: bench-contract shape, stamped
+    provenance, a full mobilenet_v3_large block set with positive measured
+    ladders, and loadable by the consumer API."""
+    with open(ARTIFACT) as f:
+        doc = json.load(f)
+    assert doc["metric"] == "mobilenet_v3_large_block_latency_table"
+    assert "error" not in doc
+    assert doc["value"] == len(doc["entries"]) >= 10
+    prov = doc["provenance"]
+    assert prov["jax_version"] and prov["jaxlib_version"] and prov["python"]
+    assert prov["platform"] == "cpu" and prov["cpu_rehearsal"] is True
+    assert len(doc["widths"]) >= 2
+    for e in doc["entries"]:
+        assert len(e["alive_channels"]) == len(e["latency_s"]) == len(doc["widths"])
+        assert all(v > 0 for v in e["latency_s"])
+        assert e["alive_channels"] == sorted(e["alive_channels"])
+    table = latency.LatencyTable.load(ARTIFACT)
+    net = get_model(ModelConfig(arch="mobilenet_v3_large"), 224)
+    costs, total = table.atom_cost_table(net, set(masking.prunable_blocks(net)))
+    assert total > 0 and all(np.all(c > 0) for c in costs.values())
+    # the searched objective is buildable straight off the checked-in table
+    cfg = PruneConfig(enable=True, cost="latency_table", latency_table=ARTIFACT)
+    assert set(penalty.atom_cost_table(net, cfg)) == set(
+        str(i) for i in masking.prunable_blocks(net))

@@ -149,6 +149,47 @@ def test_histogram_custom_default_buckets():
     assert reg.histogram("a") is before  # get-or-create keeps the old ladder
 
 
+def test_quantiles_from_counts_empty_and_zero_observations():
+    """Edge cases the serving bench's delta math can hit: an all-zero count
+    window (no observations between snapshots) and an empty-histogram
+    summary must yield zeros, never a divide-by-zero or an inf clamp."""
+    from yet_another_mobilenet_series_tpu.obs.registry import (
+        DEFAULT_BUCKET_BOUNDS, quantiles_from_counts)
+
+    counts = [0] * (len(DEFAULT_BUCKET_BOUNDS) + 1)
+    assert quantiles_from_counts(DEFAULT_BUCKET_BOUNDS, counts, (0.5, 0.95, 0.99)) == [0.0, 0.0, 0.0]
+    # vmin/vmax still at their empty sentinels (inf/-inf) must not leak out
+    assert quantiles_from_counts(
+        DEFAULT_BUCKET_BOUNDS, counts, (0.5,), vmin=float("inf"), vmax=float("-inf")) == [0.0]
+    h = MetricsRegistry().histogram("t.never_observed")
+    s = h.summary()
+    assert s == {"count": 0.0, "sum": 0.0, "mean": 0.0, "min": 0.0, "max": 0.0,
+                 "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    assert h.quantile(0.99) == 0.0
+
+
+def test_render_prometheus_empty_histogram():
+    """A histogram with no samples still renders a complete, finite family:
+    zero cumulative buckets, zero sum/count, zero quantiles — a scraper must
+    never see NaN/inf from a warmed-but-idle latency metric."""
+    reg = MetricsRegistry()
+    reg.histogram("serve.latency_seconds.batch", bounds=[0.01, 0.1])
+    golden = "\n".join([
+        '# TYPE serve_latency_seconds histogram',
+        'serve_latency_seconds_bucket{class="batch",le="0.01"} 0',
+        'serve_latency_seconds_bucket{class="batch",le="0.1"} 0',
+        'serve_latency_seconds_bucket{class="batch",le="+Inf"} 0',
+        'serve_latency_seconds_sum{class="batch"} 0',
+        'serve_latency_seconds_count{class="batch"} 0',
+        'serve_latency_seconds{class="batch",quantile="0.5"} 0',
+        'serve_latency_seconds{class="batch",quantile="0.95"} 0',
+        'serve_latency_seconds{class="batch",quantile="0.99"} 0',
+    ]) + "\n"
+    assert reg.render_prometheus() == golden
+    for v in reg.snapshot().values():
+        assert v == v and abs(v) != float("inf")  # finite, not NaN
+
+
 def test_render_prometheus_golden():
     """Exposition golden: counter/gauge samples, a labeled per-class
     histogram with cumulative buckets + quantile lines, TYPE lines once per
@@ -608,6 +649,29 @@ def test_obs_report_renders_summary(tmp_path, capsys):
     assert "ckpt.saves = 1" in out
     assert "HANG REPORT" in out
     assert "dispatch/train_step" in out
+
+
+def test_obs_report_device_section(tmp_path, capsys):
+    """The device-telemetry section: compile events, per-executable cost,
+    dispatch efficiency, memory gauges (obs/device.py surfaces)."""
+    (tmp_path / "obs_registry.json").write_text(json.dumps({
+        "obs.compiles": 3.0, "obs.compile_seconds.p50": 1.5,
+        "obs.compile_seconds.max": 2.0, "obs.compile_seconds.sum": 4.0,
+        "obs.cost_flops.serve_b8_s224_k1": 1.2e9,
+        "obs.cost_bytes.serve_b8_s224_k1": 3.4e8,
+        "serve.achieved_flops_per_s": 2.5e9, "serve.run_seconds.count": 4.0,
+        "host.rss_bytes": 5e8, "device.live_buffer_bytes": 1e7,
+        "device.bytes_in_use.d0": 2e9, "device.peak_bytes_in_use.d0": 3e9,
+    }))
+    rc = _obs_report_mod().main([str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "## device (compile / cost / memory)" in out
+    assert "compiles = 3" in out and "p50 1.50s" in out
+    assert "[serve_b8_s224_k1] 1.200 GFLOP, 340.0 MB accessed" in out
+    assert "dispatch efficiency: 2.50 achieved GFLOP/s" in out
+    assert "host rss 500 MB" in out
+    assert "d0 in-use 2000 MB (peak 3000)" in out
 
 
 def test_obs_report_missing_dir(capsys):

@@ -1,0 +1,91 @@
+"""scripts/trace_ops.py on a tiny checked-in xplane fixture (network-free):
+the aggregation functions the profiler-capture endpoints feed, previously
+untested — including the jaxlib-0.4.36 regression where the CPU-client
+thunk line was named ``tf_XLATfrtCpuClient`` and the exact-name match
+aggregated zero events."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "xplane")
+
+pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                    reason="xplane proto unavailable")
+
+
+def _mod():
+    spec = importlib.util.spec_from_file_location(
+        "trace_ops", os.path.join(REPO, "scripts", "trace_ops.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_op_kind_collapse():
+    m = _mod()
+    assert m.op_kind("fusion.123") == "fusion"
+    assert m.op_kind("%dot.2") == "dot"
+    assert m.op_kind("all-reduce-start") == "all-reduce-start"
+    assert m.op_kind("tanh") == "tanh"
+
+
+def test_fixture_host_aggregation_sees_cpu_client_thunks():
+    """The fixture traces a jitted tanh(x @ x) on CPU: the host fallback must
+    find the dot + tanh thunk events on the tf_XLATfrtCpuClient line (the
+    old XLAEigen/PjRtCpuClient exact match returned zero events here)."""
+    m = _mod()
+    xs, path = m.load_xspace(FIXTURE_DIR)
+    assert path.endswith("vm.xplane.pb")
+    host = m.aggregate_host(xs)
+    assert host["n_events"] > 0, "CPU-client thunk line not matched"
+    kinds = set(host["per_cat"])
+    assert "dot" in kinds and "tanh" in kinds
+    assert host["total_ps"] == sum(host["per_cat"].values()) > 0
+    # the fixture has no device plane — the TPU aggregator must say so, not
+    # fabricate one
+    assert not any(p.name.startswith("/device:TPU") for p in xs.planes)
+
+
+def test_load_xspace_missing_dir():
+    m = _mod()
+    with pytest.raises(FileNotFoundError, match="no .xplane.pb"):
+        m.load_xspace("/definitely/not/a/dir")
+
+
+def test_main_renders_fallback_and_table_check(tmp_path, capsys):
+    """End-to-end CLI pass over the fixture, including the latency-table
+    cross-check (table total + trace total + the provenance warning)."""
+    m = _mod()
+    table = tmp_path / "LATENCY_TABLE_t.json"
+    table.write_text(json.dumps({
+        "entries": [
+            {"key": "a", "alive_channels": [4, 8], "latency_s": [1e-4, 2e-4]},
+            {"key": "b", "alive_channels": [8, 16], "latency_s": [3e-4, 5e-4]},
+        ],
+        "provenance": {"device_kind": "cpu", "cpu_rehearsal": True},
+    }))
+    rc = m.main([FIXTURE_DIR, "5", "--check-table", str(table)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no /device:TPU plane" in out
+    assert "/host:CPU" in out and "dot" in out
+    assert "latency-table cross-check" in out
+    # predicted total = sum of full-width points = 0.2 + 0.5 ms
+    assert "0.700 ms/image" in out
+    assert "cpu_rehearsal=True" in out
+
+
+def test_table_prediction_full_width_points(tmp_path):
+    m = _mod()
+    table = tmp_path / "t.json"
+    # unsorted ladder: the full-width point is the LARGEST channels entry,
+    # not the last list element
+    table.write_text(json.dumps({"entries": [
+        {"key": "a", "alive_channels": [8, 4], "latency_s": [2e-4, 1e-4]}]}))
+    pred = m.table_prediction(str(table))
+    assert pred["entries"] == 1
+    assert pred["blocks_total_ms"] == pytest.approx(0.2)

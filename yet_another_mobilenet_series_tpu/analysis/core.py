@@ -248,6 +248,7 @@ def load_rules() -> list[Rule]:
         rules_imports,
         rules_logging,
         rules_prng_flow,
+        rules_profiler,
         rules_recompile,
         rules_spmd,
         rules_swallow,

@@ -1,0 +1,129 @@
+"""YAMT013 — profiler capture windows without a finally-guaranteed stop.
+
+``jax.profiler.start_trace`` opens a process-global capture; if the code
+between start and ``stop_trace`` raises (a failed barrier sync, a chaos
+injection, a preemption unwinding the loop), an unguarded window stays open:
+every later dispatch keeps streaming into the trace, the dump never
+finalizes, and on TPU a second ``start_trace`` then aborts the process. The
+train CLI's profiler window is exactly this shape (cli/train.py) — the rule
+pins the discipline that fixed it.
+
+A ``start_trace`` call is GUARDED when a ``stop_trace`` call is reachable on
+every exit path via a ``finally``:
+
+- the start sits inside a ``try`` (body, else, or an except handler) whose
+  ``finally`` contains a ``stop_trace`` call — possibly several levels up,
+  but within the same function (a finally in a CALLER cannot be seen and is
+  not credited); or
+- the start is immediately followed, in the same statement block, by a
+  ``try`` whose ``finally`` stops — the canonical ``start(); try: ...
+  finally: stop()`` idiom (starting inside the try would risk stopping a
+  never-started trace).
+
+Split start/stop pairs that genuinely cannot share a frame (an HTTP-triggered
+capture whose stop arrives as a separate request — obs/device.py
+ProfilerCapture) carry a same-line suppression naming the out-of-band
+guard, per the docs/LINT.md house rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+
+
+def _is_stop_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "stop_trace") or (
+        isinstance(f, ast.Name) and f.id == "stop_trace"
+    )
+
+
+def _has_stop(stmts) -> bool:
+    for st in stmts:
+        for n in ast.walk(st):
+            if _is_stop_call(n):
+                return True
+    return False
+
+
+@register
+class ProfilerStopGuard(Rule):
+    id = "YAMT013"
+    name = "profiler-window-unguarded"
+    description = (
+        "jax.profiler.start_trace without a finally-guaranteed stop_trace in the "
+        "same function: an exception inside the capture window leaks the trace "
+        "(and a later start_trace aborts on TPU) — wrap the window in try/finally"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        parents: dict[int, ast.AST] = {}
+        for node in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualified_name(node.func, src.aliases) or ""
+            if not (
+                q.endswith("profiler.start_trace")
+                or (isinstance(node.func, ast.Attribute) and node.func.attr == "start_trace")
+            ):
+                continue
+            if self._guarded(node, parents):
+                continue
+            findings.append(Finding(
+                src.path, node.lineno, node.col_offset, self.id,
+                "jax.profiler.start_trace with no finally-guaranteed stop_trace: an "
+                "exception inside the capture window leaks the trace — use "
+                "`start_trace(...); try: ... finally: stop_trace()` (or suppress "
+                "with the out-of-band guard named, for split start/stop pairs)",
+            ))
+        return findings
+
+    def _guarded(self, call: ast.Call, parents: dict[int, ast.AST]) -> bool:
+        # climb to each enclosing statement, checking both guard shapes at
+        # every level; stop at the function boundary (a caller's finally is
+        # invisible here and gets no credit)
+        cur: ast.AST = call
+        while True:
+            parent = parents.get(id(cur))
+            if parent is None or isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+            ):
+                # last chance: a module-/function-level start followed by a
+                # guarded try in the same top-level block
+                return self._followed_by_guarded_try(cur, parent)
+            if isinstance(parent, ast.Try):
+                field = next(
+                    (
+                        f
+                        for f in ("body", "orelse", "finalbody")
+                        if cur in getattr(parent, f)
+                    ),
+                    "handlers" if cur in parent.handlers else None,
+                )
+                if field in ("body", "orelse", "handlers") and _has_stop(parent.finalbody):
+                    return True
+            if isinstance(cur, ast.stmt) and self._followed_by_guarded_try(cur, parent):
+                return True
+            cur = parent
+
+    def _followed_by_guarded_try(self, stmt: ast.AST, parent: ast.AST | None) -> bool:
+        """``start_trace(...)`` then ``try: ... finally: stop_trace()`` as the
+        next statement(s) of the same block."""
+        if parent is None or not isinstance(stmt, ast.stmt):
+            return False
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(parent, field, None)
+            if isinstance(block, list) and stmt in block:
+                after = block[block.index(stmt) + 1 :]
+                return any(
+                    isinstance(st, ast.Try) and _has_stop(st.finalbody) for st in after
+                )
+        return False

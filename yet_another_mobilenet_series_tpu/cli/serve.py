@@ -43,6 +43,7 @@ import time
 import numpy as np
 
 from ..config import Config, parse_cli
+from ..obs import device as obs_device
 from ..obs import registry as obs_registry
 from ..obs import trace as obs_trace
 from ..obs.watchdog import StallWatchdog
@@ -198,12 +199,20 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     if watchdog is not None:
         watchdog.register_info("serving", lambda: _serving_info(batcher, admission))
         watchdog.start()
+    # HTTP-triggered jax.profiler capture (obs/device.py): xplane dumps land
+    # in <log_dir>/trace (or serve.listen.profile_dir) for trace_ops.py; the
+    # drain path below guarantees a still-open window closes at shutdown
+    profile_dir = cfg.serve.listen.profile_dir or (
+        os.path.join(cfg.train.log_dir, "trace") if cfg.train.log_dir else ""
+    )
+    profiler = obs_device.ProfilerCapture(profile_dir) if profile_dir else None
     frontend = Frontend(
         admission,
         host=cfg.serve.listen.host,
         port=cfg.serve.listen.port,
         request_timeout_s=cfg.serve.listen.request_timeout_s,
         retry_after_s=cfg.serve.admission.breaker_cooldown_s,
+        profiler=profiler,
     ).start()
     addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid()}
     if cfg.train.log_dir:
@@ -216,6 +225,10 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     finally:
         t0 = time.perf_counter()
         frontend.stop()
+        if profiler is not None:
+            # a capture the operator never stopped must not outlive the
+            # server (the drain-path half of the YAMT013 discipline)
+            profiler.stop_if_active()
         batcher.stop(drain=True)  # bounded by serve.drain_timeout_s
         if watchdog is not None:
             watchdog.stop()
@@ -232,6 +245,9 @@ def run(cfg: Config) -> dict:
     if cfg.obs.histogram_buckets:
         # before any serving histogram exists: the ladder applies at creation
         reg.set_default_buckets(cfg.obs.histogram_buckets)
+    # version attribution (/metrics build_info family) + device memory gauges
+    reg.set_build_info(obs_device.build_info())
+    obs_device.install_memory_gauges(reg)
     log.set_registry(reg)
     tracer = obs_trace.configure(enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size)
     result: dict = {}

@@ -41,6 +41,7 @@ from .. import data as data_lib
 from ..models import get_model
 from ..models.specs import Network
 from ..nas import masking, penalty, rematerialize
+from ..obs import device as obs_device
 from ..obs import registry as obs_registry
 from ..obs import trace as obs_trace
 from ..obs.watchdog import StallWatchdog
@@ -423,6 +424,11 @@ def run(cfg: Config) -> dict:
     if cfg.obs.histogram_buckets:
         # before any training histogram exists: the ladder applies at creation
         reg.set_default_buckets(cfg.obs.histogram_buckets)
+    # device telemetry (obs/device.py): version attribution + HBM/RSS pull
+    # gauges — read only when a snapshot is taken (the log cadence), so they
+    # ride every scalars row, hang report, and train_health dump for free
+    reg.set_build_info(obs_device.build_info())
+    obs_device.install_memory_gauges(reg)
     log.set_registry(reg)
     tracer = obs_trace.configure(
         enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size
@@ -486,6 +492,34 @@ def _run_impl(cfg: Config, log: Logger, mesh, is_coord: bool, tracer, watchdog) 
                 mgr.close()
             except Exception as e:  # noqa: BLE001 — best-effort shutdown
                 log.log(f"checkpoint close on shutdown failed ({type(e).__name__}: {e})")
+
+
+def _record_step_cost(trainer: Trainer, ts, batch, rng, reg, tracer, log: Logger,
+                      first_dispatch_s: float) -> None:
+    """Device-cost accounting for the compiled train step (obs/device.py):
+    the first dispatch's host wall time (≈ trace + compile under async
+    dispatch — the run never blocks on device execution here) lands in
+    ``obs.compile_seconds``, and a one-time re-lower of the step records its
+    cost_analysis FLOPs/bytes into the ``train_step`` cost gauges. Lowering
+    traces but does NOT compile, so the one-off cost is seconds of host
+    time per trainer build — amortized to noise over a run. Telemetry only:
+    any failure is logged and swallowed, never fatal."""
+    reg.histogram("obs.compile_seconds").observe(first_dispatch_s)
+    reg.counter("obs.compiles").inc()
+    try:
+        with tracer.span("dispatch/cost_analysis", "dispatch"):
+            lowered = trainer.train_step.lower(ts, batch, rng)
+        cost = obs_device.record_cost(
+            "train_step", lowered, compile_seconds=first_dispatch_s, registry=reg)
+    except Exception as e:  # noqa: BLE001 — cost telemetry must never end a run
+        log.log(f"train step cost_analysis unavailable ({type(e).__name__}: {e})")
+        return
+    if cost.get("flops"):
+        log.log(
+            f"train step cost_analysis: {cost['flops'] / 1e9:.3f} GFLOP, "
+            f"{cost.get('bytes', 0) / 1e6:.1f} MB accessed per step "
+            f"(first dispatch {first_dispatch_s:.1f}s ≈ trace+compile)"
+        )
 
 
 def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool, tracer,
@@ -603,6 +637,10 @@ def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool,
                                           event_fn=trainer.prune_event)
 
     grouped_step = build_grouped()
+    # device-cost accounting fires once per compiled step program: on the
+    # first dispatch, and again after a rematerialize rebuild (new shapes =>
+    # new executable => new cost)
+    cost_recorded = not is_coord
 
     try:
         while epoch < total_epochs:
@@ -616,14 +654,22 @@ def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool,
                 if grouped_step is not None and epoch_steps - steps_done >= k_dispatch:
                     with tracer.span("data/next", "data", batches=k_dispatch):
                         bs = tuple(next(train_iter) for _ in range(k_dispatch))
+                    t_dispatch0 = time.perf_counter()
                     with tracer.span("dispatch/grouped_step", "dispatch", steps=k_dispatch):
                         ts, metric_list = grouped_step(ts, bs, rng)
+                    cost_batch = bs[0]
                 else:
                     with tracer.span("data/next", "data"):
                         b = next(train_iter)  # already on-mesh (prefetch_to_mesh)
+                    t_dispatch0 = time.perf_counter()
                     with tracer.span("dispatch/train_step", "dispatch"):
                         ts, metrics = trainer.train_step(ts, b, rng)
                     metric_list = [metrics]
+                    cost_batch = b
+                if not cost_recorded:
+                    cost_recorded = True
+                    _record_step_cost(trainer, ts, cost_batch, rng, reg, tracer, log,
+                                      time.perf_counter() - t_dispatch0)
                 steps_done += len(metric_list)
                 # per-sub-step host processing: metrics entries are lazy
                 # device arrays; nothing below syncs unless a cadence fires
@@ -640,16 +686,25 @@ def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool,
 
                     if cfg.train.profile_start_step and is_coord:
                         if step_i == cfg.train.profile_start_step:
+                            # stop is finally-guaranteed (YAMT013): the close
+                            # below runs in a finally, and the loop's outer
+                            # finally flushes a window still open on ANY exit
                             jax.profiler.start_trace(cfg.train.log_dir + "/trace")
                             trace_active = True
                         elif trace_active and step_i >= cfg.train.profile_start_step + cfg.train.profile_num_steps:
-                            # true barrier before closing the trace: through the
-                            # axon tunnel block_until_ready can return at
-                            # dispatch-acknowledge and truncate the trace window
-                            # (PROFILE.md "measurement methodology")
-                            jax.device_get(metrics["loss"])
-                            jax.profiler.stop_trace()
-                            trace_active = False
+                            try:
+                                # true barrier before closing the trace: through
+                                # the axon tunnel block_until_ready can return at
+                                # dispatch-acknowledge and truncate the trace
+                                # window (PROFILE.md "measurement methodology")
+                                jax.device_get(metrics["loss"])
+                            finally:
+                                # a failed barrier sync must still close the
+                                # window HERE (the old code left it running
+                                # until the outer finally, capturing the whole
+                                # unwind into the trace)
+                                jax.profiler.stop_trace()
+                                trace_active = False
                             log.log(f"profiler trace captured to {cfg.train.log_dir}/trace")
 
                     if (
@@ -738,6 +793,7 @@ def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool,
                     reg.counter("train.rebuilds").inc()
                     with tracer.span("rebuild/grouped_step", "rebuild"):
                         grouped_step = build_grouped()
+                    cost_recorded = not is_coord  # new executable: re-account its cost
                 if watchdog is not None:
                     watchdog.arm(host_step, phase="rematerialize")
 
@@ -782,9 +838,13 @@ def _train_or_eval(cfg: Config, net: Network, log: Logger, mesh, is_coord: bool,
     finally:
         preempt.uninstall()
         if trace_active:
-            # training ended (or raised) inside the capture window:
-            # flush the trace rather than losing it
-            jax.profiler.stop_trace()
+            # training ended (or raised) inside the capture window: flush
+            # the trace rather than losing it — and never let a failing
+            # stop mask the exception that got us here
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 — best-effort flush on unwind
+                log.log(f"profiler stop on exit failed ({type(e).__name__}: {e})")
 
     if guard is not None:
         guard.check(host_step)  # flush verdicts the last log window missed

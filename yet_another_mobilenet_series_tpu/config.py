@@ -241,6 +241,15 @@ class PruneConfig:
     target_flops: float = 0.0
     # normalize per-channel flops cost by total network flops
     normalize_cost: bool = True
+    # atom cost source weighting the BN-gamma L1 (ROADMAP item 3): "flops"
+    # (analytic MACs, the AtomNAS default) or "latency_table" (MEASURED
+    # per-block latency slopes from a scripts/latency_table.py artifact —
+    # FLOPs is a poor latency proxy, PAPERS.md FLASH/LANA). Flag-gated: the
+    # default search objective is unchanged.
+    cost: str = "flops"
+    # LATENCY_TABLE_*.json path (required when cost="latency_table"); every
+    # prunable block of the net must have a measured entry (nas/latency.py)
+    latency_table: str = ""
     # rho dynamics (SURVEY.md §2 #11 "penalty weight (rho) schedule"):
     #   constant — rho as-is
     #   ramp     — linear 0 -> rho over the first rho_ramp_epochs
@@ -410,6 +419,10 @@ class ListenConfig:
     # server-side cap on how long one /predict handler waits for its result
     # when the request carries no deadline (a deadline extends this bound)
     request_timeout_s: float = 60.0
+    # xplane dump dir for the HTTP-triggered profiler capture
+    # (POST /profile/start|stop, obs/device.py ProfilerCapture);
+    # "" = <train.log_dir>/trace (endpoints 404 when neither is set)
+    profile_dir: str = ""
 
 
 @dataclass(frozen=True)

@@ -1,6 +1,8 @@
-"""AtomNAS search machinery: penalty, masking, rematerialization."""
+"""AtomNAS search machinery: penalty, masking, rematerialization, and the
+measured-latency cost table (nas/latency.py, ROADMAP item 3)."""
 
 from . import rematerialize  # submodule (rematerialize.rematerialize is the entry point)
+from .latency import LatencyTable, block_input_sizes, block_key
 from .masking import init_masks, make_mask_update, mask_summary, prunable_blocks
 from .penalty import atom_cost_table, make_penalty_fn
 from .rematerialize import RematReport
@@ -8,4 +10,5 @@ from .rematerialize import RematReport
 __all__ = [
     "init_masks", "make_mask_update", "mask_summary", "prunable_blocks",
     "atom_cost_table", "make_penalty_fn", "RematReport", "rematerialize",
+    "LatencyTable", "block_input_sizes", "block_key",
 ]

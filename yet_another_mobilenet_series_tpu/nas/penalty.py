@@ -1,13 +1,21 @@
-"""FLOPs-weighted BN-gamma L1 penalty — the AtomNAS search objective
+"""Cost-weighted BN-gamma L1 penalty — the AtomNAS search objective
 (reference: utils/prune.py + the loss hook in train.py, SURVEY.md §3.2):
 
-    loss = CE + rho * sum_atoms( flops_cost[atom] * |gamma[atom]| )
+    loss = CE + rho * sum_atoms( cost[atom] * |gamma[atom]| )
 
 Each atom is one expanded channel of an InvertedResidual block; its gamma is
 the corresponding entry of the block's post-depthwise BN scale (ops/blocks.py
 keeps one concatenated BN across kernel branches precisely so this is a
 single vector per block). Dead atoms (mask==0) are excluded so the penalty
 pressure concentrates on the living network.
+
+The cost source is ``prune.cost`` (ROADMAP item 3): ``"flops"`` (default —
+the analytic per-atom MACs of utils/profiling.py, the AtomNAS objective) or
+``"latency_table"`` (per-atom MEASURED-latency slopes from a
+scripts/latency_table.py artifact via nas/latency.py — searching for the
+serving-optimal network, not the FLOPs-optimal one; PAPERS.md FLASH/LANA).
+Either way the penalty_fn shape is identical: only the cost constants
+baked at build time differ, so switching objectives is a config flip.
 """
 
 from __future__ import annotations
@@ -22,13 +30,28 @@ from ..utils.profiling import profile_network
 
 def atom_cost_table(net: Network, cfg: PruneConfig) -> dict[str, np.ndarray]:
     """Per-block float32 cost vectors, keyed by block index as str (matching
-    the params/masks key convention). Normalized by total network MACs when
-    cfg.normalize_cost so rho is resolution/width independent."""
+    the params/masks key convention). Normalized by the total network cost
+    (MACs, or measured latency in table mode) when cfg.normalize_cost so rho
+    is resolution/width independent — and comparable ACROSS cost modes."""
     from .masking import prunable_blocks
 
+    keep = set(prunable_blocks(net))
+    if cfg.cost == "latency_table":
+        from .latency import LatencyTable
+
+        if not cfg.latency_table:
+            raise ValueError(
+                "prune.cost='latency_table' needs prune.latency_table "
+                "(a scripts/latency_table.py LATENCY_TABLE_*.json artifact)"
+            )
+        table = LatencyTable.load(cfg.latency_table)
+        costs, total = table.atom_cost_table(net, keep)
+        scale = 1.0 / total if cfg.normalize_cost else 1.0
+        return {str(i): (c * scale).astype(np.float32) for i, c in costs.items()}
+    if cfg.cost != "flops":
+        raise ValueError(f"unknown prune.cost {cfg.cost!r} (expected 'flops' or 'latency_table')")
     prof = profile_network(net)
     scale = 1.0 / float(prof.total_macs) if cfg.normalize_cost else 1.0
-    keep = set(prunable_blocks(net))
     return {str(i): (c * scale).astype(np.float32) for i, c in prof.atom_costs.items() if i in keep}
 
 

@@ -18,8 +18,13 @@ on in production:
   dumps ``hang_report.json`` — open spans, last completed step, registry
   snapshot, all thread stacks — before the job dies silently (PROFILE.md's
   dead-tunnel rounds are the motivating failure mode).
+- ``device``: the layer BELOW the dispatch boundary — compile-time +
+  cost_analysis accounting for every AOT executable, pull-based HBM/RSS
+  memory gauges, the dispatch-efficiency (achieved FLOPS) gauge, and the
+  serving profiler capture (docs/OBSERVABILITY.md "Device telemetry").
 """
 
+from . import device
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .trace import SpanTracer, configure, get_tracer
 from .watchdog import StallWatchdog
@@ -32,6 +37,7 @@ __all__ = [
     "SpanTracer",
     "StallWatchdog",
     "configure",
+    "device",
     "get_registry",
     "get_tracer",
 ]

@@ -1,0 +1,319 @@
+"""Device-side telemetry: compile/cost accounting, memory gauges, profiler
+capture — the device twin of the host-side obs/ stack (docs/OBSERVABILITY.md
+"Device telemetry").
+
+Everything below the dispatch boundary used to be a black box: the serve
+engine and the train step compile XLA executables whose FLOPs/bytes the
+compiler KNOWS (``cost_analysis()``) but nothing recorded, device memory was
+invisible until an OOM, and the only profiler window was the train-only
+step-indexed one. Three surfaces, all wired through the existing registry so
+they ride every snapshot, ``/metrics``, ``/varz``, ``obs_report`` and the
+watchdog hang report for free:
+
+- **compile telemetry** — :func:`timed_compile` wraps every
+  ``lower().compile()`` (serve/engine.py ``_build``; cli/train.py records the
+  train step via :func:`record_cost` on the already-traced ``Lowered``):
+  per-key compile seconds land in the ``obs.compile_seconds`` histogram +
+  ``obs.compiles`` counter, and the executable's ``cost_analysis()``
+  flops/bytes land in per-key ``obs.cost_flops.<key>`` /
+  ``obs.cost_bytes.<key>`` gauges plus the :func:`compile_report` table the
+  hang report embeds. The engine feeds dispatched-executable flops into
+  ``serve.dispatched_flops``, and :func:`install_dispatch_efficiency_gauge`
+  derives ``serve.achieved_flops_per_s`` = dispatched cost FLOPs ÷ measured
+  ``serve.run_seconds`` — the "how much of the paper FLOPs did the wall
+  clock actually deliver" number ROADMAP item 3's latency work keys on.
+- **memory telemetry** — :func:`install_memory_gauges` registers PULL gauges
+  (read only at snapshot time — the existing log cadence — zero extra device
+  syncs): per-device ``device.bytes_in_use.d<i>`` / peak / limit from
+  ``device.memory_stats()`` (absent on backends that don't report, e.g. CPU),
+  ``device.live_buffer_bytes`` from ``jax.live_arrays()``, and
+  ``host.rss_bytes`` from ``/proc/self/statm``. Because they are registry
+  gauges they are automatically dumped into ``hang_report.json`` and
+  ``train_health.json`` (both embed full snapshots).
+- **profiler capture** — :class:`ProfilerCapture` is the start/stop pair
+  behind the serving frontend's ``POST /profile/start|stop`` endpoints
+  (docs/SERVING.md): a lock-guarded ``jax.profiler`` window whose owner
+  (cli/serve.py) guarantees ``stop_if_active()`` on every drain path, so an
+  operator who never sends the stop request cannot leak a capture past
+  shutdown. The train-loop window stays step-indexed in cli/train.py; lint
+  rule YAMT013 pins the try/finally discipline for both.
+
+Cost analysis is best-effort by design: backends disagree on the
+``cost_analysis()`` return shape (dict vs list-of-dicts) and some refuse it
+entirely — a telemetry miss must never take a compile down, so every reader
+is wrapped and a miss records nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .registry import MetricsRegistry, get_registry
+
+# per-key cost table: key -> {"flops", "bytes", "compile_seconds"} — the
+# compile_report() section of hang reports and the engine's dispatched-flops
+# lookup. Process-lifetime like the registry itself.
+_COSTS: dict[str, dict] = {}
+_COSTS_LOCK = threading.Lock()
+
+
+def _extract_cost(raw) -> dict:
+    """Normalize a ``cost_analysis()`` result (dict, or list of per-module
+    dicts on some backends) to {"flops": float, "bytes": float}; {} when the
+    backend reported nothing usable."""
+    if raw is None:
+        return {}
+    if isinstance(raw, (list, tuple)):
+        merged: dict[str, float] = {}
+        for d in raw:
+            if isinstance(d, dict):
+                for k, v in d.items():
+                    merged[k] = merged.get(k, 0.0) + float(v)
+        raw = merged
+    if not isinstance(raw, dict):
+        return {}
+    out = {}
+    if "flops" in raw:
+        out["flops"] = float(raw["flops"])
+    if "bytes accessed" in raw:
+        out["bytes"] = float(raw["bytes accessed"])
+    return out
+
+
+def record_cost(key: str, stage, *, compile_seconds: float | None = None,
+                registry: MetricsRegistry | None = None) -> dict:
+    """Record ``stage.cost_analysis()`` (a ``jax.stages.Lowered`` or
+    ``Compiled``) for executable ``key``: per-key ``obs.cost_flops.<key>`` /
+    ``obs.cost_bytes.<key>`` gauges + the :func:`compile_report` entry.
+    Returns the extracted cost dict ({} when the backend reported nothing) —
+    never raises on a cost-analysis miss."""
+    reg = registry or get_registry()
+    try:
+        cost = _extract_cost(stage.cost_analysis())
+    except Exception:  # noqa: BLE001 — telemetry must never fail a compile
+        cost = {}
+    entry = dict(cost)
+    if compile_seconds is not None:
+        entry["compile_seconds"] = round(float(compile_seconds), 6)
+    with _COSTS_LOCK:
+        _COSTS[key] = entry
+    if "flops" in cost:
+        reg.gauge(f"obs.cost_flops.{key}").set(cost["flops"])
+    if "bytes" in cost:
+        reg.gauge(f"obs.cost_bytes.{key}").set(cost["bytes"])
+    return cost
+
+
+def timed_compile(lowered, key: str, *, registry: MetricsRegistry | None = None):
+    """``lowered.compile()`` with the device-compile telemetry attached:
+    compile wall time into ``obs.compile_seconds`` (histogram) +
+    ``obs.compiles`` (counter), and the executable's cost_analysis
+    flops/bytes into the per-key gauges (:func:`record_cost`). This is THE
+    wrapper every explicit AOT compile goes through (serve/engine.py)."""
+    reg = registry or get_registry()
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    dt = time.perf_counter() - t0
+    reg.histogram("obs.compile_seconds").observe(dt)
+    reg.counter("obs.compiles").inc()
+    record_cost(key, compiled, compile_seconds=dt, registry=reg)
+    return compiled
+
+
+def flops_for(key: str) -> float:
+    """Recorded cost-analysis FLOPs of executable ``key`` (0.0 when the
+    backend reported none) — the engine's per-dispatch accounting lookup."""
+    with _COSTS_LOCK:
+        return float(_COSTS.get(key, {}).get("flops", 0.0))
+
+
+def compile_report() -> dict:
+    """{key: {flops, bytes, compile_seconds}} for every recorded executable —
+    embedded in the watchdog hang report and printable from obs_report."""
+    with _COSTS_LOCK:
+        return {k: dict(v) for k, v in sorted(_COSTS.items())}
+
+
+# ---------------------------------------------------------------------------
+# memory gauges (pull-based: zero cost until a snapshot reads them)
+# ---------------------------------------------------------------------------
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _rss_bytes() -> float:
+    with open("/proc/self/statm") as f:
+        return float(int(f.read().split()[1]) * _PAGE_SIZE)
+
+
+def _live_buffer_bytes() -> float:
+    import jax
+
+    return float(sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+
+
+_MEM_INSTALLED = False
+_MEM_LOCK = threading.Lock()
+
+
+def install_memory_gauges(registry: MetricsRegistry | None = None) -> None:
+    """Register the device/host memory PULL gauges (idempotent; both CLIs
+    call this at startup). Each gauge's callback runs only when a snapshot is
+    taken — the existing log cadence — and ``memory_stats()`` / ``statm``
+    reads are host-side, so telemetry adds no device syncs. Backends without
+    ``memory_stats()`` support (CPU) simply skip the per-device HBM gauges;
+    RSS and live-buffer accounting still land."""
+    global _MEM_INSTALLED
+    with _MEM_LOCK:
+        if _MEM_INSTALLED:
+            return
+        _MEM_INSTALLED = True
+    import jax
+
+    reg = registry or get_registry()
+    reg.gauge("host.rss_bytes").set_fn(_rss_bytes)
+    reg.gauge("device.live_buffer_bytes").set_fn(_live_buffer_bytes)
+    for i, dev in enumerate(jax.devices()):
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — a backend without stats is not an error
+            stats = None
+        if not stats:
+            continue
+
+        def make_reader(d, field):
+            return lambda: float((d.memory_stats() or {}).get(field, 0))
+
+        for field, name in (
+            ("bytes_in_use", "bytes_in_use"),
+            ("peak_bytes_in_use", "peak_bytes_in_use"),
+            ("bytes_limit", "bytes_limit"),
+        ):
+            if field in stats:
+                reg.gauge(f"device.{name}.d{i}").set_fn(make_reader(dev, field))
+
+
+def install_dispatch_efficiency_gauge(registry: MetricsRegistry | None = None) -> None:
+    """``serve.achieved_flops_per_s`` pull gauge: cumulative cost-analysis
+    FLOPs the engine dispatched (``serve.dispatched_flops``) divided by the
+    cumulative measured wall time those requests took
+    (``serve.run_seconds.sum``). Idempotent — the engine installs it once."""
+    reg = registry or get_registry()
+    flops = reg.counter("serve.dispatched_flops")
+    run = reg.histogram("serve.run_seconds")
+
+    def achieved() -> float:
+        return flops.value / run.total if run.total > 0 else 0.0
+
+    reg.gauge("serve.achieved_flops_per_s").set_fn(achieved)
+
+
+# ---------------------------------------------------------------------------
+# build info (the /metrics build_info family)
+# ---------------------------------------------------------------------------
+
+
+def _git_sha(repo_dir: str | None = None) -> str:
+    """HEAD sha read straight from .git (no subprocess: serving startup must
+    not fork a shell); "" when not a checkout."""
+    d = repo_dir or os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        git = os.path.join(d, ".git")
+        with open(os.path.join(git, "HEAD")) as f:
+            head = f.read().strip()
+        if not head.startswith("ref:"):
+            return head[:40]
+        ref = head.split(None, 1)[1]
+        ref_path = os.path.join(git, *ref.split("/"))
+        if os.path.exists(ref_path):
+            with open(ref_path) as f:
+                return f.read().strip()[:40]
+        with open(os.path.join(git, "packed-refs")) as f:
+            for line in f:
+                parts = line.strip().split()
+                if len(parts) == 2 and parts[1] == ref:
+                    return parts[0][:40]
+    except OSError:
+        pass
+    return ""
+
+
+def build_info() -> dict:
+    """Version-attribution labels for the ``build_info`` metric family: git
+    sha, jax/jaxlib versions, backend platform. A scraped fleet can group
+    replicas by exactly what they run."""
+    import jax
+    import jaxlib
+
+    return {
+        "git_sha": _git_sha() or "unknown",
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "platform": jax.default_backend(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# profiler capture (the serving frontend's /profile endpoints)
+# ---------------------------------------------------------------------------
+
+
+class ProfilerCapture:
+    """Config/HTTP-triggered ``jax.profiler`` window for the SERVING path —
+    the train-only step-indexed window generalized (docs/SERVING.md
+    "Profiler capture"). ``start``/``stop`` arrive as separate requests, so a
+    function-local try/finally cannot guard the pair; instead the capture is
+    lock-guarded single-flight and its OWNER (cli/serve.py's drain path)
+    calls :meth:`stop_if_active` on every shutdown, bounding a leaked window
+    at process drain. The xplane dump lands under ``dir`` for
+    scripts/trace_ops.py aggregation."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+        self._lock = threading.Lock()
+        self._active_since: float | None = None
+
+    @property
+    def active(self) -> bool:
+        return self._active_since is not None
+
+    def start(self) -> dict:
+        """Begin a capture; raises RuntimeError when one is already open."""
+        import jax
+
+        with self._lock:
+            if self._active_since is not None:
+                raise RuntimeError(
+                    f"profiler capture already active for "
+                    f"{time.perf_counter() - self._active_since:.1f}s"
+                )
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)  # yamt-lint: disable=YAMT013 — stop arrives via /profile/stop; stop_if_active() guards every drain path
+            self._active_since = time.perf_counter()
+            get_registry().counter("obs.profiler_captures").inc()
+        return {"trace_dir": self.trace_dir}
+
+    def stop(self) -> dict:
+        """End the capture; raises RuntimeError when none is open."""
+        import jax
+
+        with self._lock:
+            if self._active_since is None:
+                raise RuntimeError("no profiler capture active")
+            t0 = self._active_since
+            self._active_since = None
+            jax.profiler.stop_trace()
+        return {"trace_dir": self.trace_dir,
+                "captured_s": round(time.perf_counter() - t0, 3)}
+
+    def stop_if_active(self) -> None:
+        """Drain-path guard: close a still-open window without raising —
+        the shutdown equivalent of the train loop's finally."""
+        try:
+            self.stop()
+        except RuntimeError:
+            pass
+        except Exception:  # noqa: BLE001 — a torn capture must not block drain
+            get_registry().counter("obs.profiler_stop_errors").inc()

@@ -247,7 +247,21 @@ class MetricsRegistry:
     def __init__(self, default_buckets: Sequence[float] = DEFAULT_BUCKET_BOUNDS):
         self._metrics: dict[str, Counter | Gauge | Histogram] = {}
         self._default_buckets = tuple(default_buckets)
+        self._build_info: dict[str, str] = {}
         self._lock = threading.Lock()
+
+    def set_build_info(self, labels: dict) -> None:
+        """Install the ``build_info`` exposition family (git sha, jax
+        version, platform — obs/device.py ``build_info()``): a constant-1
+        gauge whose LABELS carry the identity, the standard Prometheus
+        version-attribution idiom, so a scraped fleet can group replicas by
+        exactly what they run. Also served verbatim in ``/varz``."""
+        with self._lock:
+            self._build_info = {str(k): str(v) for k, v in labels.items()}
+
+    @property
+    def build_info(self) -> dict:
+        return dict(self._build_info)
 
     def _get(self, name: str, cls, *args):
         with self._lock:
@@ -304,8 +318,15 @@ class MetricsRegistry:
         Stdlib-only, no client library."""
         with self._lock:
             metrics = dict(self._metrics)
+            binfo = dict(self._build_info)
         lines: list[str] = []
         typed: set[str] = set()
+        if binfo:
+            labels = ",".join(
+                f'{_prom_name(k)}="{v}"' for k, v in sorted(binfo.items())
+            )
+            lines.append("# TYPE build_info gauge")
+            lines.append(f"build_info{{{labels}}} 1")
 
         def _type_line(fam: str, kind: str) -> None:
             if fam not in typed:

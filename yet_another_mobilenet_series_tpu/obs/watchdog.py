@@ -139,6 +139,12 @@ class StallWatchdog:
                 info[name] = fn()
             except Exception as e:  # noqa: BLE001 — a dying provider must not kill the report
                 info[name] = f"provider failed: {type(e).__name__}: {e}"
+        # the device-side compile/cost table (obs/device.py): a hang during
+        # or right after a compile names WHICH executable was last built and
+        # what the compiler said it costs — memory gauges ride in the
+        # registry snapshot below
+        from .device import compile_report
+
         report = {
             "seconds_since_last_beat": elapsed_s,
             "deadline_s": self.deadline_s,
@@ -146,6 +152,7 @@ class StallWatchdog:
             "last_phase": self._phase,
             "open_spans": self._tracer.open_spans() if self._tracer is not None else [],
             "registry": self._registry.snapshot() if self._registry is not None else {},
+            "executables": compile_report(),
             "threads": threads,
             "info": info,
         }

@@ -78,7 +78,12 @@ host), ``serve.run_seconds`` (predict start -> result done),
 chunks they covered), ``serve.evicted_executables``,
 ``serve.infer_images`` / ``serve.padded_rows`` / per-bucket hit counters;
 ``serve/stage`` + ``serve/dispatch`` + ``serve/dispatch_fused`` +
-``serve/complete`` spans.
+``serve/complete`` spans. Device telemetry (obs/device.py): every compile
+goes through ``timed_compile`` (``obs.compile_seconds``/``obs.compiles`` +
+per-executable ``obs.cost_flops.*``/``obs.cost_bytes.*`` cost_analysis
+gauges), every dispatch feeds ``serve.dispatched_flops``, and the derived
+``serve.achieved_flops_per_s`` gauge is cost FLOPs ÷ measured
+``serve.run_seconds`` — dispatch efficiency.
 """
 
 from __future__ import annotations
@@ -93,6 +98,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.specs import Network
+from ..obs import device as obs_device
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..parallel import mesh as mesh_lib
@@ -108,6 +114,12 @@ BF16_PARITY_ATOL = 0.35
 
 def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
+
+
+def _cost_key(bucket: int, size: int, k: int) -> str:
+    """Registry-safe executable key for the per-key cost gauges
+    (``obs.cost_flops.serve_b8_s224_k1``) and the hang report's table."""
+    return f"serve_b{bucket}_s{size}_k{k}"
 
 
 class PendingPrediction:
@@ -230,6 +242,10 @@ class InferenceEngine:
         # guards _compiled/_staging/_offladder mutation + LRU bookkeeping
         self._cache_lock = threading.Lock()
         self._reg = get_registry()
+        # device telemetry (obs/device.py, both idempotent): memory pull
+        # gauges + the achieved-FLOPS dispatch-efficiency gauge
+        obs_device.install_memory_gauges(self._reg)
+        obs_device.install_dispatch_efficiency_gauge(self._reg)
 
     # -- compilation --------------------------------------------------------
 
@@ -268,7 +284,13 @@ class InferenceEngine:
         fn = jax.jit(run, donate_argnums=(1,) if self._donate else (), **kwargs)
         t0 = time.perf_counter()
         with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket, image_size=size, k=k):
-            compiled = fn.lower(self._params, x_shape).compile()
+            # obs/device.py: compile time -> obs.compile_seconds/obs.compiles,
+            # cost_analysis flops/bytes -> per-executable obs.cost_* gauges —
+            # every warmed executable is cost-accounted in the obs snapshot
+            compiled = obs_device.timed_compile(
+                fn.lower(self._params, x_shape), _cost_key(bucket, size, k),
+                registry=self._reg,
+            )
         self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
         return compiled
 
@@ -413,6 +435,17 @@ class InferenceEngine:
             self._reg.counter("serve.fused_dispatches").inc()
             self._reg.counter("serve.fused_chunks").inc(k)
         self._reg.counter(f"serve.bucket_hits.{bucket}").inc(k)
+        # cost-analysis FLOPs this dispatch put on the device: the numerator
+        # of serve.achieved_flops_per_s (dispatch efficiency, obs/device.py).
+        # XLA costs a lax.scan body ONCE, but the fused program runs the same
+        # per-chunk forward k times — account k x the per-chunk cost.
+        flops = obs_device.flops_for(_cost_key(bucket, size, k))
+        if k > 1:
+            per_chunk = obs_device.flops_for(_cost_key(bucket, size, 1))
+            if per_chunk:
+                flops = per_chunk * k
+        if flops:
+            self._reg.counter("serve.dispatched_flops").inc(flops)
         return logits, rows
 
     def predict_async(self, images: np.ndarray, ctxs=None) -> PendingPrediction:

@@ -30,6 +30,15 @@ Endpoints:
     while the breaker is closed or half-open, ``503`` while open — a load
     balancer can drain a sick replica from rotation without parsing JSON.
 
+``POST /profile/start`` / ``POST /profile/stop``
+    HTTP-triggered ``jax.profiler`` capture of LIVE serving traffic
+    (obs/device.py :class:`~..obs.device.ProfilerCapture`): start opens an
+    xplane trace window under the configured trace dir, stop closes it and
+    returns the dir + captured seconds for scripts/trace_ops.py aggregation.
+    Single-flight: a second start (or a stop with no capture open) is
+    ``409``; a window still open at SIGTERM is closed by the drain path
+    (cli/serve.py), never leaked. ``404`` when no profiler is configured.
+
 The server is a ``ThreadingHTTPServer`` bound to loopback by default
 (``cli/serve.py --listen``); its accept loop runs on a guarded daemon
 thread (YAMT011). ``stop()`` shuts the accept loop down and returns — the
@@ -136,12 +145,17 @@ class _Handler(BaseHTTPRequestHandler):
     def _get_varz(self) -> None:
         """JSON twin of /metrics for humans and tests: the full registry
         snapshot (histograms expanded with min/max/p50/p95/p99) plus the
-        admission state and the oldest in-flight request."""
+        admission state, the oldest in-flight request, build identity, and
+        the per-executable compile/cost table (obs/device.py)."""
+        from ..obs.device import compile_report
+
         fe = self.frontend
         self._send_json(200, {
             "metrics": get_registry().snapshot(),
             "admission": fe.admission.state(),
             "draining": fe._draining,
+            "build_info": get_registry().build_info,
+            "executables": compile_report(),
         })
 
     # -- POST /predict ------------------------------------------------------
@@ -172,7 +186,28 @@ class _Handler(BaseHTTPRequestHandler):
             raise ValueError(f"image must be (H, W, C), got shape {tuple(image.shape)}")
         return image
 
+    def _post_profile(self) -> None:
+        """Start/stop the serving profiler capture (obs/device.py). State
+        errors (already running / nothing to stop) are 409 so an operator's
+        double-tap is loud but harmless; jax.profiler failures are 500."""
+        fe = self.frontend
+        if fe.profiler is None:
+            self._send_error_json(404, "not_found", "no profiler configured (set a log dir)")
+            return
+        try:
+            out = fe.profiler.start() if self.path == "/profile/start" else fe.profiler.stop()
+        except RuntimeError as e:
+            self._send_error_json(409, "profiler_state", str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — a torn capture surfaces typed
+            self._send_error_json(500, "profiler_error", f"{type(e).__name__}: {e}")
+            return
+        self._send_json(200, {"ok": True, **out})
+
     def do_POST(self):  # noqa: N802 — stdlib method name
+        if self.path in ("/profile/start", "/profile/stop"):
+            self._post_profile()
+            return
         if self.path != "/predict":
             self._send_error_json(404, "not_found", f"no route {self.path}")
             return
@@ -244,8 +279,11 @@ class Frontend:
         port: int = 0,
         request_timeout_s: float = 60.0,
         retry_after_s: float = 1.0,
+        profiler=None,
     ):
         self.admission = admission
+        # obs/device.py ProfilerCapture (or None): POST /profile/start|stop
+        self.profiler = profiler
         self._host = host
         self._port = port
         self.request_timeout_s = request_timeout_s
