@@ -139,6 +139,8 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
             for h, label in (("serve.queue_wait_seconds", "queue wait"),
                              ("serve.run_seconds", "run latency"),
                              ("serve.dispatch_seconds", "dispatch"),
+                             ("serve.h2d_seconds", "h2d transfer"),
+                             ("serve.slot_wait_seconds", "slot fence wait"),
                              ("serve.dispatch_to_complete_seconds", "dispatch->complete")):
                 if snap.get(f"{h}.count"):
                     lines.append(
@@ -169,6 +171,22 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
                 lines.append(
                     f"  off-ladder executables evicted: "
                     f"{snap['serve.evicted_executables']:.0f} (LRU bound)"
+                )
+            if snap.get("serve.dispatches_per_wakeup.count"):
+                lines.append(
+                    "  dispatches/wakeup: mean {:.2f}, max {:.0f} over {:.0f} "
+                    "wake-ups (> 1 = back-to-back runs engaged)".format(
+                        snap["serve.dispatches_per_wakeup.mean"],
+                        snap["serve.dispatches_per_wakeup.max"],
+                        snap["serve.dispatches_per_wakeup.count"])
+                )
+            if snap.get("serve.dispatched_bytes"):
+                lines.append(
+                    "  dispatched cost: {:.2f} GFLOP, {:.2f} GB accessed "
+                    "(achieved {:.3g} FLOP/s)".format(
+                        snap.get("serve.dispatched_flops", 0) / 1e9,
+                        snap["serve.dispatched_bytes"] / 1e9,
+                        snap.get("serve.achieved_flops_per_s", 0))
                 )
             # the QoS/resilience edge (serve/admission.py) — per-class
             # accounting + breaker/retry/drain health, when it was in play

@@ -26,7 +26,20 @@ writes it to a BENCH_SERVE_*.json via --out. Four measurements per run:
    QPS, speedup, and the bitwise-parity check; plus the CPU-rehearsal caveat
    recorded in the artifact (on 1 core the dispatch boundary is nearly free,
    so the speedup may be ~flat — the dispatch-count drop is the pinned win).
-5. **chaos A/B** — an OPEN-LOOP Poisson load generator (arrivals fire on
+5. **structural sweep** (``--structural``) — ONE interleaved sweep across
+   the four serving structures at a saturated bucket: **sync** (blocking
+   collect->predict cycle), **pipelined** (async in-flight window),
+   **fused** (coalesced overflow rides the lax.scan executables), and
+   **overlapped** (fence-tracked slot staging with async H2D + back-to-back
+   runs: > 1 dispatch per completion wake-up, serve/pipeline.py). Rounds
+   interleave mode-by-mode so box drift hits all four alike; per mode the
+   row carries median QPS, fill, dispatches/request, the
+   ``serve.dispatches_per_wakeup`` registry delta (the back-to-back
+   structural claim — None for sync, 1.0 for per-batch pipelining), the
+   steady-state ``serve.achieved_flops_per_s`` window (dispatched cost
+   FLOPs ÷ measured run seconds) next to the single-dispatch reference,
+   and registry-math latency quantiles. Emits the BENCH_SERVE_r05 shape.
+6. **chaos A/B** — an OPEN-LOOP Poisson load generator (arrivals fire on
    schedule regardless of completions — closed loops hide overload) drives
    mixed priorities (interactive/batch/best_effort via serve/admission.py)
    and mixed image sizes through the pipelined batcher twice: a healthy
@@ -47,6 +60,7 @@ Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--image-sizes 224] [--buckets 1,8,32] [--iters 10]
            [--concurrent-iters 6] [--ab-iters 5] [--no-bf16]
            [--fused] [--fuse-ladder 2,4] [--fused-iters 8]
+           [--structural] [--structural-rounds 3]
            [--chaos-requests 80] [--chaos-qps 0] [--chaos-fault-rate 0.05]
            [--no-chaos] [--out f.json]
 """
@@ -277,6 +291,162 @@ def _fused_ab(chained, fused, size, iters, rng):
     }
 
 
+_STRUCTURAL_CPU_CAVEAT = (
+    "cpu_rehearsal: host staging/collect work and XLA 'device' compute share "
+    "the core(s) on this box, so overlapped staging and back-to-back dispatch "
+    "cannot add throughput here (QPS columns may be ~flat or slightly "
+    "negative). The pinned structural wins are dispatches_per_wakeup > 1 on "
+    "the saturated bucket, bitwise-identical logits, and the dispatch/ "
+    "transfer accounting; the throughput claim is an accelerator measurement "
+    "— ROADMAP item 3's hardware rung, same caveat discipline as r02/r04."
+)
+
+
+def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
+                      staging_slots, run_max, fuse_ladder, rng):
+    """One interleaved sweep across the four serving structures on a
+    saturated bucket (docs/SERVING.md "Overlapped staging"):
+
+    - ``sync``       MicroBatcher: blocking collect -> predict -> resolve
+    - ``pipelined``  PipelinedBatcher(run_max=1), chained engine
+    - ``fused``      PipelinedBatcher(run_max=1), fused-scan engine
+    - ``overlapped`` PipelinedBatcher(run_max), overlapped-staging fused
+                     engine — the device-resident steady state
+
+    All share ``max_batch = 2 * max_bucket`` so every saturated coalesced
+    group exceeds the biggest bucket (the fused/overlapped modes serve it
+    as ONE engine call). Rounds interleave mode-by-mode so box drift hits
+    all four alike; median-of-rounds QPS like the r02 A/B. Per mode the
+    row also carries the registry-delta instruments the structural claims
+    are read from: dispatches/request, dispatches-per-wakeup (None for
+    sync — the MicroBatcher has no completion thread), steady-state
+    achieved FLOPs/s, and the same window's bucketed latency quantiles."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.obs import device as obs_device
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.batcher import MicroBatcher
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+    reg = get_registry()
+    eng_chained = make_engine("float32")
+    eng_fused = make_engine("float32", fuse=fuse_ladder)
+    eng_overlap = make_engine("float32", fuse=fuse_ladder, overlap=True,
+                              staging_slots=staging_slots)
+    for e in (eng_chained, eng_fused, eng_overlap):
+        e.warmup()
+    cap = eng_chained.buckets[-1]
+    max_batch = 2 * cap
+    # saturation by construction: with the window holding 2 full batches in
+    # flight, 3 x max_batch closed-loop clients keep >= max_batch requests
+    # queued — the back-to-back condition — for the whole round
+    n_clients = 3 * max_batch
+    n_requests = min(max(conc_iters * max_batch, 2 * n_clients), 384)
+    image = rng.normal(0, 1, (size, size, 3)).astype("float32")
+    # bitwise parity across the whole structural ladder, one oversized batch
+    xp = rng.normal(0, 1, (max_batch, size, size, 3)).astype("float32")
+    ref = eng_chained.predict(xp)
+    bitwise_ok = bool(
+        np.array_equal(eng_fused.predict(xp), ref)
+        and np.array_equal(eng_overlap.predict(xp), ref)
+    )
+    # single-dispatch reference for the efficiency column: cost FLOPs of the
+    # full max bucket over its measured direct latency (one warm predict)
+    xb = rng.normal(0, 1, (cap, size, size, 3)).astype("float32")
+    eng_chained.predict(xb)
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        eng_chained.predict(xb)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    flops_1 = obs_device.flops_for(f"serve_b{cap}_s{size}_k1")
+    single_dispatch_ref = flops_1 / _percentile(lat, 0.5) if lat[0] > 0 else 0.0
+
+    common = dict(max_batch=max_batch, max_wait_ms=10.0, queue_depth=max(256, 8 * max_batch))
+    batchers = {
+        "sync": MicroBatcher(eng_chained.predict, **common).start(),
+        "pipelined": PipelinedBatcher(eng_chained, max_inflight=max_inflight, **common).start(),
+        "fused": PipelinedBatcher(eng_fused, max_inflight=max_inflight, **common).start(),
+        "overlapped": PipelinedBatcher(
+            eng_overlap, max_inflight=max_inflight, run_max=run_max, **common
+        ).start(),
+    }
+    runs = {m: [] for m in batchers}  # per round: (qps, lat, deltas dict)
+    try:
+        for b in batchers.values():  # warm every path off the measured window
+            _drive_concurrent(b, image, min(2 * max_batch, n_requests), n_clients)
+        for _ in range(rounds):
+            for mode, b in batchers.items():
+                run_counts0 = _hist_counts("serve.run_seconds")
+                s0 = reg.snapshot()
+                qps, lat = _drive_concurrent(b, image, n_requests, n_clients)
+                s1 = reg.snapshot()
+                d = {k: s1.get(k, 0) - s0.get(k, 0) for k in (
+                    "serve.dispatch_seconds.count", "serve.batch_size.count",
+                    "serve.batch_size.sum", "serve.dispatches_per_wakeup.count",
+                    "serve.dispatches_per_wakeup.sum", "serve.dispatched_flops",
+                    "serve.dispatched_bytes", "serve.run_seconds.sum",
+                )}
+                d["registry_q"] = _hist_delta_quantiles("serve.run_seconds", run_counts0)
+                runs[mode].append((qps, lat, d))
+    finally:
+        for b in batchers.values():
+            b.stop()
+    modes = {}
+    for mode, rows in runs.items():
+        ordered = sorted(rows, key=lambda r: r[0])
+        med_qps, med_lat, _ = ordered[len(ordered) // 2]
+        # instruments sum over ALL rounds: the steady-state windows, not one
+        # lucky round, back the structural claims
+        tot = {k: sum(r[2][k] for r in rows) for k in rows[0][2] if k != "registry_q"}
+        reg_q = ordered[len(ordered) // 2][2]["registry_q"]
+        dispatches = tot["serve.dispatch_seconds.count"]
+        batches = tot["serve.batch_size.count"]
+        wakeups = tot["serve.dispatches_per_wakeup.count"]
+        modes[mode] = {
+            "qps": round(med_qps, 2),
+            "qps_rounds": [round(q, 2) for q, _, _ in rows],
+            "p99_ms": round(_percentile(med_lat, 0.99) * 1e3, 3),
+            "p50_ms_registry": reg_q["p50_ms"],
+            "p99_ms_registry": reg_q["p99_ms"],
+            "avg_fill": round(tot["serve.batch_size.sum"] / batches / max_batch, 3) if batches else 0.0,
+            "dispatches_per_request": round(dispatches / (rounds * n_requests), 4),
+            # None for sync: the MicroBatcher has no completion wake-ups
+            "dispatches_per_wakeup": (
+                round(tot["serve.dispatches_per_wakeup.sum"] / wakeups, 4) if wakeups else None
+            ),
+            "dispatched_gflops": round(tot["serve.dispatched_flops"] / 1e9, 3),
+            "dispatched_gbytes": round(tot["serve.dispatched_bytes"] / 1e9, 3),
+            # the steady-state dispatch-efficiency window (the same math the
+            # serve.achieved_flops_per_s pull gauge exposes, but delta-scoped
+            # to this mode's rounds)
+            "achieved_flops_per_s": round(
+                tot["serve.dispatched_flops"] / tot["serve.run_seconds.sum"], 1
+            ) if tot["serve.run_seconds.sum"] > 0 else 0.0,
+        }
+    return {
+        "image_size": size,
+        "max_bucket": cap,
+        "max_batch": max_batch,
+        "clients": n_clients,
+        "requests_per_round": n_requests,
+        "rounds": rounds,
+        "max_inflight": max_inflight,
+        "run_max": run_max,
+        "staging_slots": staging_slots,
+        "fuse_ladder": list(fuse_ladder),
+        "bitwise_ok": bitwise_ok,
+        "single_dispatch_achieved_flops_per_s": round(single_dispatch_ref, 1),
+        "modes": modes,
+        "overlapped_speedup_vs_sync": (
+            round(modes["overlapped"]["qps"] / modes["sync"]["qps"], 4)
+            if modes["sync"]["qps"] else None
+        ),
+        "cpu_rehearsal_note": _STRUCTURAL_CPU_CAVEAT,
+    }
+
+
 _CHAOS_CLASS_MIX = {"interactive": 0.5, "batch": 0.3, "best_effort": 0.2}
 
 
@@ -430,7 +600,7 @@ def _chaos_ab(engine, image_sizes, direct_rows, *, seed, n_requests, target_qps,
 
 def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_inflight, with_bf16,
             chaos_requests=0, chaos_qps=0.0, chaos_fault_rate=0.05, chaos_seed=0,
-            fuse_ladder=(), fused_iters=8):
+            fuse_ladder=(), fused_iters=8, structural=False, structural_rounds=3):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -459,10 +629,11 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
     )
     bundle = InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
 
-    def make_engine(dtype, fuse=()):
+    def make_engine(dtype, fuse=(), overlap=False, staging_slots=2):
         return InferenceEngine(bundle, buckets=buckets, compute_dtype=dtype,
                                image_size=base_size, image_sizes=image_sizes,
-                               fuse_ladder=fuse)
+                               fuse_ladder=fuse, overlap_staging=overlap,
+                               staging_slots=staging_slots)
 
     # the baseline engine stays CHAINED (fuse_ladder=()) so direct /
     # concurrent / chaos rows keep their r01-r03 meaning; the fused engine
@@ -519,6 +690,12 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
         eng_fused = make_engine("float32", fuse=fuse_ladder)
         eng_fused.warmup()
         ab["fused_vs_chained"] = _fused_ab(engine, eng_fused, base_size, fused_iters, rng)
+    if structural:
+        ab["structural_sweep"] = _structural_sweep(
+            make_engine, base_size, rounds=max(1, structural_rounds),
+            conc_iters=conc_iters, max_inflight=max_inflight, staging_slots=2,
+            run_max=4, fuse_ladder=fuse_ladder or (2, 4), rng=rng,
+        )
     chaos = None
     if chaos_requests > 0:
         chaos = _chaos_ab(
@@ -584,6 +761,12 @@ def main(argv=None) -> int:
                     help="chunk-count ladder for the fused engine (serve.fuse_chunks.ladder)")
     ap.add_argument("--fused-iters", type=int, default=8,
                     help="timed whole-request predicts per K and mode in the fused A/B")
+    ap.add_argument("--structural", action="store_true",
+                    help="run the interleaved structural sweep: sync vs pipelined vs "
+                         "fused vs overlapped on a saturated bucket (dispatches-per-"
+                         "wakeup + steady-state achieved-FLOPS deltas — the r05 shape)")
+    ap.add_argument("--structural-rounds", type=int, default=3,
+                    help="interleaved rounds per mode in the structural sweep")
     ap.add_argument("--chaos-requests", type=int, default=80,
                     help="open-loop Poisson requests per chaos round (healthy + faulty)")
     ap.add_argument("--chaos-qps", type=float, default=0.0,
@@ -616,7 +799,9 @@ def main(argv=None) -> int:
                     chaos_qps=args.chaos_qps, chaos_fault_rate=args.chaos_fault_rate,
                     chaos_seed=args.chaos_seed,
                     fuse_ladder=tuple(int(k) for k in args.fuse_ladder.split(",")) if args.fused else (),
-                    fused_iters=max(1, args.fused_iters))
+                    fused_iters=max(1, args.fused_iters),
+                    structural=args.structural,
+                    structural_rounds=args.structural_rounds)
         out.update(m)
         out["value"] = m["peak_qps"]
     except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0

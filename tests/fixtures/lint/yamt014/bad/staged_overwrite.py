@@ -1,0 +1,26 @@
+"""YAMT014 bad fixture: host staging buffers rewritten while their async
+jax.device_put transfer may still be reading them."""
+
+import jax
+import numpy as np
+
+
+def staging_loop(batches):
+    # the canonical staging-loop hazard: the transfer at the bottom of one
+    # iteration races the rewrite at the top of the next (flagged on the
+    # rule's second loop pass)
+    buf = np.zeros((8, 32, 32, 3), np.float32)
+    outs = []
+    for batch in batches:
+        buf[: len(batch)] = batch
+        outs.append(jax.device_put(buf))
+    return outs
+
+
+def stage_two(a, b):
+    buf = np.empty((4, 8), np.float32)
+    buf[:] = a
+    xa = jax.device_put(buf)
+    buf[:] = b  # overwrites while xa's transfer may be in flight
+    xb = jax.device_put(buf)
+    return xa, xb

@@ -1,0 +1,36 @@
+"""YAMT014 clean fixture: the sanctioned fence idiom — a staging buffer is
+rewritten only after its last transfer is known complete
+(serve/engine.py ``_SlotPool``)."""
+
+import jax
+import numpy as np
+
+
+def staging_loop(batches):
+    # fence idiom: wait on the previous transfer (or its consumer's
+    # outputs) before rewriting the buffer it read from
+    buf = np.zeros((8, 32, 32, 3), np.float32)
+    fence = None
+    outs = []
+    for batch in batches:
+        if fence is not None:
+            jax.block_until_ready(fence)
+        buf[: len(batch)] = batch
+        fence = jax.device_put(buf)
+        outs.append(fence)
+    return outs
+
+
+def stage_two(a, b):
+    buf = np.empty((4, 8), np.float32)
+    buf[:] = a
+    xa = jax.device_put(buf)
+    xa.block_until_ready()
+    buf[:] = b
+    xb = jax.device_put(buf)
+    return xa, xb
+
+
+def fresh_buffer_per_transfer(batches):
+    # no reuse, no hazard: each transfer gets its own buffer
+    return [jax.device_put(np.ascontiguousarray(b)) for b in batches]

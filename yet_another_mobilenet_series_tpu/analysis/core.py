@@ -243,6 +243,7 @@ def load_rules() -> list[Rule]:
     """Import every rule module (registration side effect) and return the
     registry sorted by id."""
     from . import (  # noqa: F401
+        rules_async_staging,
         rules_config,
         rules_donation,
         rules_imports,

@@ -1,0 +1,209 @@
+"""YAMT014 — host buffer mutated under an async ``jax.device_put`` transfer.
+
+``jax.device_put`` may return BEFORE the device has read the host buffer
+(that is the point: the H2D copy overlaps compute). Rewriting the buffer
+after handing it to ``device_put`` with no intervening sync therefore races
+the transfer: on backends where the copy really is asynchronous the device
+reads TORN data — silently, and only under load, which is the worst kind of
+serving bug. The live hazard is exactly the serving engine's staging-slot
+reuse (serve/engine.py): the sync ``jnp.asarray`` copy that used to make
+reuse safe was replaced by async ``device_put``, and the invariant moved
+into an explicit fence — a slot's buffer is rewritten only after its last
+transfer is KNOWN complete (``_SlotPool.acquire`` blocks on
+``jax.block_until_ready`` of the consuming dispatch's outputs). This rule
+pins that discipline wherever the idiom is written inline.
+
+A buffer name passed positionally to ``jax.device_put`` is *in transfer*
+until a **ready check** — a ``jax.block_until_ready(...)`` call or any
+``.block_until_ready()`` method call (a global sync point: every pending
+transfer is done once ANYTHING later-enqueued is ready) — or until the name
+is rebound or deleted. While in transfer, a mutation of the buffer flags:
+
+- subscript stores (``buf[:n] = rows``, ``buf[i] += x``),
+- in-place augmented assignment (``buf += x`` mutates numpy arrays),
+- mutating method calls (``buf.fill/put/sort/resize/partition``),
+- ``np.copyto(buf, ...)``.
+
+Flow handling is deliberately simple — statements are scanned in source
+order within one function (nested defs/lambdas are their own scope, a
+caller's sync is invisible), and loop bodies are walked twice so a transfer
+at the bottom of an iteration reaches a rewrite at the top of the next (the
+canonical staging-loop shape). Branches are not forked: a ready check on
+any earlier line is credited, so the guarded first-iteration idiom
+(``if fence is not None: jax.block_until_ready(fence)``) stays clean. The
+split producer/consumer shape — mutate in one function, transfer in
+another, fence waited in a third (the engine's slot pool) — is out of a
+function-local rule's sight by design: the pool class IS the sanctioned
+carrier of that invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+
+_MUTATING_METHODS = {"fill", "put", "sort", "resize", "partition", "setfield"}
+
+
+def _iter_nodes(node: ast.AST):
+    """Depth-first pre-order traversal (≈ source order) that does NOT
+    descend into nested scopes — their buffers are their own problem."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        yield child
+        yield from _iter_nodes(child)
+
+
+def _sub_name(target: ast.expr) -> str | None:
+    """``buf`` of a ``buf[...]`` store target."""
+    if isinstance(target, ast.Subscript) and isinstance(target.value, ast.Name):
+        return target.value.id
+    return None
+
+
+class _Scanner:
+    """Linear event interpreter for one scope: transfer marks, ready-check
+    clears, mutation findings (deduped by location for the double loop
+    pass)."""
+
+    def __init__(self, rule: "AsyncStagingMutation", src: SourceFile):
+        self.rule = rule
+        self.src = src
+        self.marks: dict[str, int] = {}  # buffer name -> device_put line
+        self.out: dict[tuple, Finding] = {}
+
+    def run(self, stmts) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+            self._exprs(st.test if isinstance(st, ast.While) else st.iter)
+            # two passes: a transfer at the bottom of the body reaches a
+            # rewrite at the top of the next iteration
+            for _ in range(2):
+                for s in st.body:
+                    self._stmt(s)
+            for s in st.orelse:
+                self._stmt(s)
+            return
+        if isinstance(st, (ast.If, ast.Try, ast.With, ast.AsyncWith)):
+            # linear, not forked: bodies scanned in source order (a ready
+            # check in an earlier branch is credited — see module docstring)
+            if isinstance(st, ast.If):
+                self._exprs(st.test)
+                blocks = [st.body, st.orelse]
+            elif isinstance(st, ast.Try):
+                blocks = [st.body, *[h.body for h in st.handlers], st.orelse, st.finalbody]
+            else:
+                for item in st.items:
+                    self._exprs(item.context_expr)
+                blocks = [st.body]
+            for block in blocks:
+                for s in block:
+                    self._stmt(s)
+            return
+        if isinstance(st, ast.Assign):
+            self._exprs(st.value)
+            for t in st.targets:
+                self._store(t)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._exprs(st.value)
+            if isinstance(st.target, ast.Name):
+                # numpy `buf += x` mutates in place, then rebinds the name
+                self._mutate(st.target.id, st.target)
+                self.marks.pop(st.target.id, None)
+            else:
+                self._store(st.target)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    self.marks.pop(t.id, None)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._exprs(child)
+
+    def _store(self, target: ast.expr) -> None:
+        if isinstance(target, ast.Name):
+            self.marks.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._store(el)
+        elif isinstance(target, ast.Starred):
+            self._store(target.value)
+        else:
+            name = _sub_name(target)
+            if name is not None:
+                self._mutate(name, target)
+
+    def _exprs(self, expr: ast.expr | None) -> None:
+        if expr is None:
+            return
+        for node in [expr, *_iter_nodes(expr)]:
+            if not isinstance(node, ast.Call):
+                continue
+            q = qualified_name(node.func, self.src.aliases) or ""
+            if q == "jax.device_put":
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self.marks[node.args[0].id] = node.lineno
+            elif q == "jax.block_until_ready" or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "block_until_ready"
+            ):
+                # a global sync point: everything enqueued before it —
+                # including every pending H2D transfer — is complete
+                self.marks.clear()
+            elif q in ("np.copyto", "numpy.copyto"):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    self._mutate(node.args[0].id, node.args[0])
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATING_METHODS
+                and isinstance(node.func.value, ast.Name)
+            ):
+                self._mutate(node.func.value.id, node)
+
+    def _mutate(self, name: str, node: ast.AST) -> None:
+        line = self.marks.get(name)
+        if line is None:
+            return
+        f = Finding(
+            self.src.path, node.lineno, node.col_offset, self.rule.id,
+            f"'{name}' mutated after being passed to jax.device_put (line {line}) "
+            "with no intervening ready check: the async H2D transfer may still "
+            "be reading the buffer — wait on a fence (jax.block_until_ready of "
+            "the transfer or its consumer's outputs) before rewriting it",
+        )
+        self.out.setdefault((f.line, f.col, name), f)
+
+
+@register
+class AsyncStagingMutation(Rule):
+    id = "YAMT014"
+    name = "async-staging-mutation"
+    description = (
+        "host buffer mutated after being passed to an async jax.device_put with "
+        "no intervening sync/ready check: the transfer may still be reading the "
+        "buffer, so the device can observe torn data (serve/engine.py's slot "
+        "fence is the sanctioned idiom)"
+    )
+
+    def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        scopes: list[ast.AST] = [src.tree]
+        scopes += [
+            n for n in ast.walk(src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            scanner = _Scanner(self, src)
+            scanner.run(scope.body)
+            findings.extend(scanner.out.values())
+        return findings

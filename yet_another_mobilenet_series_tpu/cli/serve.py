@@ -143,7 +143,14 @@ def _make_batcher(cfg: Config, engine) -> MicroBatcher:
         drain_timeout_s=cfg.serve.drain_timeout_s,
     )
     if cfg.serve.pipelined:
-        return PipelinedBatcher(engine, max_inflight=cfg.serve.max_inflight, **common)
+        return PipelinedBatcher(
+            engine,
+            max_inflight=cfg.serve.max_inflight,
+            # back-to-back dispatch rides the overlap block: a saturated
+            # bucket dispatches runs with one completion wake-up per run
+            run_max=cfg.serve.overlap.run_max if cfg.serve.overlap.enable else 1,
+            **common,
+        )
     return MicroBatcher(engine.predict, **common)
 
 
@@ -274,6 +281,8 @@ def run(cfg: Config) -> dict:
             image_sizes=cfg.serve.image_sizes,
             fuse_ladder=cfg.serve.fuse_chunks.ladder if cfg.serve.fuse_chunks.enable else (),
             offladder_cache=cfg.serve.offladder_cache,
+            overlap_staging=cfg.serve.overlap.enable,
+            staging_slots=cfg.serve.overlap.staging_slots,
         )
         if cfg.serve.warmup:
             t0 = time.perf_counter()

@@ -503,6 +503,27 @@ class FuseChunksConfig:
 
 
 @dataclass(frozen=True)
+class OverlapConfig:
+    """Overlapped staging + back-to-back dispatch (serve/engine.py,
+    serve/pipeline.py): the device-resident serving steady state. The H2D
+    transfer of batch N+1 overlaps compute of batch N via a fence-tracked
+    pool of staging slots filled with async jax.device_put (a slot's host
+    buffer is rewritten only after its last transfer is KNOWN complete), and
+    a saturated bucket dispatches runs of pre-staged batches with no host
+    wake-up between dispatches — the completion thread syncs only the run's
+    tail (serve.dispatches_per_wakeup; docs/SERVING.md)."""
+
+    enable: bool = True
+    # host staging buffers per (bucket, size, K) key; >= max_inflight keeps
+    # the fence wait (serve.slot_wait_seconds) at ~0
+    staging_slots: int = 2
+    # back-to-back run cap: batches the collect thread may dispatch per
+    # completion wake-up on a saturated bucket (the window still bounds
+    # device-side memory); 1 = per-batch wake-ups, the pre-overlap behavior
+    run_max: int = 4
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Inference serving (serve/, docs/SERVING.md): export a checkpoint to a
     folded InferenceBundle and/or serve a bundle through the AOT-batched
@@ -558,6 +579,9 @@ class ServeConfig:
     offladder_cache: int = 8
     # fused multi-chunk dispatch: whole-request inference in one dispatch
     fuse_chunks: FuseChunksConfig = field(default_factory=FuseChunksConfig)
+    # overlapped staging + back-to-back dispatch: the device-resident
+    # steady state (async H2D slot pool; saturated buckets dispatch runs)
+    overlap: OverlapConfig = field(default_factory=OverlapConfig)
     # HTTP front door / admission control / fault injection sub-blocks
     listen: ListenConfig = field(default_factory=ListenConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
@@ -635,6 +659,7 @@ _SECTION_TYPES = {
     "AdmissionConfig": AdmissionConfig,
     "FaultsConfig": FaultsConfig,
     "FuseChunksConfig": FuseChunksConfig,
+    "OverlapConfig": OverlapConfig,
     "ServeConfig": ServeConfig,
     "Config": Config,
 }

@@ -18,7 +18,9 @@ watchdog hang report for free:
   flops/bytes land in per-key ``obs.cost_flops.<key>`` /
   ``obs.cost_bytes.<key>`` gauges plus the :func:`compile_report` table the
   hang report embeds. The engine feeds dispatched-executable flops into
-  ``serve.dispatched_flops``, and :func:`install_dispatch_efficiency_gauge`
+  ``serve.dispatched_flops`` and the matching cost bytes into
+  ``serve.dispatched_bytes`` (the transfer-side twin, via :func:`bytes_for`),
+  and :func:`install_dispatch_efficiency_gauge`
   derives ``serve.achieved_flops_per_s`` = dispatched cost FLOPs ÷ measured
   ``serve.run_seconds`` — the "how much of the paper FLOPs did the wall
   clock actually deliver" number ROADMAP item 3's latency work keys on.
@@ -127,6 +129,15 @@ def flops_for(key: str) -> float:
     backend reported none) — the engine's per-dispatch accounting lookup."""
     with _COSTS_LOCK:
         return float(_COSTS.get(key, {}).get("flops", 0.0))
+
+
+def bytes_for(key: str) -> float:
+    """Recorded cost-analysis bytes-accessed of executable ``key`` (0.0 when
+    the backend reported none) — the transfer-side twin of :func:`flops_for`:
+    the engine joins it to every dispatch as ``serve.dispatched_bytes``, the
+    number the staging-overlap win is read against (docs/SERVING.md)."""
+    with _COSTS_LOCK:
+        return float(_COSTS.get(key, {}).get("bytes", 0.0))
 
 
 def compile_report() -> dict:

@@ -285,6 +285,13 @@ class MicroBatcher:
         if first is _STOP:
             return None
         batch = [first]
+        self._linger_fill(batch)
+        return batch
+
+    def _linger_fill(self, batch: list[_Request]) -> None:
+        """Top ``batch`` up from the queue until max_batch or max_wait_s of
+        linger, whichever first — the shared coalescing policy (also used by
+        the pipelined back-to-back path when a drain comes up short)."""
         t_close = time.perf_counter() + self._max_wait_s
         while len(batch) < self._max_batch:
             remaining = t_close - time.perf_counter()
@@ -300,7 +307,6 @@ class MicroBatcher:
                 self._exit_after_batch = True
                 break
             batch.append(nxt)
-        return batch
 
     def _shed_expired(self, batch: list[_Request]) -> list[_Request]:
         """Dispatch-time deadline check: fail expired requests, record queue

@@ -41,12 +41,39 @@ the cached array). ``predict`` is literally ``predict_async(...).result()``,
 so the two paths share one executable cache and are bitwise-identical by
 construction.
 
-Tail padding writes into a **reused per-(bucket, size, K) staging buffer**
+Tail padding writes into a **reused per-(bucket, size, K) staging slot**
 instead of ``np.concatenate([chunk, pad])``: no allocation per dispatch, and
-only the pad rows are re-zeroed. Reuse right after dispatch is safe because
-``jnp.asarray`` copies the host buffer synchronously (the device array never
-aliases the staging memory); the multi-chunk bitwise-parity tests would
+only the pad rows are re-zeroed. With ``overlap_staging=False`` (the legacy
+sync path) there is one slot per key and reuse right after dispatch is safe
+because ``jnp.asarray`` copies the host buffer synchronously (the device
+array never aliases the staging memory); the bitwise-parity tests would
 catch any backend that broke that assumption.
+
+**Overlapped staging** (``overlap_staging=True``, serve.overlap config)
+removes that synchronous copy from the dispatch path: each key gets a small
+round-robin pool of ``staging_slots`` host buffers, the transfer goes
+through ``jax.device_put`` — which may return BEFORE the device has read the
+host memory — and the resulting device array is donated to the executable
+exactly as before. The invariant that used to rest on the synchronous copy
+("the staging buffer is reusable the moment dispatch returns") becomes an
+explicit slot lifecycle: a slot's buffer may be rewritten only after its
+last transfer is KNOWN complete. The completion proof is the slot's
+**fence** — the device-side logits of the dispatch that consumed the slot
+(the donated input array itself is deleted by donation and cannot be
+waited on): outputs existing implies the compute ran, which implies the
+input transfer finished with the host memory. ``_SlotPool.acquire`` blocks
+on the fence before handing a slot out (``serve.slot_wait_seconds`` — with
+``staging_slots`` ≥ the pipeline's in-flight window this wait is normally
+zero), so the H2D copy of batch N+1 overlaps compute of batch N while the
+host buffers stay torn-write-free (yamt-lint YAMT014 pins the
+mutation-after-async-device_put discipline this code is the sanctioned
+idiom for). A dispatch that FAILS between the device_put and fence arming
+(device OOM, a trace callback raising) orphans the slot's buffer — fresh
+storage replaces it and the in-flight transfer keeps the old memory — so
+the pipeline's keep-serving-after-engine-errors policy can never recycle a
+possibly-in-transfer buffer. Overlapped and sync staging move the same float32 bytes, so
+logits are **bitwise identical** across the two modes (pinned by
+tests/test_overlap.py across buckets, sizes, fused K, and bf16).
 
 **Compilation never blocks warm traffic**: a cold (off-ladder) key compiles
 under a dedicated compile lock with a double-checked insert, OUTSIDE the
@@ -69,20 +96,30 @@ Optional data parallelism: pass a ``parallel/mesh`` mesh and every bucket is
 sharded over its 'data' axis (params replicated) — the eval forward has no
 collectives, so partitioning is pure SPMD batch splitting. The fused path
 is bypassed under a mesh (device_put sharding semantics differ; the
-per-chunk path serves every chunk exactly as before).
+per-chunk path serves every chunk exactly as before). The sharded path's
+staging-copy semantics are PINNED, not defensive: ``shard_batch``'s
+device_put reads the host buffer on a backend-defined schedule, so a
+pool-owned staging buffer is snapshotted with a synchronous ``np.array``
+copy before sharding and its slot is released immediately — the sharded
+path never waits on (or arms) a fence, and overlap cannot corrupt sharded
+inputs (regression-tested in tests/test_overlap.py).
 
 Instrumentation (obs/): ``serve.dispatch_seconds`` (host stage+dispatch per
 piece), ``serve.dispatch_to_complete_seconds`` (first dispatch -> logits on
 host), ``serve.run_seconds`` (predict start -> result done),
+``serve.h2d_seconds`` (host wall of the staging transfer call) /
+``serve.slot_wait_seconds`` (fence waits on slot acquire),
 ``serve.fused_dispatches`` / ``serve.fused_chunks`` (fused pieces and the
 chunks they covered), ``serve.evicted_executables``,
 ``serve.infer_images`` / ``serve.padded_rows`` / per-bucket hit counters;
-``serve/stage`` + ``serve/dispatch`` + ``serve/dispatch_fused`` +
-``serve/complete`` spans. Device telemetry (obs/device.py): every compile
-goes through ``timed_compile`` (``obs.compile_seconds``/``obs.compiles`` +
-per-executable ``obs.cost_flops.*``/``obs.cost_bytes.*`` cost_analysis
-gauges), every dispatch feeds ``serve.dispatched_flops``, and the derived
-``serve.achieved_flops_per_s`` gauge is cost FLOPs ÷ measured
+``serve/stage`` + ``serve/h2d`` + ``serve/dispatch`` +
+``serve/dispatch_fused`` + ``serve/complete`` spans. Device telemetry
+(obs/device.py): every compile goes through ``timed_compile``
+(``obs.compile_seconds``/``obs.compiles`` + per-executable
+``obs.cost_flops.*``/``obs.cost_bytes.*`` cost_analysis gauges), every
+dispatch feeds ``serve.dispatched_flops`` AND ``serve.dispatched_bytes``
+(the transfer-side twin — cost_analysis bytes joined to dispatches), and
+the derived ``serve.achieved_flops_per_s`` gauge is cost FLOPs ÷ measured
 ``serve.run_seconds`` — dispatch efficiency.
 """
 
@@ -122,6 +159,52 @@ def _cost_key(bucket: int, size: int, k: int) -> str:
     return f"serve_b{bucket}_s{size}_k{k}"
 
 
+class _StagingSlot:
+    """One host staging buffer + the fence guarding its reuse.
+
+    ``fence`` is the device-side logits of the dispatch that consumed this
+    slot's transfer (armed right after dispatch, overlap mode only). The
+    buffer may be rewritten only once the fence is ready: the executable's
+    outputs existing proves the compute ran, which proves the async H2D
+    transfer finished reading the host memory. The donated INPUT array
+    cannot serve as the fence — donation deletes it the moment the dispatch
+    returns."""
+
+    __slots__ = ("buf", "fence")
+
+    def __init__(self, buf: np.ndarray):
+        self.buf = buf
+        self.fence = None
+
+
+class _SlotPool:
+    """Round-robin pool of staging slots for one (bucket, size, K) key.
+
+    Dispatches are serialized by the engine's dispatch lock, so the pool
+    needs no lock of its own. With N slots, acquire() only blocks when the
+    slot's consumer is still among the last N dispatches in flight — sized
+    at (pipeline max_inflight), the fence wait is normally a no-op and
+    ``serve.slot_wait_seconds`` stays ~0."""
+
+    __slots__ = ("slots", "_next")
+
+    def __init__(self, shape: tuple[int, ...], n: int):
+        self.slots = [_StagingSlot(np.zeros(shape, np.float32)) for _ in range(n)]
+        self._next = 0
+
+    def acquire(self, reg) -> _StagingSlot:
+        """Next slot, its buffer safe to rewrite: waits for the slot's last
+        armed fence (usually already ready) before handing it out."""
+        slot = self.slots[self._next]
+        self._next = (self._next + 1) % len(self.slots)
+        if slot.fence is not None:
+            t0 = time.perf_counter()
+            jax.block_until_ready(slot.fence)
+            reg.histogram("serve.slot_wait_seconds").observe(time.perf_counter() - t0)
+            slot.fence = None
+        return slot
+
+
 class PendingPrediction:
     """Device-side handle returned by :meth:`InferenceEngine.predict_async`.
 
@@ -131,14 +214,22 @@ class PendingPrediction:
     once-latch serializes concurrent callers, exactly one performs the sync
     and everyone gets the same cached array. Until the sync the device is
     free to still be computing — that's the point.
+
+    ``dispatches`` is the number of engine dispatch pieces behind this
+    handle (1 for an on-bucket or fully-fused batch, more when an oversized
+    request decomposed) — it survives ``result()`` clearing ``_parts``, so
+    the pipeline's ``serve.dispatches_per_wakeup`` can count real dispatches
+    rather than handles.
     """
 
-    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out", "_lock", "_ctxs")
+    __slots__ = ("_engine", "_parts", "_t_start", "_t_dispatched", "_out", "_lock", "_ctxs",
+                 "dispatches")
 
     def __init__(self, engine: "InferenceEngine", parts, t_start: float, t_dispatched: float,
                  ctxs=()):
         self._engine = engine
         self._parts = parts  # [(device_logits, real_rows), ...]
+        self.dispatches = len(parts)
         self._t_start = t_start
         self._t_dispatched = t_dispatched
         self._out: np.ndarray | None = None
@@ -196,6 +287,8 @@ class InferenceEngine:
         image_sizes: Sequence[int] | None = None,
         fuse_ladder: Sequence[int] = (2, 4),
         offladder_cache: int = 8,
+        overlap_staging: bool = False,
+        staging_slots: int = 2,
     ):
         if not buckets:
             raise ValueError("engine needs at least one batch bucket")
@@ -213,6 +306,13 @@ class InferenceEngine:
         if offladder_cache < 1:
             raise ValueError(f"offladder_cache must be >= 1, got {offladder_cache}")
         self._offladder_cap = int(offladder_cache)
+        if staging_slots < 1:
+            raise ValueError(f"staging_slots must be >= 1, got {staging_slots}")
+        # overlapped staging: async jax.device_put through a fence-tracked
+        # slot pool instead of the synchronous jnp.asarray copy (see module
+        # docstring). Off = the legacy single-slot sync path, bit-identical.
+        self._overlap = bool(overlap_staging)
+        self._staging_slots = int(staging_slots) if self._overlap else 1
         self._compute_dtype = _dtype(compute_dtype)
         self._mesh = mesh
         self._donate = donate_input
@@ -226,10 +326,10 @@ class InferenceEngine:
             self._params = mesh_lib.replicate(bundle.params, mesh)
         else:
             self._params = jax.tree.map(jnp.asarray, bundle.params)
-        # executables and staging buffers are keyed (bucket, image_size, K);
-        # K == 1 is the plain per-chunk executable, K >= 2 the fused scan
+        # executables and staging slot pools are keyed (bucket, image_size,
+        # K); K == 1 is the plain per-chunk executable, K >= 2 the fused scan
         self._compiled: dict[tuple[int, int, int], jax.stages.Compiled] = {}
-        self._staging: dict[tuple[int, int, int], np.ndarray] = {}
+        self._staging: dict[tuple[int, int, int], _SlotPool] = {}
         # off-ladder keys live in a bounded LRU (on-ladder keys are pinned):
         # a size-scanning client must not grow the caches without bound
         self._offladder: OrderedDict[tuple[int, int, int], None] = OrderedDict()
@@ -375,27 +475,31 @@ class InferenceEngine:
             chunk += 1
         return pieces
 
-    def _stage(self, rows_arr: np.ndarray, key: tuple[int, int, int]) -> np.ndarray:
-        """Executable-shaped host array for a piece's rows: the rows
-        themselves (reshaped, zero-copy) when they fill the piece exactly,
-        else the reused per-(bucket, size, K) staging buffer with the tail
-        rows zeroed. Only the pad rows are re-zeroed — no per-dispatch
-        allocation, no full-buffer copy."""
+    def _stage(self, rows_arr: np.ndarray, key: tuple[int, int, int]):
+        """Executable-shaped host array for a piece's rows, as ``(array,
+        slot)``: the rows themselves (reshaped, zero-copy — ``slot`` None;
+        the caller's batch is never rewritten by the engine, so it needs no
+        fence) when they fill the piece exactly, else a slot from the
+        per-(bucket, size, K) pool with the tail rows zeroed. Acquire waits
+        on the slot's fence, so an overlapped in-flight transfer is never
+        torn by the rewrite; only the pad rows are re-zeroed — no
+        per-dispatch allocation, no full-buffer copy."""
         bucket, size, k = key
         total = k * bucket
         n = rows_arr.shape[0]
         shape = (bucket, size, size, 3) if k == 1 else (k, bucket, size, size, 3)
         if n == total:
-            return np.ascontiguousarray(rows_arr).reshape(shape)
+            return np.ascontiguousarray(rows_arr).reshape(shape), None
         with self._cache_lock:
-            buf = self._staging.get(key)
-            if buf is None:
-                buf = self._staging[key] = np.zeros(shape, np.float32)
-        flat = buf.reshape(total, size, size, 3)
+            pool = self._staging.get(key)
+            if pool is None:
+                pool = self._staging[key] = _SlotPool(shape, self._staging_slots)
+        slot = pool.acquire(self._reg)
+        flat = slot.buf.reshape(total, size, size, 3)
         flat[:n] = rows_arr
         flat[n:] = 0.0
         self._reg.counter("serve.padded_rows").inc(total - n)
-        return buf
+        return slot.buf, slot
 
     def _dispatch_piece(self, images: np.ndarray, piece: tuple[int, int, int, int], size: int,
                         ctxs=()):
@@ -409,43 +513,84 @@ class InferenceEngine:
         exe = self._ensure_compiled(key)  # pre-warmed by predict_async; a hit
         tracer = obs_trace.get_tracer()
         t0 = time.perf_counter()
-        with tracer.span("serve/stage", "serve", bucket=bucket, rows=rows, k=k):
-            staged = self._stage(images[start : start + rows], key)
-            if self._mesh is not None:
-                # defensive: device_put's host-read timing is backend-defined,
-                # so never hand the reused staging buffer to the sharded path
-                if staged is self._staging.get(key):
-                    staged = np.array(staged)
-                x = mesh_lib.shard_batch({"image": staged}, self._mesh)["image"]
-            else:
-                # jnp.asarray copies synchronously: the staging buffer is
-                # reusable the moment dispatch returns (parity tests pin it)
-                x = jnp.asarray(staged)
-        span = "serve/dispatch" if k == 1 else "serve/dispatch_fused"
-        span_args = dict(bucket=bucket, image_size=size, rows=rows, k=k)
-        if ctxs:
-            span_args["rids"] = [c.rid for c in ctxs[:16]]  # keep args tiny
-        with tracer.span(span, "serve", **span_args):
-            logits = exe(self._params, x)
-            for c in ctxs:  # in-span: the flow arrow binds to this slice
-                c.advance("dispatched")
-                tracer.flow_step("serve/req", c.rid)
+        slot = None
+        try:
+            with tracer.span("serve/stage", "serve", bucket=bucket, rows=rows, k=k):
+                staged, slot = self._stage(images[start : start + rows], key)
+                if self._mesh is not None:
+                    # pinned copy semantics: shard_batch's device_put reads the
+                    # host buffer on a backend-defined schedule, so a pool-owned
+                    # buffer is snapshotted synchronously and its slot freed NOW
+                    # — the sharded path never arms a fence, and overlapped
+                    # staging cannot tear sharded inputs (tests/test_overlap.py)
+                    if slot is not None:
+                        staged = np.array(staged)
+                        slot = None
+                    x = mesh_lib.shard_batch({"image": staged}, self._mesh)["image"]
+                else:
+                    t_h2d = time.perf_counter()
+                    with tracer.span("serve/h2d", "serve", bucket=bucket, k=k,
+                                     overlap=self._overlap):
+                        if self._overlap:
+                            # async H2D: device_put may return BEFORE the device
+                            # has read the host memory — the slot fence armed
+                            # after dispatch is what makes the buffer's next
+                            # rewrite safe (YAMT014 discipline)
+                            x = jax.device_put(staged)
+                        else:
+                            # jnp.asarray copies synchronously: the staging
+                            # buffer is reusable the moment dispatch returns
+                            # (parity tests pin it)
+                            x = jnp.asarray(staged)
+                    self._reg.histogram("serve.h2d_seconds").observe(time.perf_counter() - t_h2d)
+            span = "serve/dispatch" if k == 1 else "serve/dispatch_fused"
+            span_args = dict(bucket=bucket, image_size=size, rows=rows, k=k)
+            if ctxs:
+                span_args["rids"] = [c.rid for c in ctxs[:16]]  # keep args tiny
+            with tracer.span(span, "serve", **span_args):
+                logits = exe(self._params, x)
+                for c in ctxs:  # in-span: the flow arrow binds to this slice
+                    c.advance("dispatched")
+                    tracer.flow_step("serve/req", c.rid)
+            if slot is not None and self._overlap:
+                # the executable's outputs existing proves its input transfer is
+                # done with the host memory: the logits are the reuse fence
+                slot.fence = logits
+        except BaseException:
+            if slot is not None and self._overlap:
+                # A failure between the async device_put and fence arming
+                # (device OOM in the executable, a trace callback raising)
+                # would return the slot to rotation with NO fence while the
+                # H2D transfer may still be reading its buffer — the next
+                # acquire would rewrite it unguarded and hand the device torn
+                # input. Orphan the buffer instead: the in-flight transfer
+                # keeps the old memory alive, the slot gets fresh storage,
+                # and the engine keeps serving (the pipeline deliberately
+                # survives engine exceptions).
+                slot.buf = np.zeros_like(slot.buf)
+                slot.fence = None
+            raise
         self._reg.histogram("serve.dispatch_seconds").observe(time.perf_counter() - t0)
         if k > 1:
             self._reg.counter("serve.fused_dispatches").inc()
             self._reg.counter("serve.fused_chunks").inc(k)
         self._reg.counter(f"serve.bucket_hits.{bucket}").inc(k)
-        # cost-analysis FLOPs this dispatch put on the device: the numerator
-        # of serve.achieved_flops_per_s (dispatch efficiency, obs/device.py).
+        # cost-analysis FLOPs + bytes this dispatch put on the device: the
+        # numerator of serve.achieved_flops_per_s (dispatch efficiency) and
+        # its transfer-side twin serve.dispatched_bytes (obs/device.py).
         # XLA costs a lax.scan body ONCE, but the fused program runs the same
         # per-chunk forward k times — account k x the per-chunk cost.
-        flops = obs_device.flops_for(_cost_key(bucket, size, k))
-        if k > 1:
-            per_chunk = obs_device.flops_for(_cost_key(bucket, size, 1))
-            if per_chunk:
-                flops = per_chunk * k
-        if flops:
-            self._reg.counter("serve.dispatched_flops").inc(flops)
+        for counter, lookup in (
+            ("serve.dispatched_flops", obs_device.flops_for),
+            ("serve.dispatched_bytes", obs_device.bytes_for),
+        ):
+            cost = lookup(_cost_key(bucket, size, k))
+            if k > 1:
+                per_chunk = lookup(_cost_key(bucket, size, 1))
+                if per_chunk:
+                    cost = per_chunk * k
+            if cost:
+                self._reg.counter(counter).inc(cost)
         return logits, rows
 
     def predict_async(self, images: np.ndarray, ctxs=None) -> PendingPrediction:
@@ -459,7 +604,13 @@ class InferenceEngine:
         ``ctxs`` (optional) are the batch rows' RequestContexts
         (serve/context.py): their ids ride the dispatch spans and their
         phase/flow trace edges fire inside the engine's spans, so one
-        request correlates from HTTP handler to completion thread."""
+        request correlates from HTTP handler to completion thread.
+
+        Caller contract under overlapped staging: an exact-bucket batch is
+        transferred zero-copy via async ``device_put``, so ``images`` must
+        not be mutated until ``result()`` returns (the batchers always pass
+        freshly-stacked arrays; with ``overlap_staging=False`` the transfer
+        copies synchronously and no such constraint exists)."""
         images = np.asarray(images, np.float32)
         if images.ndim != 4 or images.shape[1] != images.shape[2]:
             raise ValueError(f"predict expects (N, S, S, 3), got shape {images.shape}")

@@ -40,6 +40,30 @@ window blocks the collect thread, which backs pressure up into the bounded
 submit queue and ultimately :class:`~.batcher.QueueFull`, exactly like the
 sync path.
 
+**Back-to-back dispatch** (``run_max`` > 1, serve.overlap config) is the
+device-resident steady state for a SATURATED bucket: after dispatching a
+batch, while the queue already holds a full next batch, a window slot is
+free without blocking, and the run has room, the collect thread drains and
+dispatches the next batch immediately — no linger, no completion wake-up in
+between — and hands the whole run to the completion thread as ONE item. The
+completion thread then syncs only the run's TAIL (device execution is FIFO:
+the tail's logits existing proves every earlier batch completed, so their
+``result()`` calls are pure device_get, zero further blocking syncs) inside
+a ``serve/resident`` span. Each wake-up observes
+``serve.dispatches_per_wakeup`` — ENGINE dispatch pieces per completion
+wake-up (``handle.dispatches``: an oversized batch a non-fused engine
+serves as several pieces counts them all, same granularity as
+``serve.dispatch_seconds``). On a fused engine every saturated batch is one
+piece, so a mean > 1 on a saturated bucket means runs really formed — the
+structural claim the r05 bench artifact pins — and
+paired with the engine's overlapped staging (fence-tracked slot pool +
+async ``jax.device_put``) the H2D transfer of batch N+1 overlaps compute of
+batch N, so steady-state ``serve.achieved_flops_per_s`` approaches the
+single-dispatch number. Any blocking window acquire FLUSHES the pending run
+first — a run the completion thread has not been handed yet can never be
+the thing its window slots are waiting on (the deadlock this ordering rule
+exists to make impossible).
+
 Failure semantics are preserved, not weakened:
 
 - ``QueueFull`` backpressure and dispatch-time deadline shedding behave as
@@ -97,6 +121,7 @@ class PipelinedBatcher(MicroBatcher):
         engine,
         *,
         max_inflight: int = 2,
+        run_max: int = 1,
         max_batch: int = 32,
         max_wait_ms: float = 2.0,
         queue_depth: int = 256,
@@ -105,6 +130,8 @@ class PipelinedBatcher(MicroBatcher):
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if run_max < 1:
+            raise ValueError(f"run_max must be >= 1, got {run_max}")
         super().__init__(
             engine.predict,
             max_batch=max_batch,
@@ -115,6 +142,10 @@ class PipelinedBatcher(MicroBatcher):
         )
         self._engine = engine
         self._max_inflight = max_inflight
+        # back-to-back run cap: > 1 lets a saturated bucket dispatch up to
+        # this many batches per completion wake-up (bounded by the window,
+        # which stays the device-side memory bound); 1 = legacy per-batch
+        self._run_max = int(run_max)
         # thread request identity into the engine when it speaks the ctxs
         # extension (InferenceEngine/FaultyEngine do; bare test doubles with
         # predict_async(images) keep working — the batcher's own phase
@@ -126,8 +157,9 @@ class PipelinedBatcher(MicroBatcher):
         # dispatched-but-unsynced budget, acquired BEFORE each dispatch so
         # at most max_inflight executions are ever enqueued device-side
         self._window = threading.BoundedSemaphore(max_inflight)
-        # (handle, live_requests) in dispatch order; the semaphore is the
-        # bound, the queue just carries them to the completion thread
+        # runs of (handle, live_requests) pairs in dispatch order; the
+        # semaphore is the bound, the queue just carries them to the
+        # completion thread (a run_max=1 run is a singleton list)
         self._inflight: queue.Queue = queue.Queue()
         self._inflight_n = 0
         self._inflight_lock = threading.Lock()
@@ -219,15 +251,69 @@ class PipelinedBatcher(MicroBatcher):
         # reserve the slot (window = dispatched-but-unsynced cap) BEFORE
         # dispatch — backpressure toward submit(); released by completion
         self._acquire_window_topping_up(batch)
+        run: list[tuple] = []
+        self._dispatch_groups(batch, run)
+        # back-to-back extension: while the bucket stays saturated (a FULL
+        # next batch is already queued — no linger would improve its fill),
+        # a window slot is free WITHOUT blocking, and the run has room,
+        # dispatch the next batch with no completion wake-up in between.
+        # The completion thread receives the whole run as one item and
+        # syncs only its tail.
+        while (
+            run
+            and len(run) < self._run_max
+            and not self._exit_after_batch
+            and self._q.qsize() >= self._max_batch
+        ):
+            if not self._window.acquire(blocking=False):
+                break  # window full: the run is as deep as the device bound allows
+            nxt = self._drain_full_batch_nowait()
+            if not nxt:
+                self._window.release()
+                break
+            if len(nxt) < self._max_batch and not self._exit_after_batch:
+                # short drain: the qsize saturation signal overstated what
+                # was really queued (it counts the stop sentinel, and a
+                # concurrent stop() sweep can race the drain) — this batch
+                # is NOT saturated, so fill it through the normal lingering
+                # path instead of dispatching a padded partial bucket with
+                # zero linger. (When the sentinel was drawn we are exiting:
+                # dispatch what we have, lingering would only delay drain.)
+                self._linger_fill(nxt)
+            self._dispatch_groups(nxt, run)
+        self._flush_run(run)
+
+    def _drain_full_batch_nowait(self) -> list[_Request]:
+        """Up to max_batch queued requests with NO lingering — only called
+        when the queue reported a full batch available (saturation). The
+        stop sentinel sets ``_exit_after_batch`` exactly like ``_collect``;
+        anything enqueued after it is failed by stop()'s final sweep."""
+        batch: list[_Request] = []
+        while len(batch) < self._max_batch:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is _STOP:
+                self._exit_after_batch = True
+                break
+            batch.append(nxt)
+        return batch
+
+    def _dispatch_groups(self, batch: list[_Request], run: list[tuple]) -> None:
+        """Shed, partition by image shape, dispatch each group, append the
+        ``(handle, group)`` pairs to ``run``. The caller holds ONE window
+        slot for the first group; mixed-size groups past the first acquire
+        their own — FLUSHING the pending run first, so the blocking acquire
+        can never wait on window slots held by a run the completion thread
+        has not been handed yet."""
         live = self._shed_expired(batch)
         if not live:
             self._window.release()
             return
-        # mixed image sizes dispatch one engine batch per size group, each
-        # hitting its own (bucket, image_size) executable; every group past
-        # the first takes its own window slot
         for i, group in enumerate(_group_by_shape(live)):
             if i:
+                self._flush_run(run)
                 self._window.acquire()
             self._reg.histogram("serve.batch_size").observe(len(group))
             for req in group:  # queued -> in-flight edge, collect thread
@@ -245,8 +331,14 @@ class PipelinedBatcher(MicroBatcher):
                 for req in group:
                     self._finish_err(req, e)
                 continue
-            self._inflight.put((handle, group))
+            run.append((handle, group))
             self._inflight_adj(+1)
+
+    def _flush_run(self, run: list[tuple]) -> None:
+        """Hand the accumulated run to the completion thread as ONE item."""
+        if run:
+            self._inflight.put(list(run))
+            run.clear()
 
     # -- completion thread --------------------------------------------------
 
@@ -258,34 +350,57 @@ class PipelinedBatcher(MicroBatcher):
             self._thread_crash(e)
 
     def _complete_loop_inner(self) -> None:
+        tracer = obs_trace.get_tracer()
         while True:
             item = self._inflight.get()
             if item is _DRAINED:
                 return
-            handle, live = item
-            try:
-                logits = handle.result()
-            except Exception as e:  # noqa: BLE001 — fail this batch, keep draining
-                self._inflight_adj(-1)
-                self._window.release()
-                for req in live:
-                    self._finish_err(req, e)
-                continue
-            # the device is free the moment the sync returns: open the
-            # window before the host-side future resolution
+            run = item
+            # engine dispatches the collect thread managed per completion
+            # wake-up: the back-to-back instrument. Counts real dispatch
+            # PIECES (handle.dispatches — an oversized batch on a non-fused
+            # engine is one handle but several pieces), matching the
+            # serve.dispatch_seconds granularity; bare test doubles without
+            # the attribute count as one dispatch.
+            self._reg.histogram("serve.dispatches_per_wakeup").observe(
+                sum(getattr(h, "dispatches", 1) for h, _ in run))
+            if len(run) > 1:
+                # device-resident run: sync ONLY the tail. Execution is FIFO
+                # on the device, so the tail's logits existing proves every
+                # earlier batch in the run completed — their result() calls
+                # below are pure device_get, no further blocking sync.
+                with tracer.span("serve/resident", "serve", batches=len(run)):
+                    try:
+                        run[-1][0].result()
+                    except Exception:  # yamt-lint: disable=YAMT012 — ordering optimization only; the per-batch result() below re-raises and fails exactly that batch
+                        pass
+            for handle, live in run:
+                self._complete_one(handle, live)
+
+    def _complete_one(self, handle, live: list[_Request]) -> None:
+        try:
+            logits = handle.result()
+        except Exception as e:  # noqa: BLE001 — fail this batch, keep draining
             self._inflight_adj(-1)
             self._window.release()
-            now = time.perf_counter()
-            done = 0
-            for req, row in zip(live, logits):
-                if req.t_deadline is not None and now > req.t_deadline:
-                    # expired while the batch executed: a stale answer is a
-                    # shed, not a success (completion-time deadline check)
-                    self._reg.counter("serve.shed_at_completion").inc()
-                    self._shed(req, DeadlineExceeded(
-                        f"completed {now - req.t_enqueue:.3f}s past deadline"
-                    ))
-                else:
-                    done += self._finish_ok(req, row)
-            if done:
-                self._reg.counter("serve.completed").inc(done)
+            for req in live:
+                self._finish_err(req, e)
+            return
+        # the device is free the moment the sync returns: open the
+        # window before the host-side future resolution
+        self._inflight_adj(-1)
+        self._window.release()
+        now = time.perf_counter()
+        done = 0
+        for req, row in zip(live, logits):
+            if req.t_deadline is not None and now > req.t_deadline:
+                # expired while the batch executed: a stale answer is a
+                # shed, not a success (completion-time deadline check)
+                self._reg.counter("serve.shed_at_completion").inc()
+                self._shed(req, DeadlineExceeded(
+                    f"completed {now - req.t_enqueue:.3f}s past deadline"
+                ))
+            else:
+                done += self._finish_ok(req, row)
+        if done:
+            self._reg.counter("serve.completed").inc(done)
