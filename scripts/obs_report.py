@@ -221,6 +221,36 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
             hits = {k.rsplit(".", 1)[-1]: v for k, v in snap.items() if k.startswith("serve.bucket_hits.")}
             if hits:
                 lines.append("  bucket hits: " + ", ".join(f"{b}: {v:.0f}" for b, v in sorted(hits.items(), key=lambda kv: int(kv[0]))))
+            if snap.get("fleet.routed") or snap.get("fleet.spawns"):
+                # the replica-fleet tier (serve/router.py + cli/fleet.py):
+                # routing, hedging, supervision, and scaling accounting
+                lines.append(
+                    f"  fleet: routed = {snap.get('fleet.routed', 0):.0f} "
+                    f"(retries {snap.get('fleet.route_retries', 0):.0f}, "
+                    f"errors {snap.get('fleet.route_errors', 0):.0f}), "
+                    f"replicas routable = {snap.get('fleet.replicas_routable', 0):.0f}"
+                    f"/{snap.get('fleet.replicas', 0):.0f}, "
+                    f"ejections = {snap.get('fleet.ejections', 0):.0f}, "
+                    f"readmissions = {snap.get('fleet.readmissions', 0):.0f}, "
+                    f"restarts detected = {snap.get('fleet.replica_restarts', 0):.0f}"
+                )
+                lines.append(
+                    f"  fleet lifecycle: spawns = {snap.get('fleet.spawns', 0):.0f} "
+                    f"(failed {snap.get('fleet.spawn_failures', 0):.0f}), "
+                    f"restarts = {snap.get('fleet.restarts', 0):.0f}, "
+                    f"rolling restarts = {snap.get('fleet.rolling_restarts', 0):.0f}, "
+                    f"chaos kills = {snap.get('fleet.chaos_kills', 0):.0f}, "
+                    f"scale ups/downs = {snap.get('fleet.scale_ups', 0):.0f}"
+                    f"/{snap.get('fleet.scale_downs', 0):.0f}"
+                )
+            if snap.get("serve.hedges"):
+                wins = snap.get("serve.hedge_wins", 0)
+                lines.append(
+                    f"  hedging: fired = {snap['serve.hedges']:.0f}, "
+                    f"wins = {wins:.0f} "
+                    f"({100.0 * wins / snap['serve.hedges']:.0f}%), "
+                    f"losers dropped = {snap.get('serve.hedge_wasted', 0):.0f}"
+                )
         if snap.get("obs.compiles"):
             # device telemetry (obs/device.py, docs/OBSERVABILITY.md "Device
             # telemetry"): compile events, per-executable cost accounting,

@@ -56,6 +56,16 @@ serve/export transform and dispatched through the real AOT engine — the
 numbers measure the serving path (compile, pad, dispatch, device_get), which
 does not depend on trained weight values.
 
+7. **replica fleet** (``--fleet``, standalone mode) — a REAL fleet of N
+   ``cli/serve.py`` replica subprocesses behind the router tier
+   (serve/router.py), measured three ways on shared seeded schedules:
+   hedged-vs-unhedged tail A/B against a latency-injected straggler
+   replica (``serve.hedges``/``serve.hedge_wins`` + p99 delta), a kill -9
+   availability round (every submitted request must resolve as completed
+   or typed-rejected, the supervisor must restart the corpse), and the
+   autoscaler's N-over-time trace across a diurnal low/high/low open-loop
+   schedule (cooldown respected). Emits the BENCH_SERVE_r06 shape.
+
 Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--image-sizes 224] [--buckets 1,8,32] [--iters 10]
            [--concurrent-iters 6] [--ab-iters 5] [--no-bf16]
@@ -63,6 +73,9 @@ Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--structural] [--structural-rounds 3]
            [--chaos-requests 80] [--chaos-qps 0] [--chaos-fault-rate 0.05]
            [--no-chaos] [--out f.json]
+       python scripts/serve_bench.py --fleet [--fleet-replicas 2]
+           [--fleet-requests 40] [--fleet-qps 0] [--fleet-straggler-ms 400]
+           [--fleet-phase-s 5,20,10] [--fleet-seed 0] [--out f.json]
 """
 
 from __future__ import annotations
@@ -70,6 +83,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import threading
 import time
@@ -447,6 +461,302 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
     }
 
 
+_FLEET_CPU_CAVEAT = (
+    "cpu_rehearsal: router, replicas, and load generator share this box's "
+    "core(s), so absolute QPS and latency are contention-dominated. The "
+    "pinned structural claims are the availability/accounting invariants "
+    "(every submitted request resolves; a kill -9 costs retries+ejection, "
+    "not client-visible failures), hedging firing at the measured-p-quantile "
+    "timer with wins counted, and the autoscaler trace rising and falling "
+    "with cooldown respected. Absolute fleet throughput is an accelerator "
+    "measurement — same caveat discipline as r02/r04/r05."
+)
+
+
+def _fleet_round(router, image, *, n_requests, target_qps, seed,
+                 mid_hook=None, mid_at=None, result_timeout_s=120.0):
+    """One open-loop Poisson round through the fleet router. Arrivals fire
+    on schedule regardless of completions; EVERY future is resolved at the
+    end (a hang shows as ``unresolved`` > 0, never a stuck bench).
+    ``mid_hook`` fires once before request index ``mid_at`` — the kill -9
+    injection point."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.serve.client import ClientHTTPError
+
+    rs = np.random.RandomState(seed)
+    gaps = rs.exponential(1.0 / target_qps, size=n_requests)
+    pending = []
+    lat = []
+    lat_lock = threading.Lock()
+
+    def _stamp(t0):
+        # latency is stamped AT resolution (done callback), not when the
+        # collector loop gets around to the future — otherwise every number
+        # silently includes the remainder of the arrival schedule
+        def cb(fut):
+            if fut.exception() is None:
+                with lat_lock:
+                    lat.append(time.perf_counter() - t0)
+        return cb
+
+    t_start = time.perf_counter()
+    t_next = t_start
+    for i in range(n_requests):
+        t_next += gaps[i]
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if mid_hook is not None and i == mid_at:
+            mid_hook()
+            mid_hook = None
+        t0 = time.perf_counter()
+        fut = router.submit(image)
+        fut.add_done_callback(_stamp(t0))
+        pending.append(fut)
+    out = {"submitted": n_requests, "completed": 0, "rejected": 0, "failed": 0,
+           "unresolved": 0}
+    for fut in pending:
+        try:
+            fut.result(timeout=result_timeout_s)
+            out["completed"] += 1
+        except FutTimeout:
+            out["unresolved"] += 1  # a real hang: the router broke its contract
+        except ClientHTTPError as e:
+            out["rejected" if e.status < 500 else "failed"] += 1
+        except Exception:  # noqa: BLE001 — typed route failure
+            out["failed"] += 1
+    wall = time.perf_counter() - t_start
+    lat.sort()
+    out.update({
+        "wall_s": round(wall, 3),
+        "qps": round(out["completed"] / wall, 2) if wall else 0.0,
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+    })
+    return out
+
+
+def _fleet_registry_delta(reg, s0, keys):
+    s1 = reg.snapshot()
+    return {k.split(".", 1)[1]: int(s1.get(k, 0) - s0.get(k, 0)) for k in keys}
+
+
+_FLEET_AB_KEYS = ("serve.hedges", "serve.hedge_wins", "serve.hedge_wasted",
+                  "fleet.routed", "fleet.route_retries")
+_FLEET_KILL_KEYS = ("fleet.route_retries", "fleet.ejections", "fleet.readmissions",
+                    "fleet.restarts", "fleet.chaos_kills", "serve.hedges")
+
+
+def measure_fleet(arch, image_size, buckets, *, replicas, requests, target_qps,
+                  straggler_ms, seed, phase_s, log_root):
+    """The ``--fleet`` measurement: a real fleet of cli/serve.py replica
+    subprocesses behind the router tier (serve/router.py), exercised three
+    ways on shared seeded schedules:
+
+    1. **hedged vs unhedged A/B** — one straggler replica (highest slot)
+       carries seeded injected completion latency (serve/faults.py), both
+       rounds share one Poisson arrival schedule, and the hedged round arms
+       the p-quantile timer (serve/hedge.py): ``serve.hedges`` fired,
+       ``serve.hedge_wins`` first-answer wins, tail delta recorded.
+    2. **kill -9 availability** — mid-round SIGKILL of a serving replica;
+       the router's transport retry + ejection must account for EVERY
+       submitted request as completed or typed-rejected (failed == 0, no
+       client ever hangs), and the supervisor must restart the corpse.
+    3. **autoscaler diurnal trace** — the fleet scales to 1, the straggler
+       drains away, and a low/high/low open-loop schedule drives the
+       Autoscaler (tail-latency + queue-depth signals, cooldown
+       hysteresis): the N-over-time trace must rise under the peak and
+       fall after it.
+    """
+    import jax
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.cli.fleet import FleetSupervisor
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.autoscale import Autoscaler
+    from yet_another_mobilenet_series_tpu.serve.export import export_bundle
+    from yet_another_mobilenet_series_tpu.serve.hedge import Hedger
+    from yet_another_mobilenet_series_tpu.serve.router import Router
+
+    reg = get_registry()
+    if arch == "tiny":  # same contract-test preset as measure()
+        mc = ModelConfig(arch="mobilenet_v2", num_classes=16, dropout=0.0,
+                         block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}, {"t": 2, "c": 16, "n": 1, "s": 2}])
+    else:
+        mc = ModelConfig(arch=arch)
+    net = get_model(mc, image_size)
+    params, state = net.init(jax.random.PRNGKey(0))
+    bundle_dir = os.path.join(log_root, "bundle")
+    export_bundle(net, params, state, bundle_dir)
+
+    replica_argv = [
+        f"serve.bundle={bundle_dir}",
+        f"data.image_size={image_size}",
+        f"serve.buckets=[{','.join(str(b) for b in buckets)}]",
+        "serve.max_wait_ms=2.0",
+        "serve.drain_timeout_s=10",
+    ]
+    straggler_slot = replicas - 1
+    per_slot = {straggler_slot: [
+        "serve.faults.enable=true",
+        f"serve.faults.latency_ms={straggler_ms}",
+        "serve.faults.latency_rate=0.3",
+        "serve.faults.fail_at=result",
+        f"serve.faults.seed={seed + 7}",
+    ]}
+    class _StderrLog:
+        # the bench contract owns stdout (ONE JSON line); supervisor
+        # progress goes to stderr like every other bench diagnostic
+        def log(self, msg):
+            print(msg, file=sys.stderr, flush=True)
+
+    router = Router(poll_interval_s=0.25, eject_failures=2, route_attempts=3,
+                    client_timeout_s=60.0, seed=seed).start()
+    fleet = FleetSupervisor(
+        replica_argv=replica_argv, log_dir=log_root, replicas=replicas,
+        per_slot_argv=per_slot, spawn_timeout_s=240.0, drain_timeout_s=30.0,
+        on_change=router.set_backends, logger=_StderrLog(),
+    )
+    rng = np.random.RandomState(seed)
+    image = rng.normal(0, 1, (image_size, image_size, 3)).astype("float32")
+    out = {"replicas": replicas, "image_size": image_size, "seed": seed,
+           "straggler": {"slot": straggler_slot, "latency_ms": straggler_ms,
+                         "latency_rate": 0.3}}
+    try:
+        t0 = time.perf_counter()
+        fleet.start()
+        out["spawn_s"] = round(time.perf_counter() - t0, 2)
+
+        # warm + calibrate: sequential closed-loop requests teach the router
+        # latency histogram (the hedge timer's input) and give the pacing
+        # p50. The timer quantile sits BELOW the straggler's hit rate
+        # (~0.5 routing share x 0.3 injection) so the timer derives from the
+        # fast cluster and fires well inside the injected stall.
+        hedger = Hedger(quantile=0.8, min_samples=20, min_timer_ms=10.0)
+        warm_lat = []
+        for _ in range(40):
+            t1 = time.perf_counter()
+            router.submit(image).result(timeout=60)
+            warm_lat.append(time.perf_counter() - t1)
+        warm_lat.sort()
+        p50_s = max(_percentile(warm_lat, 0.5), 1e-3)
+        if target_qps <= 0:
+            # well below the box's capacity: the A/B must measure the
+            # straggler's tail, not open-loop queueing (which hedging
+            # rightly cannot fix)
+            target_qps = max(2.0, 0.35 / p50_s)
+        out["warm_p50_ms"] = round(p50_s * 1e3, 3)
+        out["target_qps"] = round(target_qps, 2)
+        timer_s = hedger.timer_s("interactive")
+        out["hedge_timer_ms"] = round(timer_s * 1e3, 3) if timer_s is not None else None
+
+        # 1. hedged vs unhedged on one shared seeded schedule
+        ab = {}
+        for mode, h in (("unhedged", None), ("hedged", hedger)):
+            router.set_hedger(h)
+            s0 = reg.snapshot()
+            rnd = _fleet_round(router, image, n_requests=requests,
+                               target_qps=target_qps, seed=seed)
+            # a hedge-won request's PRIMARY may still be inside the
+            # straggler's stall: let the losers' late answers land (and be
+            # counted dropped) before the delta is read
+            time.sleep(2.5 * straggler_ms / 1e3)
+            rnd.update(_fleet_registry_delta(reg, s0, _FLEET_AB_KEYS))
+            ab[mode] = rnd
+        router.set_hedger(None)
+        ab["p99_ms_unhedged"] = ab["unhedged"]["p99_ms"]
+        ab["p99_ms_hedged"] = ab["hedged"]["p99_ms"]
+        ab["hedged_tail_speedup"] = (
+            round(ab["unhedged"]["p99_ms"] / ab["hedged"]["p99_ms"], 4)
+            if ab["hedged"]["p99_ms"] else None
+        )
+        out["hedge_ab"] = ab
+
+        # 2. kill -9 a serving (non-straggler) replica mid-round: the books
+        # must balance with zero client-visible failures, and the
+        # supervisor must restart the corpse
+        s0 = reg.snapshot()
+        kill = _fleet_round(
+            router, image, n_requests=requests, target_qps=target_qps, seed=seed + 1,
+            mid_at=requests // 3,
+            mid_hook=lambda: fleet.kill_replica(slot=0, sig=signal.SIGKILL),
+        )
+        # bounded wait for the restart to land (counts fleet.restarts)
+        deadline = time.monotonic() + 120
+        while len(fleet.addresses()) < replicas and time.monotonic() < deadline:
+            time.sleep(0.25)
+        kill.update(_fleet_registry_delta(reg, s0, _FLEET_KILL_KEYS))
+        kill["replicas_after_restart"] = len(fleet.addresses())
+        out["kill"] = kill
+
+        # 3. autoscaler over a diurnal low/high/low open-loop schedule,
+        # starting from one clean replica (the straggler drains first).
+        # Thresholds calibrate off the A/B round's OPEN-LOOP p50 — the
+        # sequential warm p50 is dominated by per-request HTTP overhead the
+        # concurrent path pipelines away, so it would set the bar far above
+        # anything the peak can reach.
+        fleet.scale_to(1)
+        router.poll_once()
+        ab_p50_ms = max(ab["unhedged"]["p50_ms"], 1.0)
+        low_s, high_s, trough_s = phase_s
+        autoscaler = Autoscaler(
+            fleet, router,
+            min_replicas=1, max_replicas=min(replicas + 1, 3),
+            interval_s=0.4, cooldown_s=1.5,
+            # the dead band separates this box's measured light-traffic
+            # windows (~5-10ms p99) from its saturated ones (>= ~50ms,
+            # often seconds): up above the idle ceiling, down below it
+            up_p99_ms=max(6.0 * ab_p50_ms, 30.0),
+            down_p99_ms=max(2.5 * ab_p50_ms, 12.0),
+            up_queue_depth=2.0, down_queue_depth=1.0,
+        ).start()
+        # the peak must EXCEED what the box can serve (router + replicas +
+        # load gen share its cores), so the latency windows really rise
+        phases = [(0.4 * target_qps, low_s),
+                  (12.0 * target_qps, high_s),
+                  (0.4 * target_qps, trough_s)]
+        diurnal = []
+        for i, (qps, dur) in enumerate(phases):
+            n = max(4, int(qps * dur))
+            rnd = _fleet_round(router, image, n_requests=n, target_qps=qps,
+                               seed=seed + 2 + i)
+            diurnal.append({"phase": ("low", "high", "trough")[i],
+                            "target_qps": round(qps, 2), **rnd})
+        # let the trough's relaxed signals finish the scale-down
+        settle_until = time.monotonic() + 3 * autoscaler._cooldown_s
+        while time.monotonic() < settle_until:
+            time.sleep(0.3)
+        autoscaler.stop()
+        trace = autoscaler.trace
+        ns = [r["n"] for r in trace]
+        action_ts = [r["t"] for r in trace if r["action"] != "hold"]
+        out["autoscale"] = {
+            "min_replicas": autoscaler.min_replicas,
+            "max_replicas": autoscaler.max_replicas,
+            "cooldown_s": autoscaler._cooldown_s,
+            "phases": diurnal,
+            "trace": trace,
+            "n_start": ns[0] if ns else None,
+            "n_peak": max(ns) if ns else None,
+            "n_end": ns[-1] if ns else None,
+            "actions": [r for r in trace if r["action"] != "hold"],
+            "cooldown_respected": all(
+                b - a >= 0.9 * autoscaler._cooldown_s
+                for a, b in zip(action_ts, action_ts[1:])
+            ),
+        }
+        out["cpu_rehearsal_note"] = _FLEET_CPU_CAVEAT
+        return out
+    finally:
+        router.stop()
+        fleet.stop()
+
+
 _CHAOS_CLASS_MIX = {"interactive": 0.5, "batch": 0.3, "best_effort": 0.2}
 
 
@@ -767,6 +1077,22 @@ def main(argv=None) -> int:
                          "wakeup + steady-state achieved-FLOPS deltas — the r05 shape)")
     ap.add_argument("--structural-rounds", type=int, default=3,
                     help="interleaved rounds per mode in the structural sweep")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the REPLICA-FLEET measurement instead of the single-"
+                         "process suites: N cli/serve.py replica subprocesses behind "
+                         "the router tier — hedged-vs-unhedged A/B, kill -9 "
+                         "availability round, autoscaler diurnal trace (the r06 shape)")
+    ap.add_argument("--fleet-replicas", type=int, default=2,
+                    help="initial replica count (the straggler is the highest slot)")
+    ap.add_argument("--fleet-requests", type=int, default=40,
+                    help="open-loop requests per fleet round (A/B and kill)")
+    ap.add_argument("--fleet-qps", type=float, default=0.0,
+                    help="open-loop arrival rate; 0 = auto from the measured p50")
+    ap.add_argument("--fleet-straggler-ms", type=float, default=400.0,
+                    help="injected completion latency on the straggler replica")
+    ap.add_argument("--fleet-phase-s", default="5,20,10",
+                    help="low,high,trough durations (s) of the autoscaler's diurnal schedule")
+    ap.add_argument("--fleet-seed", type=int, default=0)
     ap.add_argument("--chaos-requests", type=int, default=80,
                     help="open-loop Poisson requests per chaos round (healthy + faulty)")
     ap.add_argument("--chaos-qps", type=float, default=0.0,
@@ -780,6 +1106,52 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
     image_sizes = tuple(int(s) for s in args.image_sizes.split(","))
+
+    if args.fleet:
+        # the fleet measurement is standalone: replica subprocesses own the
+        # engines, so the single-process suites would only add minutes of
+        # redundant compile time to the artifact
+        import shutil
+        import tempfile
+
+        out = {
+            "metric": f"{args.arch}_fleet_requests_per_sec",
+            "value": None,
+            "unit": "requests/sec",
+            "vs_baseline": None,
+            "vs_baseline_note": "first fleet round; single-replica rows live in BENCH_SERVE_r01..r05",
+            "image_size": image_sizes[0],
+            "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        log_root = tempfile.mkdtemp(prefix="serve_bench_fleet_")
+        try:
+            m = measure_fleet(
+                args.arch, image_sizes[0], buckets,
+                replicas=max(2, args.fleet_replicas),
+                requests=max(10, args.fleet_requests),
+                target_qps=args.fleet_qps,
+                straggler_ms=args.fleet_straggler_ms,
+                seed=args.fleet_seed,
+                phase_s=tuple(float(s) for s in args.fleet_phase_s.split(",")),
+                log_root=log_root,
+            )
+            import jax
+
+            from bench import provenance
+
+            dev = jax.devices()[0]
+            out.update({"platform": dev.platform, "device_kind": dev.device_kind,
+                        "provenance": provenance(), "fleet": m})
+            out["value"] = m["hedge_ab"]["unhedged"]["qps"]
+            shutil.rmtree(log_root, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0
+            out["error"] = f"{type(e).__name__}: {e} (replica logs under {log_root})"
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
 
     out = {
         "metric": f"{args.arch}_serve_images_per_sec",

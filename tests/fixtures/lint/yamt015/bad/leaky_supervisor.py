@@ -1,0 +1,36 @@
+"""YAMT015 bad fixture: subprocess spawns with no bounded cleanup path."""
+
+import subprocess
+
+
+def wait_for_socket(proc):
+    return proc
+
+
+def launch_worker(cmd):
+    # flagged: anything between the spawn and the return can raise, and
+    # nothing on the exception edge terminates or bounded-waits the child
+    proc = subprocess.Popen(cmd)
+    wait_for_socket(proc)
+    return proc
+
+
+class LeakySupervisor:
+    def spawn(self, cmd):
+        # flagged: the handle lands on self, but no function in the file
+        # ever terminates/kills/bounded-waits self._proc
+        self._proc = subprocess.Popen(cmd)
+        return self._proc
+
+    def running(self):
+        return self._proc.poll() is None
+
+
+def build_native(cmd):
+    # flagged: no timeout — a wedged child wedges the parent forever
+    subprocess.run(cmd, check=True)
+
+
+def read_version(cmd):
+    # flagged: check_output with no timeout is the same unbounded wait
+    return subprocess.check_output(cmd)

@@ -1,0 +1,53 @@
+"""YAMT015 clean fixture: the sanctioned bounded-supervision shapes."""
+
+import subprocess
+
+
+def wait_for_socket(proc):
+    return proc
+
+
+def launch_worker(cmd):
+    # clean: the exception edge terminates the child with a bounded reap
+    proc = subprocess.Popen(cmd)
+    try:
+        wait_for_socket(proc)
+    except Exception:
+        proc.terminate()
+        proc.wait(timeout=10)
+        raise
+    return proc
+
+
+def launch_with_finally(cmd):
+    # clean: finally-guaranteed bounded cleanup is equally sanctioned
+    proc = subprocess.Popen(cmd)
+    ok = False
+    try:
+        wait_for_socket(proc)
+        ok = True
+    finally:
+        if not ok:
+            proc.kill()
+            proc.wait(timeout=5)
+    return proc
+
+
+class BoundedSupervisor:
+    def spawn(self, cmd):
+        # clean: the handle lands on self and stop() below can reap it
+        self._proc = subprocess.Popen(cmd)
+        return self._proc
+
+    def stop(self):
+        self._proc.terminate()
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            self._proc.wait(timeout=5)
+
+
+def build_native(cmd):
+    # clean: the blocking helper carries an explicit bound
+    subprocess.run(cmd, check=True, timeout=600)

@@ -68,6 +68,55 @@ def _assert_structural_sweep(sw, *, saturated=False):
     assert "cpu_rehearsal" in sw["cpu_rehearsal_note"]  # the caveat is recorded
 
 
+def _assert_fleet(fl, *, rehearsal=False):
+    """The --fleet contract (shared by the tiny fast run and the checked-in
+    r06 rehearsal artifact): hedged-vs-unhedged on one seeded schedule with
+    hedges fired and first-answer wins counted; a kill -9 round where
+    completed + rejected accounts for EVERY submitted request (failed == 0,
+    unresolved == 0 — no client ever hangs or sees the death) and the
+    supervisor restarts the corpse; and an autoscaler trace bounded by
+    [min, max] with cooldown respected. The rehearsal artifact additionally
+    pins the diurnal shape — N rising under the peak and falling after —
+    and the hedged tail beating the unhedged one. QPS magnitude is never
+    asserted (1-core caveat, recorded in the artifact)."""
+    assert fl["replicas"] >= 2
+    assert fl["hedge_timer_ms"] is not None and fl["hedge_timer_ms"] > 0
+    ab = fl["hedge_ab"]
+    for mode in ("unhedged", "hedged"):
+        r = ab[mode]
+        assert r["unresolved"] == 0, f"{mode}: a client hung"
+        assert r["submitted"] == r["completed"] + r["rejected"] + r["failed"], (mode, r)
+        assert r["qps"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0, (mode, r)
+    assert ab["unhedged"]["hedges"] == 0  # the control arm really was a control
+    assert ab["hedged"]["hedges"] >= 1, "the straggler never triggered a hedge"
+    assert 1 <= ab["hedged"]["hedge_wins"] <= ab["hedged"]["hedges"]
+    # first-answer-wins is idempotent: losers' late answers are dropped and
+    # COUNTED, never double-delivered (>= because a loser still inside its
+    # stall when the delta is read is not yet counted)
+    assert ab["hedged"]["hedge_wasted"] >= 1
+    k = fl["kill"]
+    assert k["chaos_kills"] == 1
+    assert k["unresolved"] == 0 and k["failed"] == 0, k
+    assert k["submitted"] == k["completed"] + k["rejected"], k
+    assert k["restarts"] >= 1 and k["replicas_after_restart"] == fl["replicas"]
+    a = fl["autoscale"]
+    assert a["min_replicas"] >= 1 and a["max_replicas"] > a["min_replicas"]
+    assert a["trace"], "autoscaler never ticked"
+    assert all(a["min_replicas"] <= r["n"] <= a["max_replicas"] for r in a["trace"])
+    assert all(r["action"] == "hold" for r in a["trace"] if r["in_cooldown"])
+    assert a["cooldown_respected"]
+    for p in a["phases"]:
+        assert p["unresolved"] == 0, (p["phase"], "a client hung")
+        assert p["submitted"] == p["completed"] + p["rejected"] + p["failed"], p
+    if rehearsal:
+        assert ab["hedged_tail_speedup"] is not None and ab["hedged_tail_speedup"] > 1.0
+        assert a["n_peak"] > a["n_start"], "N never rose under the diurnal peak"
+        assert a["n_end"] < a["n_peak"], "N never fell after the peak"
+        assert any(r["action"] == "up" for r in a["actions"])
+        assert any(r["action"] == "down" for r in a["actions"])
+    assert "cpu_rehearsal" in fl["cpu_rehearsal_note"]  # the caveat is recorded
+
+
 def _assert_fused_ab(fz):
     """The chained-vs-fused A/B contract (shared by the tiny fast run and
     the checked-in r04 rehearsal artifact): one row per ladder K plus one
@@ -253,6 +302,53 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     assert out["value"] == out["peak_qps"] >= max(r["qps"] for r in out["buckets"])
     # --out writes the same artifact for the driver to collect
     assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_fleet_emits_parsed_artifact(tmp_path):
+    """scripts/serve_bench.py --fleet: a REAL 2-replica fleet (cli/serve.py
+    subprocesses behind the router tier) driven through the hedge A/B, the
+    kill -9 availability round, and the autoscaler's diurnal schedule —
+    one JSON line in the bench artifact shape, the r06 contract."""
+    out_path = tmp_path / "BENCH_SERVE_fleet_test.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--fleet", "--arch", "tiny", "--image-sizes", "24", "--buckets", "1,4",
+         "--fleet-requests", "24", "--fleet-phase-s", "3,10,7",
+         "--out", str(out_path)],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "tiny_fleet_requests_per_sec"
+    assert "error" not in out, out.get("error")
+    assert out["unit"] == "requests/sec" and out["vs_baseline"] is None
+    prov = out["provenance"]
+    assert prov["jax_version"] and prov["platform"] == out["platform"]
+    # structure + invariants on the tiny run (the checked-in r06 rehearsal
+    # additionally pins the diurnal rise/fall and the hedged-tail win)
+    _assert_fleet(out["fleet"])
+    assert out["value"] == out["fleet"]["hedge_ab"]["unhedged"]["qps"] > 0
+    assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_r06_fleet_rehearsal_artifact():
+    """The r06 cpu_rehearsal artifact pins the fleet acceptance: the hedged
+    round beats the unhedged tail on the shared seeded schedule (hedges
+    fired at the measured p-quantile timer, first answer wins), the kill -9
+    round accounts for every submitted request as completed+rejected with
+    nothing hanging and the replica restarted, and the autoscaler trace
+    rises and falls across the diurnal schedule with cooldown respected.
+    Absolute throughput is the deferred accelerator measurement; the caveat
+    is recorded in the artifact — r02/r04/r05 discipline."""
+    with open(os.path.join(REPO, "BENCH_SERVE_r06_cpu_rehearsal.json")) as f:
+        out = json.load(f)
+    assert out["platform"] == "cpu" and "error" not in out
+    assert out["value"] is not None and out["value"] > 0
+    prov = out["provenance"]
+    assert prov["cpu_rehearsal"] is True and prov["jax_version"]
+    _assert_fleet(out["fleet"], rehearsal=True)
 
 
 def test_train_chaos_emits_parsed_artifact(tmp_path):

@@ -252,6 +252,7 @@ def load_rules() -> list[Rule]:
         rules_profiler,
         rules_recompile,
         rules_spmd,
+        rules_subprocess,
         rules_swallow,
         rules_threads,
         rules_tracing,

@@ -1,0 +1,681 @@
+"""Fleet entry point — ``python -m yet_another_mobilenet_series_tpu.cli.fleet
+app:<yaml> serve.bundle=<dir> [key=value ...]``.
+
+Spawns and supervises N ``cli/serve.py --listen`` replica subprocesses on
+ephemeral ports and puts the fleet router (serve/router.py) in front of them
+as an ordinary frontend — same endpoints, same typed statuses, same
+``X-Request-Id`` threading — so to a client the fleet IS one replica, just
+one that survives the death of any of its processes. The supervisor process
+itself never imports jax: replicas own the device; the parent owns policy.
+
+What runs here:
+
+- **spawn**: each replica is ``cli/serve.py`` with the SAME config plus per
+  -slot overrides (``serve.listen.port=0``, ``serve.listen.replica_id=r<i>``,
+  its own ``train.log_dir``). The bound port is read from the replica's
+  atomically-renamed ``listen_addr.json`` (a poll never sees partial JSON)
+  and cross-checked against the child pid, bounded by
+  ``serve.fleet.spawn_timeout_s``.
+- **supervision**: a guarded thread restarts any replica that exits while
+  wanted (``fleet.restarts``), with per-slot exponential backoff
+  (``restart_backoff_ms`` doubling to ``restart_backoff_max_s``) so a
+  crash-looping artifact cannot spin the host. Every membership change is
+  pushed to the router (``on_change`` -> ``Router.set_backends``).
+- **scaling**: :meth:`FleetSupervisor.scale_to` adds replicas (new slots)
+  or drains the newest ones — the autoscaler's one dependency.
+- **rolling restart** (SIGHUP): replicas drain and respawn ONE AT A TIME,
+  each waiting for its successor to bind before the next drain starts, so
+  capacity never drops by more than one replica.
+- **replica chaos** (``serve.fleet.chaos``): a seeded schedule of kill -9
+  against random live replicas mid-load (``fleet.chaos_kills``) — the
+  process-granular twin of serve/faults.py, exercising restart-on-exit,
+  router ejection/readmission, and transport-retry for real.
+
+SIGTERM/SIGINT: stop accepting at the router, then drain every replica
+sequentially (each bounded by its own SIGTERM drain), then exit 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+from ..config import Config, parse_cli
+from ..obs import device as obs_device
+from ..obs import registry as obs_registry
+from ..obs import trace as obs_trace
+from ..serve.autoscale import Autoscaler
+from ..serve.frontend import Frontend, write_listen_addr
+from ..serve.hedge import Hedger
+from ..serve.router import Router
+from ..utils.logging import Logger, emit
+
+# repo root (the package's parent): child interpreters must resolve the
+# package no matter where the operator launched the supervisor from
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class FleetSpawnError(RuntimeError):
+    """A replica failed to come up (died early or never published its
+    listen_addr.json inside spawn_timeout_s)."""
+
+
+# Why not PR_SET_PDEATHSIG: the kernel delivers it when the forking THREAD
+# exits, not the process — the supervisor spawns from short-lived threads,
+# so pdeathsig SIGTERMed freshly-bound replicas the moment their spawn
+# thread finished (measured). The orphan guard lives on the REPLICA side
+# instead: cli/serve.py polls getppid() against this env var and
+# self-drains when its supervisor process is gone (kill -9 included), so a
+# dead supervisor can never leak replicas — the process-level YAMT015
+# hazard, closed portably.
+ORPHAN_ENV = "YAMT_FLEET_PARENT"
+
+
+class ReplicaHandle:
+    """One replica subprocess: spawn, readiness, drain, kill."""
+
+    def __init__(self, slot: int, argv: list[str], log_dir: str, *,
+                 spawn_timeout_s: float = 120.0, env: dict | None = None):
+        self.slot = slot
+        self.argv = argv
+        self.log_dir = log_dir
+        self.spawn_timeout_s = spawn_timeout_s
+        self._env = env
+        self._proc: subprocess.Popen | None = None
+        self._log_file = None
+        self.addr: dict | None = None
+
+    @property
+    def pid(self) -> int | None:
+        return self._proc.pid if self._proc is not None else None
+
+    @property
+    def returncode(self) -> int | None:
+        return self._proc.returncode if self._proc is not None else None
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def spawn(self) -> "ReplicaHandle":
+        """Launch the replica and block until it publishes its bound address
+        (atomic listen_addr.json) or the spawn budget runs out — in which
+        case the half-started child is killed, never leaked."""
+        os.makedirs(self.log_dir, exist_ok=True)
+        addr_path = os.path.join(self.log_dir, "listen_addr.json")
+        if os.path.exists(addr_path):
+            os.remove(addr_path)  # a stale address from a previous incarnation
+        env = dict(os.environ if self._env is None else self._env)
+        env["PYTHONPATH"] = _PKG_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # the replica self-drains if THIS process disappears (see ORPHAN_ENV)
+        env[ORPHAN_ENV] = str(os.getpid())
+        self._log_file = open(os.path.join(self.log_dir, "replica.log"), "ab")
+        self._proc = subprocess.Popen(
+            self.argv, stdout=self._log_file, stderr=subprocess.STDOUT, env=env
+        )
+        try:
+            self.addr = self._wait_ready(addr_path)
+        except Exception:
+            # the exception edge must not leak a half-started child: bounded
+            # terminate -> kill, then re-raise the spawn failure
+            self.kill(sig=signal.SIGKILL)
+            raise
+        return self
+
+    def _wait_ready(self, addr_path: str) -> dict:
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise FleetSpawnError(
+                    f"replica {self.slot} exited rc={self._proc.returncode} before binding "
+                    f"(see {self.log_dir}/replica.log)"
+                )
+            if os.path.exists(addr_path):
+                with open(addr_path) as f:
+                    addr = json.load(f)  # whole JSON by the rename contract
+                if addr.get("pid") == self._proc.pid:
+                    return addr
+            time.sleep(0.1)
+        raise FleetSpawnError(
+            f"replica {self.slot} never published {addr_path} within {self.spawn_timeout_s:.0f}s"
+        )
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """SIGTERM -> bounded wait (the replica's own drain path runs);
+        escalate to SIGKILL if the budget runs out. True = clean exit."""
+        if self._proc is None:
+            return True
+        clean = True
+        try:
+            if self._proc.poll() is None:
+                self._proc.send_signal(signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                clean = False
+                self._proc.kill()
+                self._proc.wait(timeout=10.0)
+        except ProcessLookupError:
+            pass  # already reaped
+        self._close_log()
+        return clean
+
+    def send_signal(self, sig: int) -> bool:
+        """Deliver ``sig`` WITHOUT waiting (the chaos hook: a kill -9 must
+        not politely reap before the supervisor notices the death)."""
+        if self._proc is None or self._proc.poll() is not None:
+            return False
+        try:
+            self._proc.send_signal(sig)
+        except ProcessLookupError:
+            return False
+        return True
+
+    def kill(self, sig: int = signal.SIGKILL) -> None:
+        """Immediate (chaos / cleanup) kill with a bounded reap."""
+        if self._proc is None:
+            return
+        try:
+            if self._proc.poll() is None:
+                self._proc.send_signal(sig)
+            self._proc.wait(timeout=10.0)
+        except (ProcessLookupError, subprocess.TimeoutExpired):
+            pass
+        self._close_log()
+
+    def _close_log(self) -> None:
+        if self._log_file is not None:
+            self._log_file.close()
+            self._log_file = None
+
+
+class _Slot:
+    """Supervisor bookkeeping for one replica position."""
+
+    __slots__ = ("idx", "handle", "wanted", "busy", "generation",
+                 "consecutive_crashes", "next_restart_t", "last_spawn_t")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        self.handle: ReplicaHandle | None = None
+        self.wanted = True
+        self.busy = False  # a spawn/drain is in flight for this slot
+        self.generation = 0
+        self.consecutive_crashes = 0
+        self.next_restart_t = 0.0
+        self.last_spawn_t = 0.0
+
+
+class FleetSupervisor:
+    """Spawns, restarts, scales, and drains the replica set."""
+
+    # a replica that survived this long resets its crash-backoff ladder
+    CRASH_RESET_S = 30.0
+
+    def __init__(
+        self,
+        *,
+        replica_argv: list[str],
+        log_dir: str,
+        replicas: int = 2,
+        restart_backoff_ms: float = 200.0,
+        restart_backoff_max_s: float = 5.0,
+        spawn_timeout_s: float = 120.0,
+        drain_timeout_s: float = 30.0,
+        supervise_poll_s: float = 0.2,
+        per_slot_argv: dict[int, list[str]] | None = None,
+        on_change=None,
+        spawn_fn=None,
+        logger=None,
+    ):
+        self._replica_argv = list(replica_argv)
+        self._log_dir = log_dir
+        self._n_initial = max(1, int(replicas))
+        self._backoff_s = restart_backoff_ms / 1e3
+        self._backoff_max_s = restart_backoff_max_s
+        self._spawn_timeout_s = spawn_timeout_s
+        self._drain_timeout_s = drain_timeout_s
+        self._supervise_poll_s = supervise_poll_s
+        self._per_slot_argv = dict(per_slot_argv or {})
+        self._on_change = on_change  # e.g. Router.set_backends (addresses list)
+        self._spawn_fn = spawn_fn or self._spawn_real
+        self._log = logger
+        self._lock = threading.Lock()
+        self._slots: dict[int, _Slot] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._reg = obs_registry.get_registry()
+
+    # -- spawning ------------------------------------------------------------
+
+    def _spawn_real(self, slot: int) -> ReplicaHandle:
+        argv = [
+            sys.executable, "-m", "yet_another_mobilenet_series_tpu.cli.serve",
+            *self._replica_argv,
+            "serve.listen.enable=true",
+            "serve.listen.port=0",
+            f"serve.listen.replica_id=r{slot}",
+            f"train.log_dir={os.path.join(self._log_dir, f'r{slot}')}",
+            *self._per_slot_argv.get(slot, []),
+        ]
+        return ReplicaHandle(
+            slot, argv, os.path.join(self._log_dir, f"r{slot}"),
+            spawn_timeout_s=self._spawn_timeout_s,
+        ).spawn()
+
+    def _emit(self, msg: str) -> None:
+        if self._log is not None:
+            self._log.log(msg)
+        else:
+            emit(msg)
+
+    def _spawn_slot(self, slot: _Slot) -> bool:
+        slot.last_spawn_t = time.monotonic()
+        try:
+            handle = self._spawn_fn(slot.idx)
+        except Exception as e:  # noqa: BLE001 — a failed spawn backs off, not crashes
+            self._reg.counter("fleet.spawn_failures").inc()
+            self._emit(f"[fleet] spawn r{slot.idx} failed: {type(e).__name__}: {e}")
+            return False
+        with self._lock:
+            slot.handle = handle
+            slot.generation += 1
+        self._reg.counter("fleet.spawns").inc()
+        self._emit(f"[fleet] replica r{slot.idx} up: pid={handle.pid} "
+                   f"addr={handle.addr['host']}:{handle.addr['port']}")
+        return True
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetSupervisor":
+        if self._thread is not None:
+            raise RuntimeError("fleet already started")
+        with self._lock:
+            for i in range(self._n_initial):
+                self._slots[i] = _Slot(i)
+        # parallel first spawn: N children import/compile concurrently
+        threads = [
+            threading.Thread(target=self._first_spawn_guarded, args=(s,), daemon=True,
+                             name=f"fleet-spawn-r{s.idx}")
+            for s in self._slots.values()
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not self.addresses():
+            self.stop()
+            raise FleetSpawnError("no replica came up; fleet cannot start")
+        self._notify()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._supervise, name="fleet-supervise", daemon=True)
+        self._thread.start()
+        return self
+
+    def _first_spawn_guarded(self, slot: _Slot) -> None:
+        try:  # YAMT011: a dead spawn thread would silently halve the fleet
+            self._spawn_slot(slot)
+        except Exception as e:  # noqa: BLE001 — contain; start() checks coverage
+            self._reg.counter("serve.thread_crashes").inc()
+            self._emit(f"[fleet] spawn thread r{slot.idx} crashed: {type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        """Stop supervising, then drain every replica sequentially (each
+        bounded); the fleet exits with no child left behind."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._lock:
+            slots = list(self._slots.values())
+            for s in slots:
+                s.wanted = False
+        for s in slots:
+            if s.handle is not None:
+                s.handle.drain(self._drain_timeout_s)
+        self._notify()
+
+    # -- supervision (restart-on-exit with backoff) --------------------------
+
+    def _supervise(self) -> None:
+        try:  # YAMT011: the supervisor dying silently orphans the fleet
+            while not self._stop.wait(self._supervise_poll_s):
+                self._supervise_once()
+        except Exception as e:  # noqa: BLE001 — contain, count, report
+            self._reg.counter("serve.thread_crashes").inc()
+            self._emit(f"[fleet] supervise thread crashed: {type(e).__name__}: {e}")
+
+    def _supervise_once(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            slots = [s for s in self._slots.values() if s.wanted and not s.busy]
+        changed = False
+        for s in slots:
+            if s.handle is not None and s.handle.alive():
+                if s.consecutive_crashes and now - s.last_spawn_t > self.CRASH_RESET_S:
+                    s.consecutive_crashes = 0  # survived: the loop is over
+                continue
+            if s.handle is not None:
+                # died while wanted: schedule the restart with backoff
+                rc = s.handle.returncode
+                s.handle._close_log()
+                s.handle = None
+                changed = True
+                backoff = min(self._backoff_s * (2 ** s.consecutive_crashes), self._backoff_max_s)
+                s.consecutive_crashes += 1
+                s.next_restart_t = now + backoff
+                self._emit(f"[fleet] replica r{s.idx} exited rc={rc}; "
+                           f"restart in {backoff * 1e3:.0f}ms")
+            if s.handle is None and now >= s.next_restart_t:
+                self._reg.counter("fleet.restarts").inc()
+                if self._spawn_slot(s):
+                    changed = True
+                else:
+                    backoff = min(self._backoff_s * (2 ** s.consecutive_crashes),
+                                  self._backoff_max_s)
+                    s.consecutive_crashes += 1
+                    s.next_restart_t = time.monotonic() + backoff
+        if changed:
+            self._notify()
+
+    def _notify(self) -> None:
+        self._reg.gauge("fleet.replicas").set(self.n_replicas)
+        if self._on_change is not None:
+            try:
+                self._on_change(self.addresses())
+            except Exception as e:  # noqa: BLE001 — a router hiccup must not kill supervision
+                self._emit(f"[fleet] membership notify failed: {type(e).__name__}: {e}")
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def n_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots.values() if s.wanted)
+
+    def addresses(self) -> list[tuple[str, int]]:
+        with self._lock:
+            return [
+                (s.handle.addr["host"], s.handle.addr["port"])
+                for s in self._slots.values()
+                if s.wanted and s.handle is not None and s.handle.addr is not None
+            ]
+
+    def replicas(self) -> list[dict]:
+        with self._lock:
+            return [
+                {
+                    "slot": s.idx,
+                    "wanted": s.wanted,
+                    "alive": s.handle.alive() if s.handle else False,
+                    "pid": s.handle.pid if s.handle else None,
+                    "addr": s.handle.addr if s.handle else None,
+                    "generation": s.generation,
+                    "consecutive_crashes": s.consecutive_crashes,
+                }
+                for s in self._slots.values()
+            ]
+
+    # -- scaling / rolling restart / chaos -----------------------------------
+
+    def scale_to(self, n: int) -> int:
+        """Grow or shrink to ``n`` replicas (blocking: spawns wait for bind,
+        drains wait for exit). Shrink drains the NEWEST slots first. Returns
+        the achieved count."""
+        n = max(1, int(n))
+        with self._lock:
+            wanted = sorted(s.idx for s in self._slots.values() if s.wanted)
+            grow = n - len(wanted)
+            new_slots: list[_Slot] = []
+            victims: list[_Slot] = []
+            if grow > 0:
+                next_idx = (max(self._slots) + 1) if self._slots else 0
+                for i in range(grow):
+                    s = _Slot(next_idx + i)
+                    s.busy = True
+                    self._slots[s.idx] = s
+                    new_slots.append(s)
+            elif grow < 0:
+                for idx in wanted[grow:]:
+                    s = self._slots[idx]
+                    s.wanted = False
+                    s.busy = True
+                    victims.append(s)
+        for s in new_slots:
+            self._spawn_slot(s)
+            with self._lock:
+                s.busy = False
+        for s in victims:
+            if s.handle is not None:
+                s.handle.drain(self._drain_timeout_s)
+            with self._lock:
+                s.handle = None
+                del self._slots[s.idx]
+        if new_slots or victims:
+            self._notify()
+        return self.n_replicas
+
+    def rolling_restart(self) -> int:
+        """Drain + respawn every replica ONE AT A TIME (capacity never drops
+        by more than one). Returns the number restarted."""
+        with self._lock:
+            order = sorted(s.idx for s in self._slots.values() if s.wanted)
+        restarted = 0
+        for idx in order:
+            with self._lock:
+                s = self._slots.get(idx)
+                if s is None or not s.wanted or s.busy:
+                    continue
+                s.busy = True
+            try:
+                if s.handle is not None:
+                    s.handle.drain(self._drain_timeout_s)
+                    s.handle = None
+                    self._notify()  # the router must stop routing here NOW
+                if self._spawn_slot(s):
+                    restarted += 1
+                    s.consecutive_crashes = 0
+            finally:
+                with self._lock:
+                    s.busy = False
+            self._notify()
+        self._reg.counter("fleet.rolling_restarts").inc()
+        return restarted
+
+    def kill_replica(self, slot: int | None = None, *, sig: int = signal.SIGKILL,
+                     rng: random.Random | None = None) -> int | None:
+        """Chaos: kill one live replica (seeded-random when ``slot`` is
+        None). The supervise loop restarts it; the router ejects it the
+        moment a poll or dispatch hits the dead socket."""
+        with self._lock:
+            live = [s for s in self._slots.values()
+                    if s.wanted and s.handle is not None and s.handle.alive()]
+            if not live:
+                return None
+            target = (
+                next((s for s in live if s.idx == slot), None) if slot is not None
+                else (rng or random).choice(live)
+            )
+            if target is None:
+                return None
+            handle = target.handle
+        self._reg.counter("fleet.chaos_kills").inc()
+        self._emit(f"[fleet] CHAOS: sending signal {sig} to replica r{target.idx} "
+                   f"(pid {handle.pid})")
+        if not handle.send_signal(sig):
+            return None
+        return target.idx
+
+
+class FleetChaos:
+    """Seeded kill schedule against the live fleet (serve.fleet.chaos)."""
+
+    def __init__(self, fleet: FleetSupervisor, *, seed: int = 0, kill_after_s: float = 2.0,
+                 kill_period_s: float = 0.0, sig: int = signal.SIGKILL):
+        self._fleet = fleet
+        self._rng = random.Random(seed)
+        self._kill_after_s = kill_after_s
+        self._kill_period_s = kill_period_s
+        self._sig = sig
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "FleetChaos":
+        self._thread = threading.Thread(target=self._loop, name="fleet-chaos", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        try:  # YAMT011: silent chaos death = a drill that never ran
+            if self._stop.wait(self._kill_after_s):
+                return
+            self._fleet.kill_replica(rng=self._rng, sig=self._sig)
+            while self._kill_period_s > 0 and not self._stop.wait(self._kill_period_s):
+                self._fleet.kill_replica(rng=self._rng, sig=self._sig)
+        except Exception as e:  # noqa: BLE001 — contain, count, report
+            obs_registry.get_registry().counter("serve.thread_crashes").inc()
+            emit(f"[fleet] chaos thread crashed: {type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def run(cfg: Config, replica_argv: list[str]) -> dict:
+    """The fleet serving loop: supervisor + router + frontend + (optional)
+    autoscaler + chaos, until SIGTERM/SIGINT. SIGHUP = rolling restart."""
+    log = Logger(cfg.train.log_dir, enabled=True, tensorboard=False)
+    reg = obs_registry.get_registry()
+    if cfg.obs.histogram_buckets:
+        reg.set_default_buckets(cfg.obs.histogram_buckets)
+    reg.set_build_info(obs_device.build_info())  # no jax import: versions + git sha
+    log.set_registry(reg)
+    tracer = obs_trace.configure(enabled=bool(cfg.obs.trace), ring_size=cfg.obs.trace_ring_size)
+    fc = cfg.serve.fleet
+    stop_event = threading.Event()
+    rolling_event = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.log(f"signal {signum}: stopping router, draining fleet")
+        stop_event.set()
+
+    def _on_hup(signum, frame):
+        rolling_event.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_signal)
+        signal.signal(signal.SIGINT, _on_signal)
+        signal.signal(signal.SIGHUP, _on_hup)
+    except ValueError:
+        pass  # embedded (test) runs drive stop_event directly
+
+    hedger = Hedger(
+        quantile=fc.hedge.quantile, min_samples=fc.hedge.min_samples,
+        min_timer_ms=fc.hedge.min_timer_ms, max_timer_ms=fc.hedge.max_timer_ms,
+    ) if fc.hedge.enable else None
+    router = Router(
+        default_class=cfg.serve.admission.default_class,
+        poll_interval_s=fc.poll_interval_s,
+        eject_failures=fc.eject_failures,
+        route_attempts=fc.route_attempts,
+        client_timeout_s=fc.client_timeout_s,
+        hedger=hedger,
+    ).start()
+    fleet = FleetSupervisor(
+        replica_argv=replica_argv,
+        log_dir=cfg.train.log_dir,
+        replicas=fc.replicas,
+        restart_backoff_ms=fc.restart_backoff_ms,
+        restart_backoff_max_s=fc.restart_backoff_max_s,
+        spawn_timeout_s=fc.spawn_timeout_s,
+        drain_timeout_s=cfg.serve.drain_timeout_s + 10.0,
+        on_change=router.set_backends,
+        logger=log,
+    )
+    result: dict = {}
+    frontend = autoscaler = chaos = None
+    try:
+        fleet.start()
+        frontend = Frontend(
+            router,
+            host=cfg.serve.listen.host,
+            port=cfg.serve.listen.port,
+            request_timeout_s=cfg.serve.listen.request_timeout_s,
+            replica_id=cfg.serve.listen.replica_id or "router",
+        ).start()
+        addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid(),
+                "replica_id": frontend.replica_id, "role": "router",
+                "replicas": fleet.n_replicas}
+        if cfg.train.log_dir:
+            write_listen_addr(cfg.train.log_dir, addr)
+        log.log(f"fleet of {fleet.n_replicas} behind {frontend.url} "
+                f"(hedge={'on' if hedger else 'off'})")
+        if fc.autoscale.enable:
+            a = fc.autoscale
+            autoscaler = Autoscaler(
+                fleet, router,
+                min_replicas=a.min_replicas, max_replicas=a.max_replicas,
+                interval_s=a.interval_s, cooldown_s=a.cooldown_s,
+                up_p99_ms=a.up_p99_ms, down_p99_ms=a.down_p99_ms,
+                up_queue_depth=a.up_queue_depth, down_queue_depth=a.down_queue_depth,
+                signal_class=a.signal_class,
+            ).start()
+        if fc.chaos.enable:
+            chaos = FleetChaos(
+                fleet, seed=fc.chaos.seed, kill_after_s=fc.chaos.kill_after_s,
+                kill_period_s=fc.chaos.kill_period_s,
+                sig=signal.SIGKILL if fc.chaos.signal == "kill" else signal.SIGTERM,
+            ).start()
+            log.log(f"CHAOS: replica kills on (seed={fc.chaos.seed}, "
+                    f"after={fc.chaos.kill_after_s}s, period={fc.chaos.kill_period_s}s)")
+        while not stop_event.wait(0.2):
+            if rolling_event.is_set():
+                rolling_event.clear()
+                log.log("SIGHUP: rolling restart")
+                n = fleet.rolling_restart()
+                log.log(f"rolling restart complete: {n} replicas recycled")
+        result.update({"listened": True, **addr})
+    finally:
+        t0 = time.perf_counter()
+        if chaos is not None:
+            chaos.stop()
+        if autoscaler is not None:
+            autoscaler.stop()
+            result["autoscale_trace"] = autoscaler.trace
+        if frontend is not None:
+            frontend.stop()
+        router.stop()
+        fleet.stop()
+        result["drain_s"] = round(time.perf_counter() - t0, 3)
+        log.log(f"fleet drained in {result['drain_s']:.2f}s")
+        if cfg.train.log_dir:
+            if tracer.enabled:
+                tracer.write(os.path.join(cfg.train.log_dir, "obs_trace.json"))
+            os.makedirs(cfg.train.log_dir, exist_ok=True)
+            with open(os.path.join(cfg.train.log_dir, "obs_registry.json"), "w") as f:
+                json.dump(reg.snapshot(), f, indent=1, sort_keys=True)
+        log.close()
+    return result
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # replicas re-parse the SAME operator argv (app: + overrides) plus their
+    # per-slot overrides, so fleet config and replica config cannot drift;
+    # --listen sugar is meaningless here (the fleet always listens)
+    argv = [a for a in argv if a != "--listen"]
+    cfg = parse_cli(argv)
+    if not (cfg.serve.bundle or cfg.serve.export_from):
+        raise ValueError("fleet: needs serve.bundle (replicas load it at spawn)")
+    return run(cfg, argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -52,7 +52,7 @@ from ..serve.admission import AdmissionController
 from ..serve.batcher import MicroBatcher, QueueFull
 from ..serve.engine import InferenceEngine
 from ..serve.faults import FaultyEngine
-from ..serve.frontend import Frontend
+from ..serve.frontend import Frontend, write_listen_addr
 from ..serve.pipeline import PipelinedBatcher
 from ..serve.export import export_checkpoint, load_bundle
 from ..utils.logging import Logger
@@ -187,6 +187,30 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     except ValueError:
         pass
 
+    # fleet-spawned replicas (cli/fleet.py sets YAMT_FLEET_PARENT) self-
+    # drain when their supervisor PROCESS disappears — a supervisor killed
+    # -9 cannot run its drain paths, and an orphaned replica would hold its
+    # port and device lease forever. getppid() changing away from the
+    # recorded pid (reparenting to init/subreaper) is the death signal.
+    supervisor_pid = os.environ.get("YAMT_FLEET_PARENT")
+
+    def _orphan_watch():
+        try:  # YAMT011: a dead watcher silently disables orphan protection
+            parent = int(supervisor_pid)
+            while not stop_event.wait(0.5):
+                if os.getppid() != parent:
+                    log.log(f"supervisor {parent} gone (now child of {os.getppid()}): "
+                            "orphaned — draining")
+                    reg.counter("serve.orphan_exits").inc()
+                    stop_event.set()
+                    return
+        except Exception as e:  # noqa: BLE001 — contain, count, report
+            reg.counter("serve.thread_crashes").inc()
+            log.log(f"[serve] orphan watcher crashed: {type(e).__name__}: {e}")
+
+    if supervisor_pid:
+        threading.Thread(target=_orphan_watch, name="serve-orphan-watch", daemon=True).start()
+
     batcher = _make_batcher(cfg, engine).start()
     watchdog = None
     if cfg.obs.watchdog_deadline_s > 0 and cfg.train.log_dir:
@@ -220,12 +244,15 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
         request_timeout_s=cfg.serve.listen.request_timeout_s,
         retry_after_s=cfg.serve.admission.breaker_cooldown_s,
         profiler=profiler,
+        replica_id=cfg.serve.listen.replica_id,
     ).start()
-    addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid()}
+    # ephemeral ports (listen.port=0) make N replicas on one host trivial;
+    # the bound port is published ATOMICALLY (temp + rename) so a polling
+    # supervisor (cli/fleet.py) never reads a partial JSON
+    addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid(),
+            "replica_id": frontend.replica_id}
     if cfg.train.log_dir:
-        os.makedirs(cfg.train.log_dir, exist_ok=True)
-        with open(os.path.join(cfg.train.log_dir, "listen_addr.json"), "w") as f:
-            json.dump(addr, f)
+        write_listen_addr(cfg.train.log_dir, addr)
     log.log(f"listening on {frontend.url} (POST /predict, GET /healthz|/metrics|/varz)")
     try:
         stop_event.wait()

@@ -423,6 +423,11 @@ class ListenConfig:
     # (POST /profile/start|stop, obs/device.py ProfilerCapture);
     # "" = <train.log_dir>/trace (endpoints 404 when neither is set)
     profile_dir: str = ""
+    # stable replica name reported in the /healthz + /varz identity block
+    # (replica_id/pid/start_unix/git_sha) so a router can attribute health
+    # and detect a restarted process behind the same address; "" = pid-<pid>.
+    # A fleet supervisor (cli/fleet.py) assigns r<i> per slot.
+    replica_id: str = ""
 
 
 @dataclass(frozen=True)
@@ -484,6 +489,102 @@ class FaultsConfig:
     # dispatch index that HANGS until FaultyEngine.hang_release is set
     # (drain-timeout / watchdog drills); -1 = never
     hang_at: int = -1
+
+
+@dataclass(frozen=True)
+class HedgeConfig:
+    """Request hedging (serve/hedge.py): duplicate a straggler to a second
+    replica after a timer DERIVED from the router's measured per-class
+    latency (the p-quantile of serve.router.latency_seconds.<class>), first
+    answer wins, loser dropped idempotently — docs/SERVING.md "Fleet"."""
+
+    enable: bool = True
+    # the latency quantile the hedge timer fires at (0.99 = only the worst
+    # ~1% of requests ever cost a duplicate)
+    quantile: float = 0.99
+    # per-class observations required before hedging arms (a cold fleet
+    # must not hedge on garbage estimates)
+    min_samples: int = 20
+    # timer clamp: never hedge faster than min (herd protection) or wait
+    # longer than max (a wedged replica must not pin its requests forever)
+    min_timer_ms: float = 10.0
+    max_timer_ms: float = 2000.0
+
+
+@dataclass(frozen=True)
+class AutoscaleConfig:
+    """Fleet autoscaler (serve/autoscale.py): a control thread scaling the
+    replica count off the /metrics tail-latency + queue-depth families with
+    cooldown hysteresis. Off by default: a fixed-N fleet is the predictable
+    baseline; enable for diurnal traffic."""
+
+    enable: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    interval_s: float = 1.0
+    # no second scaling action within this window of the previous one —
+    # a spawn needs seconds to absorb load, and flapping costs a compile
+    cooldown_s: float = 5.0
+    # scale-up triggers (either): window p99 of the router latency family
+    # above up_p99_ms, or mean routable queue depth above up_queue_depth
+    up_p99_ms: float = 250.0
+    up_queue_depth: float = 8.0
+    # scale-down requires BOTH below these (strictly under the up
+    # thresholds — the dead band between them is the hysteresis)
+    down_p99_ms: float = 50.0
+    down_queue_depth: float = 1.0
+    # the class whose serve.router.latency_seconds histogram is the tail
+    # signal (interactive = the traffic with an SLO)
+    signal_class: str = "interactive"
+
+
+@dataclass(frozen=True)
+class FleetChaosConfig:
+    """Replica-level chaos (cli/fleet.py): a seeded schedule of kill -9
+    against live replicas mid-load — the process-granular twin of
+    serve/faults.py's in-process injection. The supervisor's restart-on-exit
+    and the router's ejection/retry are dead code until a replica actually
+    dies. Off in production."""
+
+    enable: bool = False
+    seed: int = 0
+    # first kill this long after the fleet is up
+    kill_after_s: float = 2.0
+    # subsequent kills every this often; 0 = exactly one kill
+    kill_period_s: float = 0.0
+    # "kill" = SIGKILL (no drain, the real chaos); "term" = SIGTERM
+    # (graceful — drills the drain path instead)
+    signal: str = "kill"
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Replica fleet (cli/fleet.py + serve/router.py): N cli/serve.py
+    --listen subprocesses on ephemeral ports behind one router frontend —
+    weighted routing, health ejection, hedging, restart-on-exit, rolling
+    restart, autoscaling. docs/SERVING.md "Fleet"."""
+
+    # starting replica count (the autoscaler moves N inside its own bounds)
+    replicas: int = 2
+    # router health-poll cadence against each replica's /healthz
+    poll_interval_s: float = 0.25
+    # consecutive poll/dispatch failures that eject a replica from rotation
+    eject_failures: int = 2
+    # replicas one request may try before failing typed (transport-level
+    # failures and replica-side 503s re-route; per-request verdicts do not)
+    route_attempts: int = 3
+    # per-dispatch client timeout (router -> replica)
+    client_timeout_s: float = 60.0
+    # restart-on-exit backoff: base doubles per consecutive crash of the
+    # same slot, capped — a crash-looping replica must not spin the host
+    restart_backoff_ms: float = 200.0
+    restart_backoff_max_s: float = 5.0
+    # how long a spawned replica may take to publish listen_addr.json
+    # (includes jax import + AOT warmup) before the spawn counts as failed
+    spawn_timeout_s: float = 120.0
+    hedge: HedgeConfig = field(default_factory=HedgeConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    chaos: FleetChaosConfig = field(default_factory=FleetChaosConfig)
 
 
 @dataclass(frozen=True)
@@ -586,6 +687,9 @@ class ServeConfig:
     listen: ListenConfig = field(default_factory=ListenConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    # replica fleet: router tier + hedging + autoscaler + replica chaos
+    # (cli/fleet.py; ignored by the single-replica cli/serve.py entry point)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 @dataclass(frozen=True)
@@ -658,6 +762,10 @@ _SECTION_TYPES = {
     "ListenConfig": ListenConfig,
     "AdmissionConfig": AdmissionConfig,
     "FaultsConfig": FaultsConfig,
+    "HedgeConfig": HedgeConfig,
+    "AutoscaleConfig": AutoscaleConfig,
+    "FleetChaosConfig": FleetChaosConfig,
+    "FleetConfig": FleetConfig,
     "FuseChunksConfig": FuseChunksConfig,
     "OverlapConfig": OverlapConfig,
     "ServeConfig": ServeConfig,

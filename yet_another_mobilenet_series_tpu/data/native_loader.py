@@ -40,10 +40,14 @@ def build_library(force: bool = False) -> str:
     a no-op when up to date — so a stale prebuilt library can never be used
     against newer ctypes signatures (the C ABI has grown arguments before;
     extra args are silently dropped by the calling convention)."""
+    # timeout per YAMT015: a wedged compiler must fail the load loudly, not
+    # hang the training process before its watchdog even exists
     if force:
-        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH), "-B"], check=True, capture_output=True)
+        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH), "-B"],
+                       check=True, capture_output=True, timeout=600)
     else:
-        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)], check=True, capture_output=True)
+        subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)],
+                       check=True, capture_output=True, timeout=600)
     return _LIB_PATH
 
 

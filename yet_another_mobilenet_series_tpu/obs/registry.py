@@ -60,6 +60,8 @@ PROM_LABEL_FAMILIES: dict[str, str] = {
     "serve.retries": "class",
     "serve.shed_deadline": "class",
     "serve.bucket_hits": "bucket",
+    # the fleet router's per-class latency (the hedge timer's input)
+    "serve.router.latency_seconds": "class",
 }
 
 

@@ -33,6 +33,21 @@ training graph re-run with train=False):
 - :mod:`.faults` — deterministic, seeded fault injection around any engine
   (failure rates, fail-N-then-recover, added latency, hang-until-event) so
   every recovery path above is testable and benchable.
+- :mod:`.client` — the connection-reused, typed-error HTTP client every
+  frontend caller shares (router, hedger, benches): keep-alive per thread,
+  replica verdicts surfaced as :class:`~.client.ClientHTTPError` with the
+  wire status + tag.
+- :mod:`.router` — the fleet tier: weighted routing over N replica
+  frontends driven by polled ``/healthz`` (queue depth, breaker, identity),
+  ejection/readmission, transport-level retry, hedging integration. Speaks
+  the admission protocol, so a :class:`~.frontend.Frontend` serves it
+  directly and a fleet is externally indistinguishable from one replica.
+- :mod:`.hedge` — request hedging: duplicate a straggler to a second
+  replica at a timer derived from the measured per-class latency p99;
+  first answer wins, the loser is dropped idempotently.
+- :mod:`.autoscale` — the control thread scaling replica count off the
+  measured tail-latency + queue-depth families with cooldown hysteresis
+  (cli/fleet.py is the supervisor it drives).
 
 Everything is instrumented through obs/ (``serve/*`` spans, queue-wait and
 run-latency histograms, request/shed counters), so scripts/obs_report.py
@@ -41,4 +56,21 @@ operator guide; ``cli/serve.py`` + the ``serve:`` config block are the entry
 point.
 """
 
-from .export import InferenceBundle, apply_folded, export_bundle, fold_network, load_bundle  # noqa: F401
+# Lazy re-exports (PEP 562): .export drags in jax, but the fleet supervisor
+# (cli/fleet.py) imports sibling serve modules (frontend, router, client)
+# and must stay jax-free — the replicas own the device, the parent owns
+# policy. Importing the package therefore costs nothing until an export
+# symbol is actually touched.
+_EXPORTS = ("InferenceBundle", "apply_folded", "export_bundle", "fold_network", "load_bundle")
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        from . import export
+
+        return getattr(export, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_EXPORTS))

@@ -1,0 +1,190 @@
+"""Connection-reused HTTP client for one serving replica — the client half
+of the front door, extracted so every caller that speaks to a frontend
+(the fleet router, the hedger's duplicate leg, benches, tests) shares ONE
+implementation of the wire protocol instead of three divergent
+urllib-request copies.
+
+Design points, matching the frontend's contract (serve/frontend.py):
+
+- **connection reuse**: the frontend speaks HTTP/1.1 with Content-Length on
+  every response, so keep-alive works; the client holds one persistent
+  ``http.client.HTTPConnection`` PER THREAD (the router's worker pool and
+  the poll thread each get their own socket — ``http.client`` connections
+  are not thread-safe). A stale keep-alive socket (server closed it between
+  requests) is retried ONCE on a fresh connection; a failure on the fresh
+  socket is a real :class:`ClientConnectError`.
+- **typed errors**: every non-2xx response raises :class:`ClientHTTPError`
+  carrying the HTTP status and the frontend's wire error tag
+  (``queue_full``, ``breaker_open``, ...), so the router can pass a
+  replica's typed rejection through to ITS client unchanged — a fleet is
+  externally indistinguishable from one replica. Transport-level failures
+  are :class:`ClientConnectError` (dead/refused/reset socket — the retry-
+  on-another-replica signal) or :class:`ClientTimeout` (the socket timeout
+  expired with the request possibly still running server-side).
+- **identity threading**: ``predict(..., request_id=...)`` sends
+  ``X-Request-Id``, so a router-minted id correlates the replica-side spans
+  with the router's own ``fleet/route`` span.
+
+Images ride as raw little-endian float32 bytes + ``X-Shape`` (the
+octet-stream body the frontend parses without JSON overhead).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+
+import numpy as np
+
+DEFAULT_TIMEOUT_S = 60.0
+
+
+class ClientError(RuntimeError):
+    """Base class for every typed client failure."""
+
+
+class ClientConnectError(ClientError):
+    """The replica's socket is dead: connection refused, reset, or closed
+    mid-request. The caller may safely retry ANOTHER replica — inference is
+    pure and the request either never arrived or its answer is orphaned."""
+
+
+class ClientTimeout(ClientError):
+    """The socket timeout expired. Unlike a connect error the request may
+    still be running server-side; retries must be idempotence-aware (they
+    are: inference is pure)."""
+
+
+class ClientHTTPError(ClientError):
+    """A non-2xx response with the frontend's typed error body. ``status``
+    and ``tag`` mirror the wire (``429``/``queue_full``, ``503``/
+    ``breaker_open``, ...), so routers re-raise replica verdicts verbatim."""
+
+    def __init__(self, status: int, tag: str, message: str):
+        super().__init__(f"{status} {tag}: {message}")
+        self.status = status
+        self.tag = tag
+
+
+class ReplicaClient:
+    """Typed, keep-alive HTTP client for one frontend address."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = timeout_s
+        self._local = threading.local()
+        # every connection ever opened, for close(); threads come and go
+        # (Timer threads in the hedger), so the local alone cannot enumerate
+        self._conns: list[http.client.HTTPConnection] = []
+        self._conns_lock = threading.Lock()
+
+    @classmethod
+    def from_addr(cls, addr: dict, **kw) -> "ReplicaClient":
+        """Build from a ``listen_addr.json`` dict (``{"host", "port"}``)."""
+        return cls(addr["host"], addr["port"], **kw)
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- transport ----------------------------------------------------------
+
+    def _fresh_conn(self, timeout_s: float) -> http.client.HTTPConnection:
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=timeout_s)
+        with self._conns_lock:
+            self._conns.append(conn)
+        return conn
+
+    def _request(self, method: str, path: str, body: bytes | None = None,
+                 headers: dict | None = None, timeout_s: float | None = None):
+        """(status, response headers, body bytes); one stale-socket retry."""
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        last_exc: Exception | None = None
+        for attempt in (0, 1):
+            conn = getattr(self._local, "conn", None)
+            if conn is None or attempt == 1:
+                if conn is not None:
+                    conn.close()
+                conn = self._fresh_conn(timeout_s)
+                self._local.conn = conn
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+                return resp.status, dict(resp.headers), data
+            except socket.timeout as e:
+                conn.close()
+                self._local.conn = None
+                raise ClientTimeout(
+                    f"{method} {self.base_url}{path} exceeded {timeout_s:.1f}s"
+                ) from e
+            except (ConnectionError, BrokenPipeError, http.client.HTTPException, OSError) as e:
+                # a reused socket the server already closed fails here; only
+                # the retry on a FRESH socket proves the replica is dead
+                conn.close()
+                self._local.conn = None
+                last_exc = e
+        raise ClientConnectError(
+            f"{method} {self.base_url}{path}: {type(last_exc).__name__}: {last_exc}"
+        ) from last_exc
+
+    def _request_json(self, method: str, path: str, **kw):
+        status, headers, data = self._request(method, path, **kw)
+        try:
+            doc = json.loads(data) if data else {}
+        except json.JSONDecodeError:
+            doc = {"error": "bad_body", "message": data[:200].decode("utf-8", "replace")}
+        return status, headers, doc
+
+    # -- the serving protocol ------------------------------------------------
+
+    def predict(self, image: np.ndarray, *, priority: str | None = None,
+                deadline_ms: float | None = None, request_id: str | None = None,
+                timeout_s: float | None = None) -> np.ndarray:
+        """POST one (H, W, C) image; returns the logits row. Raises the
+        typed hierarchy above on every failure mode."""
+        image = np.ascontiguousarray(image, dtype="<f4")
+        headers = {
+            "Content-Type": "application/octet-stream",
+            "X-Shape": ",".join(str(d) for d in image.shape),
+        }
+        if priority:
+            headers["X-Priority"] = priority
+        if deadline_ms is not None:
+            headers["X-Deadline-Ms"] = str(deadline_ms)
+        if request_id:
+            headers["X-Request-Id"] = str(request_id)
+        status, _, doc = self._request_json(
+            "POST", "/predict", body=image.tobytes(), headers=headers, timeout_s=timeout_s
+        )
+        if status != 200:
+            raise ClientHTTPError(status, doc.get("error", "unknown"), doc.get("message", ""))
+        return np.asarray(doc["logits"], np.float32)
+
+    def healthz(self, timeout_s: float | None = None) -> tuple[int, dict]:
+        """(status, body) — 503 is a VALUE here (breaker open / draining),
+        not an exception; only transport failures raise."""
+        status, _, doc = self._request_json("GET", "/healthz", timeout_s=timeout_s)
+        return status, doc
+
+    def varz(self, timeout_s: float | None = None) -> tuple[int, dict]:
+        status, _, doc = self._request_json("GET", "/varz", timeout_s=timeout_s)
+        return status, doc
+
+    def metrics_text(self, timeout_s: float | None = None) -> str:
+        status, _, data = self._request("GET", "/metrics", timeout_s=timeout_s)
+        if status != 200:
+            raise ClientHTTPError(status, "metrics", data[:200].decode("utf-8", "replace"))
+        return data.decode()
+
+    def close(self) -> None:
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            c.close()

@@ -49,7 +49,9 @@ line, so SIGTERM = stop accepting, then drain in-flight work.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -60,7 +62,14 @@ from ..obs.registry import get_registry
 from ..utils.logging import emit
 from .admission import BreakerOpen, DeadlineUnmeetable, BREAKER_OPEN
 from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
+from .client import ClientHTTPError, ClientTimeout
 from .context import RequestContext
+from .router import NoHealthyReplicas
+
+# this process's birth time: the replica-identity field a router compares to
+# detect a RESTARTED replica behind an unchanged address (same host:port,
+# new process) — pid alone can recycle
+_PROC_START_UNIX = time.time()
 
 # exception type -> (HTTP status, wire error tag); anything else is a 500
 _ERROR_MAP = [
@@ -69,14 +78,35 @@ _ERROR_MAP = [
     (QueueFull, 429, "queue_full"),  # covers ClassQueueFull too
     (DeadlineExceeded, 504, "deadline_exceeded"),
     (DrainTimeout, 503, "draining"),
+    (NoHealthyReplicas, 503, "no_healthy_replicas"),
+    (ClientTimeout, 504, "timeout"),
 ]
 
 
 def _classify(exc: Exception) -> tuple[int, str]:
+    # a replica's typed verdict crossing the router passes through verbatim
+    # (fleet-behind-the-frontend is indistinguishable from one replica)
+    if isinstance(exc, ClientHTTPError):
+        return exc.status, exc.tag
     for typ, status, tag in _ERROR_MAP:
         if isinstance(exc, typ):
             return status, tag
     return 500, "engine_error"
+
+
+def write_listen_addr(log_dir: str, addr: dict) -> str:
+    """Publish the bound address ATOMICALLY as ``<log_dir>/listen_addr.json``:
+    write a temp file, then rename. A polling supervisor (cli/fleet.py) reads
+    either nothing or whole JSON — never a partial document."""
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, "listen_addr.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(addr, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # atomic on POSIX: readers see old-or-new, whole
+    return path
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -125,6 +155,9 @@ class _Handler(BaseHTTPRequestHandler):
         state = fe.admission.state()
         state["inflight"] = int(get_registry().gauge("serve.inflight").value)
         state["draining"] = fe._draining
+        # replica identity: lets a router/obs_report attribute this health
+        # to a specific process and detect a restart behind the same address
+        state["replica"] = fe.identity()
         status = 503 if state["breaker_state"] == BREAKER_OPEN else 200
         state["ok"] = status == 200 and not fe._draining
         self._send_json(status, state)
@@ -154,6 +187,7 @@ class _Handler(BaseHTTPRequestHandler):
             "metrics": get_registry().snapshot(),
             "admission": fe.admission.state(),
             "draining": fe._draining,
+            "replica": fe.identity(),
             "build_info": get_registry().build_info,
             "executables": compile_report(),
         })
@@ -280,6 +314,7 @@ class Frontend:
         request_timeout_s: float = 60.0,
         retry_after_s: float = 1.0,
         profiler=None,
+        replica_id: str = "",
     ):
         self.admission = admission
         # obs/device.py ProfilerCapture (or None): POST /profile/start|stop
@@ -288,9 +323,23 @@ class Frontend:
         self._port = port
         self.request_timeout_s = request_timeout_s
         self.retry_after_s = retry_after_s
+        # stable name a supervisor assigns (serve.listen.replica_id); ports
+        # are ephemeral and pids recycle, so health/restart attribution
+        # needs an identity that survives both
+        self.replica_id = replica_id or f"pid-{os.getpid()}"
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
         self._draining = False
+
+    def identity(self) -> dict:
+        """The replica identity block on /healthz and /varz: who is serving
+        behind this address, and since when."""
+        return {
+            "replica_id": self.replica_id,
+            "pid": os.getpid(),
+            "start_unix": _PROC_START_UNIX,
+            "git_sha": get_registry().build_info.get("git_sha", ""),
+        }
 
     @property
     def port(self) -> int:

@@ -1,0 +1,387 @@
+"""Fleet router: weighted routing over N replica frontends.
+
+One replica process is bounded by one host; the millions-of-users story
+needs a shared-nothing fleet behind one address. The router is that
+address. It speaks the SAME protocol the admission controller speaks
+(``submit(image, priority, deadline_ms, ctx) -> Future`` + ``state()``), so
+``serve/frontend.py`` can serve it directly — the fleet exposes the exact
+endpoints, typed statuses, and ``X-Request-Id`` threading one replica does,
+and a client cannot tell N replicas from one.
+
+Routing policy, all driven by what the replicas THEMSELVES report:
+
+- **health polling**: a daemon thread polls every backend's ``/healthz`` at
+  ``poll_interval_s``. Each poll refreshes the replica's queue depth
+  (``queued_total``), breaker state, draining flag, and identity block
+  (``replica_id``/``pid``/``start_unix`` — a changed ``start_unix`` behind
+  the same address is a detected restart, ``fleet.replica_restarts``).
+- **weighted pick**: routable replicas are drawn with weight
+  ``1 / (1 + queue_depth)`` (seeded RNG — reproducible in tests), so load
+  skews away from backed-up replicas without starving anyone.
+- **ejection / readmission**: ``eject_failures`` consecutive failures
+  (poll or dispatch transport errors), an open breaker, or a draining flag
+  eject a replica from rotation (``fleet.ejections``); the next healthy
+  poll readmits it (``fleet.readmissions``). Ejection is advisory — with
+  every replica ejected the router fails typed
+  (:class:`NoHealthyReplicas` -> 503), never silently.
+- **transport retry**: a dead socket (:class:`~.client.ClientConnectError`)
+  or a replica-side 503 (draining / its own breaker) re-routes the request
+  to the next replica (``fleet.route_retries``), because inference is pure;
+  typed per-request verdicts (429 quota, 504 deadline, 500 engine error)
+  pass through unchanged — the replica already ran ITS retry policy.
+- **hedging** (serve/hedge.py): when a :class:`~.hedge.Hedger` is attached
+  and >= 2 replicas are routable, a timer fires at the class's p99-derived
+  bound and sends a duplicate to a second replica (primary's replica
+  excluded); first answer wins, the loser is dropped idempotently.
+
+Instrumentation: ``fleet.routed`` / ``fleet.route_retries`` /
+``fleet.route_errors`` / ``fleet.ejections`` / ``fleet.readmissions`` /
+``fleet.replica_restarts`` counters, the ``fleet.replicas_routable`` gauge,
+per-class ``serve.router.latency_seconds.<class>`` histograms (the hedge
+timer's input), and a ``fleet/route`` span per request.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from ..obs import trace as obs_trace
+from ..obs.registry import get_registry
+from ..utils.logging import emit
+from .admission import CLASSES
+from .client import ClientConnectError, ClientError, ClientHTTPError, ReplicaClient
+from .hedge import ROUTER_LATENCY, HedgedCall, Hedger
+
+
+class NoHealthyReplicas(RuntimeError):
+    """Every replica is ejected or the backend set is empty: the fleet
+    cannot serve this request (mapped to 503 by the frontend)."""
+
+
+class _Replica:
+    """Router-side view of one backend: client + polled health."""
+
+    __slots__ = ("key", "host", "port", "client", "routable", "consecutive_failures",
+                 "queue_depth", "breaker_state", "draining", "identity")
+
+    def __init__(self, host: str, port: int, client):
+        self.key = f"{host}:{port}"
+        self.host = host
+        self.port = port
+        self.client = client
+        self.routable = True
+        self.consecutive_failures = 0
+        self.queue_depth = 0.0
+        self.breaker_state = 0
+        self.draining = False
+        self.identity: dict = {}
+
+    def weight(self) -> float:
+        return 1.0 / (1.0 + max(self.queue_depth, 0.0))
+
+    def as_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "routable": self.routable,
+            "queue_depth": self.queue_depth,
+            "breaker_state": self.breaker_state,
+            "draining": self.draining,
+            "consecutive_failures": self.consecutive_failures,
+            "identity": self.identity,
+        }
+
+
+class Router:
+    """Weighted fleet router implementing the frontend's admission protocol."""
+
+    def __init__(
+        self,
+        backends=(),
+        *,
+        default_class: str = "interactive",
+        poll_interval_s: float = 0.25,
+        eject_failures: int = 2,
+        route_attempts: int = 3,
+        client_timeout_s: float = 60.0,
+        hedger: Hedger | None = None,
+        seed: int = 0,
+        max_workers: int = 32,
+        client_factory=None,
+    ):
+        if default_class not in CLASSES:
+            raise ValueError(f"default_class {default_class!r} not in {CLASSES}")
+        self._default_class = default_class
+        self._poll_interval_s = poll_interval_s
+        self._eject_failures = max(1, int(eject_failures))
+        self._route_attempts = max(1, int(route_attempts))
+        self._client_timeout_s = client_timeout_s
+        self._hedger = hedger
+        self._rng = random.Random(seed)
+        self._client_factory = client_factory or (
+            lambda host, port: ReplicaClient(host, port, timeout_s=client_timeout_s)
+        )
+        self._lock = threading.Lock()
+        self._replicas: dict[str, _Replica] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="fleet-route")
+        self._poll_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._reg = get_registry()
+        self.set_backends(backends)
+
+    # -- backend set (the supervisor / autoscaler mutate this) ---------------
+
+    def set_backends(self, backends) -> None:
+        """Reconcile the replica set against ``backends`` (iterable of
+        ``(host, port)`` or ``"host:port"``). New backends start routable;
+        removed backends have their clients closed."""
+        want: dict[str, tuple[str, int]] = {}
+        for b in backends:
+            host, port = b.rsplit(":", 1) if isinstance(b, str) else b
+            want[f"{host}:{int(port)}"] = (host, int(port))
+        with self._lock:
+            for key in [k for k in self._replicas if k not in want]:
+                rep = self._replicas.pop(key)
+                rep.client.close()
+            for key, (host, port) in want.items():
+                if key not in self._replicas:
+                    self._replicas[key] = _Replica(host, port, self._client_factory(host, port))
+            self._update_routable_gauge_locked()
+
+    def _update_routable_gauge_locked(self) -> None:
+        self._reg.gauge("fleet.replicas_routable").set(
+            sum(1 for r in self._replicas.values() if r.routable)
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Router":
+        if self._poll_thread is not None:
+            raise RuntimeError("router already started")
+        self._stop.clear()
+        self._poll_thread = threading.Thread(target=self._poll_loop, name="fleet-poll", daemon=True)
+        self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+            self._poll_thread = None
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        with self._lock:
+            for rep in self._replicas.values():
+                rep.client.close()
+
+    # -- health polling ------------------------------------------------------
+
+    def _poll_loop(self) -> None:
+        try:  # YAMT011: a silently-dead poll thread would freeze health state
+            obs_trace.get_tracer().register_thread()
+            while not self._stop.wait(self._poll_interval_s):
+                self.poll_once()
+        except Exception as e:  # noqa: BLE001 — contain, count, report
+            self._reg.counter("serve.thread_crashes").inc()
+            emit(f"[fleet] router poll thread crashed: {type(e).__name__}: {e}")
+
+    def poll_once(self) -> None:
+        """One health sweep over every backend (also callable directly —
+        tests and the autoscaler use it for deterministic refreshes)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        poll_timeout = max(2.0, 4 * self._poll_interval_s)
+        for rep in reps:
+            try:
+                status, doc = rep.client.healthz(timeout_s=poll_timeout)
+            except ClientError:
+                self._record_failure(rep)
+                continue
+            identity = doc.get("replica") or {}
+            with self._lock:
+                rep.consecutive_failures = 0
+                rep.queue_depth = float(doc.get("queued_total") or 0.0)
+                rep.breaker_state = int(doc.get("breaker_state") or 0)
+                rep.draining = bool(doc.get("draining"))
+                if (identity and rep.identity
+                        and identity.get("start_unix") != rep.identity.get("start_unix")):
+                    # same address, new process: a supervisor restarted it
+                    self._reg.counter("fleet.replica_restarts").inc()
+                if identity:
+                    rep.identity = identity
+                healthy = status == 200 and not rep.draining
+                self._set_routable_locked(rep, healthy)
+
+    def _set_routable_locked(self, rep: _Replica, routable: bool) -> None:
+        if routable and not rep.routable:
+            rep.routable = True
+            self._reg.counter("fleet.readmissions").inc()
+        elif not routable and rep.routable:
+            rep.routable = False
+            self._reg.counter("fleet.ejections").inc()
+        self._update_routable_gauge_locked()
+
+    def _record_failure(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.consecutive_failures += 1
+            if rep.consecutive_failures >= self._eject_failures:
+                self._set_routable_locked(rep, False)
+
+    # -- picking -------------------------------------------------------------
+
+    def _pick(self, exclude: set[str]) -> _Replica:
+        with self._lock:
+            pool = [r for r in self._replicas.values() if r.routable and r.key not in exclude]
+            if not pool:
+                raise NoHealthyReplicas(
+                    f"no routable replica ({len(self._replicas)} registered, "
+                    f"{len(exclude)} excluded)"
+                )
+            weights = [r.weight() for r in pool]
+            return self._rng.choices(pool, weights=weights, k=1)[0]
+
+    def set_hedger(self, hedger: Hedger | None) -> None:
+        """Swap the hedging policy live (the serve_bench A/B drives both
+        arms through ONE router so replica state is shared)."""
+        self._hedger = hedger
+
+    def n_routable(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas.values() if r.routable)
+
+    def mean_queue_depth(self) -> float:
+        """Mean polled queue depth across routable replicas (the
+        autoscaler's backlog signal); 0 with nothing routable."""
+        with self._lock:
+            depths = [r.queue_depth for r in self._replicas.values() if r.routable]
+        return sum(depths) / len(depths) if depths else 0.0
+
+    # -- the serving protocol (what Frontend consumes) -----------------------
+
+    def submit(self, image, *, priority: str | None = None,
+               deadline_ms: float | None = None, ctx=None) -> Future:
+        cls = priority or self._default_class
+        if cls not in CLASSES:
+            raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
+        fut: Future = Future()
+        call = HedgedCall(fut)
+        image = np.asarray(image, np.float32)
+        # latency is measured from HERE (submit), not from leg start: router
+        # queueing is part of what a client experiences, so the histogram
+        # the autoscaler and hedge timer read must include it
+        t_submit = time.perf_counter()
+        self._pool.submit(self._route_guarded, call, image, cls, deadline_ms, ctx, t_submit)
+        return fut
+
+    def _route_guarded(self, call, image, cls, deadline_ms, ctx, t_submit) -> None:
+        try:
+            self._route(call, image, cls, deadline_ms, ctx, t_submit)
+        except Exception as e:  # noqa: BLE001 — a crashed route must not hang its client
+            self._reg.counter("fleet.route_errors").inc()
+            call.err(HedgedCall.PRIMARY, e)
+
+    def _route(self, call, image, cls, deadline_ms, ctx, t_submit) -> None:
+        rid = ctx.wire_id if ctx is not None else None
+        timer: threading.Timer | None = None
+        primary_at: dict = {}
+        hedge_s = self._hedger.timer_s(cls) if self._hedger is not None else None
+        # the hedge timer arms at LEG start, while the histogram it derives
+        # from measures submit -> resolution: under router-side overload the
+        # timer inflates past per-leg latency, so hedging naturally backs
+        # off instead of doubling the load of an already-saturated fleet
+        if hedge_s is not None and self.n_routable() >= 2:
+            timer = threading.Timer(
+                hedge_s, self._fire_hedge,
+                args=(call, image, cls, deadline_ms, rid, primary_at, t_submit),
+            )
+            timer.daemon = True
+            timer.start()
+        try:
+            with obs_trace.get_tracer().span("fleet/route", "serve", cls=cls):
+                self._leg(call, HedgedCall.PRIMARY, image, cls, deadline_ms, rid,
+                          exclude=set(), chosen=primary_at, t_submit=t_submit)
+        finally:
+            if timer is not None and call.resolved:
+                timer.cancel()
+
+    def _fire_hedge(self, call, image, cls, deadline_ms, rid, primary_at, t_submit) -> None:
+        try:  # Timer threads die as silently as any other (YAMT011 discipline)
+            if not call.launch_hedge():
+                return  # primary already resolved; nothing to duplicate
+            exclude = {primary_at["key"]} if "key" in primary_at else set()
+            self._leg(call, HedgedCall.HEDGE, image, cls, deadline_ms, rid,
+                      exclude=exclude, t_submit=t_submit)
+        except Exception as e:  # noqa: BLE001 — contain: fail the leg, not the thread
+            self._reg.counter("fleet.route_errors").inc()
+            call.err(HedgedCall.HEDGE, e)
+
+    def _leg(self, call, leg, image, cls, deadline_ms, rid, *, exclude, chosen=None,
+             t_submit=None) -> None:
+        """One leg (primary or hedge) of one request: pick, dispatch, retry
+        transport-level failures on other replicas, resolve the call."""
+        tried = set(exclude)
+        last_exc: Exception | None = None
+        for _ in range(self._route_attempts):
+            try:
+                rep = self._pick(tried)
+            except NoHealthyReplicas as e:
+                call.err(leg, last_exc or e)
+                return
+            if chosen is not None:
+                chosen["key"] = rep.key
+            t0 = time.perf_counter() if t_submit is None else t_submit
+            try:
+                logits = rep.client.predict(
+                    image, priority=cls, deadline_ms=deadline_ms, request_id=rid,
+                    timeout_s=self._client_timeout_s,
+                )
+            except ClientConnectError as e:
+                # the socket is dead — likely a killed replica: score it,
+                # move the request to the next one (inference is pure)
+                self._record_failure(rep)
+                self._reg.counter("fleet.route_retries").inc()
+                tried.add(rep.key)
+                last_exc = e
+                continue
+            except ClientHTTPError as e:
+                if e.status == 503:
+                    # replica-local unavailability (draining / its breaker):
+                    # another replica may well serve it
+                    self._reg.counter("fleet.route_retries").inc()
+                    tried.add(rep.key)
+                    last_exc = e
+                    continue
+                call.err(leg, e)  # per-request verdict: pass through verbatim
+                return
+            except ClientError as e:  # timeout: the request burned its budget
+                call.err(leg, e)
+                return
+            with self._lock:
+                rep.consecutive_failures = 0
+            self._reg.histogram(f"{ROUTER_LATENCY}.{cls}").observe(time.perf_counter() - t0)
+            self._reg.counter("fleet.routed").inc()
+            call.ok(leg, logits)
+            return
+        call.err(leg, last_exc or NoHealthyReplicas("route attempts exhausted"))
+
+    # -- introspection (healthz / varz via the frontend) ---------------------
+
+    def replicas_state(self) -> list[dict]:
+        with self._lock:
+            return [r.as_dict() for r in self._replicas.values()]
+
+    def state(self) -> dict:
+        """The frontend's /healthz payload: aggregate availability expressed
+        in the breaker vocabulary (0 = serving, 1 = nothing routable -> 503)
+        plus the per-replica fleet table."""
+        reps = self.replicas_state()
+        routable = sum(1 for r in reps if r["routable"])
+        return {
+            "breaker_state": 0 if routable else 1,
+            "breaker": "closed" if routable else "open",
+            "queued_total": sum(r["queue_depth"] for r in reps),
+            "fleet": {"total": len(reps), "routable": routable, "replicas": reps},
+        }
