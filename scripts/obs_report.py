@@ -188,6 +188,22 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
                         snap["serve.dispatched_bytes"] / 1e9,
                         snap.get("serve.achieved_flops_per_s", 0))
                 )
+            if snap.get("serve.h2d_bytes"):
+                # the quantized-serving wire instrument: exact staged bytes
+                # (docs/SERVING.md "Quantized serving"); per-dispatch mean
+                # quarters when serve.quant.wire=uint8
+                n_disp = snap.get("serve.dispatch_seconds.count", 0)
+                per = snap["serve.h2d_bytes"] / n_disp if n_disp else 0.0
+                lines.append(
+                    "  wire bytes (h2d): {:.3f} GB staged{}".format(
+                        snap["serve.h2d_bytes"] / 1e9,
+                        f", {per / 1e6:.3f} MB/dispatch" if per else "")
+                )
+            if snap.get("serve.int8_exports"):
+                lines.append(
+                    f"  int8 exports: {snap['serve.int8_exports']:.0f} "
+                    "(gated post-training weight quantization)"
+                )
             # the QoS/resilience edge (serve/admission.py) — per-class
             # accounting + breaker/retry/drain health, when it was in play
             classes = sorted(

@@ -51,6 +51,17 @@ writes it to a BENCH_SERVE_*.json via --out. Four measurements per run:
    0). Both rounds share one arrival schedule (same seed), so the delta is
    the injected faults, not the load draw.
 
+8. **quantized-serving A/B** (``--quant``) — ONE interleaved sweep over the
+   three serving precisions per bucket: **f32** (the status quo),
+   **uint8-wire** (raw pixels on the wire, device denorm), and **int8**
+   (uint8 wire + post-training int8 weights). Per mode: median-of-rounds
+   QPS/p50/p99 plus the byte instruments from registry math — per-request
+   ``serve.h2d_bytes`` (the uint8 wire moves EXACTLY 1/4 of the f32 bytes,
+   on any host) and ``serve.dispatched_bytes`` — and the parity verdicts:
+   zero-mean denorm bitwise, mean/std wire delta vs the configured atol,
+   and the int8 export's gated top-1 agreement. Emits the BENCH_SERVE_r07
+   shape.
+
 The model is random-init + synthetic BN stats, folded through the real
 serve/export transform and dispatched through the real AOT engine — the
 numbers measure the serving path (compile, pad, dispatch, device_get), which
@@ -71,6 +82,8 @@ Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--concurrent-iters 6] [--ab-iters 5] [--no-bf16]
            [--fused] [--fuse-ladder 2,4] [--fused-iters 8]
            [--structural] [--structural-rounds 3]
+           [--quant] [--quant-iters 5] [--quant-rounds 3]
+           [--quant-top1-min 0.9]
            [--chaos-requests 80] [--chaos-qps 0] [--chaos-fault-rate 0.05]
            [--no-chaos] [--out f.json]
        python scripts/serve_bench.py --fleet [--fleet-replicas 2]
@@ -458,6 +471,160 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
             if modes["sync"]["qps"] else None
         ),
         "cpu_rehearsal_note": _STRUCTURAL_CPU_CAVEAT,
+    }
+
+
+_QUANT_CPU_CAVEAT = (
+    "cpu_rehearsal: QPS deltas between the wire modes are contention-noise on "
+    "a 1-core box (the forward dominates; the transfer it shrinks is nearly "
+    "free host-to-host). Unlike the overlap rounds, though, the HEADLINE "
+    "claim here does not need an accelerator: per-request serve.h2d_bytes is "
+    "registry math — the uint8 wire moves exactly 1/4 of the f32 wire's "
+    "bytes on ANY host — and the parity verdicts (bitwise for the zero-mean "
+    "denorm, measured max-abs delta under the configured atol otherwise, "
+    "int8 top-1 agreement over the gate) are host-independent. The "
+    "throughput win lands where H2D and HBM are real — the ROADMAP item 5 "
+    "hardware rung. Note: random-init logits are a WORST CASE for top-1 "
+    "agreement (near-ties everywhere, no trained margins), so the bench "
+    "gate is configured below the production default."
+)
+
+
+def _quant_ab(net, folded, buckets, size, iters, rounds, rng, *,
+              mean, std, top1_min):
+    """The --quant measurement: ONE interleaved sweep over the three serving
+    precisions — f32 (wire f32, weights f32), uint8-wire (wire u8, weights
+    f32), and int8 (wire u8, weights int8) — at every bucket. Per mode:
+    median-of-rounds QPS + p50/p99, per-request serve.h2d_bytes and
+    serve.dispatched_bytes registry deltas (the transferred-byte and
+    cost-byte instruments), and the parity verdicts: the zero-mean bitwise
+    check, the mean/std wire delta vs the configured atol, and the int8
+    export's gated top-1 agreement (serve/quant.py)."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.config import QuantConfig
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve import quant
+    from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+    from yet_another_mobilenet_series_tpu.serve.export import InferenceBundle
+
+    wire_atol = QuantConfig().wire_atol  # the configured (production) gate
+    reg = get_registry()
+    bundle_f32 = InferenceBundle(net=net, params=folded, meta={})
+    # the int8 export pass, gated exactly as cli/serve.py would run it:
+    # seeded synthetic raw pixels normalized with the pipeline's mean/std
+    calib_raw = rng.randint(0, 256, (32, size, size, 3)).astype(np.uint8)
+    calib = quant.normalize_reference(calib_raw, mean, std)
+    quantized, int8_report = quant.calibrate_and_quantize(
+        net, folded, calib, top1_min=top1_min)
+    bundle_int8 = InferenceBundle(net=net, params=quantized, meta={"quant": int8_report})
+
+    common = dict(buckets=buckets, image_size=size, image_sizes=(size,), fuse_ladder=())
+    engines = {
+        "f32": InferenceEngine(bundle_f32, **common),
+        "uint8_wire": InferenceEngine(bundle_f32, wire="uint8", wire_mean=mean,
+                                      wire_std=std, **common),
+        "int8": InferenceEngine(bundle_int8, wire="uint8", wire_mean=mean,
+                                wire_std=std, **common),
+    }
+    for e in engines.values():
+        e.warmup()
+
+    # parity verdicts, all on one raw batch at the largest bucket
+    cap = buckets[-1]
+    raw = rng.randint(0, 256, (cap, size, size, 3)).astype(np.uint8)
+    norm = quant.normalize_reference(raw, mean, std)
+    ref = engines["f32"].predict(norm)
+    got_u8 = engines["uint8_wire"].predict(raw)
+    wire_delta = float(np.max(np.abs(got_u8 - ref)))
+    # the bitwise regime: a zero-mean denorm is a single per-channel
+    # multiply — pinned here with a dedicated identity-norm engine pair
+    e_id_u8 = InferenceEngine(bundle_f32, wire="uint8", **common)
+    id_bitwise = bool(np.array_equal(
+        e_id_u8.predict(raw), engines["f32"].predict(quant.normalize_reference(raw))))
+    got_int8 = engines["int8"].predict(raw)
+    int8_top1 = float(np.mean(np.argmax(got_int8, -1) == np.argmax(ref, -1)))
+
+    inputs = {
+        "f32": {b: np.ascontiguousarray(norm[:b]) if b <= cap else None for b in buckets},
+        "uint8_wire": {b: np.ascontiguousarray(raw[:b]) for b in buckets},
+    }
+    inputs["int8"] = inputs["uint8_wire"]
+    per_bucket = []
+    mode_tot = {m: {"h2d": 0.0, "cost": 0.0, "requests": 0} for m in engines}
+    for b in buckets:
+        row = {"batch": b}
+        runs = {m: [] for m in engines}
+        for e, x in ((engines[m], inputs[m][b]) for m in engines):
+            e.predict(x)  # untimed page-in per mode
+        for _ in range(rounds):
+            for m, e in engines.items():  # interleaved: drift hits all alike
+                x = inputs[m][b]
+                s0 = reg.snapshot()
+                lat = []
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    e.predict(x)
+                    lat.append(time.perf_counter() - t0)
+                s1 = reg.snapshot()
+                lat.sort()
+                runs[m].append((b / (sum(lat) / len(lat)), lat))
+                mode_tot[m]["h2d"] += s1.get("serve.h2d_bytes", 0) - s0.get("serve.h2d_bytes", 0)
+                mode_tot[m]["cost"] += (
+                    s1.get("serve.dispatched_bytes", 0) - s0.get("serve.dispatched_bytes", 0))
+                mode_tot[m]["requests"] += iters
+        for m in engines:
+            ordered = sorted(runs[m], key=lambda r: r[0])
+            qps, lat = ordered[len(ordered) // 2]
+            row[f"qps_{m}"] = round(qps, 2)
+            row[f"p50_ms_{m}"] = round(_percentile(lat, 0.50) * 1e3, 3)
+            row[f"p99_ms_{m}"] = round(_percentile(lat, 0.99) * 1e3, 3)
+        per_bucket.append(row)
+
+    modes = {}
+    for m, e in engines.items():
+        t = mode_tot[m]
+        modes[m] = {
+            "quant_mode": e.quant_mode,  # the build_info label this mode serves under
+            "h2d_bytes_per_request": round(t["h2d"] / t["requests"], 1),
+            "dispatched_bytes_per_request": round(t["cost"] / t["requests"], 1),
+        }
+    wire_ratio = (modes["f32"]["h2d_bytes_per_request"]
+                  / modes["uint8_wire"]["h2d_bytes_per_request"])
+    return {
+        "image_size": size,
+        "buckets": list(buckets),
+        "rounds": rounds,
+        "iters_per_round": iters,
+        "mean": list(mean),
+        "std": list(std),
+        "per_bucket": per_bucket,
+        "modes": modes,
+        # the headline: transferred bytes per request, registry math. The
+        # cost-analysis dispatched_bytes columns above are a COMPUTE-traffic
+        # metric (they count the in-program dequant intermediates too), so
+        # the residency win reads from int8_export.resident_shrink and the
+        # transfer win from this ratio — docs/OBSERVABILITY.md.
+        "wire_bytes_ratio": round(wire_ratio, 4),
+        "parity": {
+            "identity_norm_bitwise": id_bitwise,
+            "wire_max_abs_logit_delta": round(wire_delta, 9),
+            "wire_atol": wire_atol,
+            "wire_parity_ok": wire_delta <= wire_atol,
+            "int8_top1_agreement_calib": int8_report["top1_agreement"],
+            "int8_top1_agreement_heldout": int8_top1,
+            "int8_top1_min": top1_min,
+        },
+        "int8_export": {
+            "quantized_tensors": int8_report["quantized_tensors"],
+            "bytes_f32": int8_report["bytes_f32"],
+            "bytes_int8": int8_report["bytes_int8"],
+            "resident_shrink": round(
+                int8_report["bytes_f32"] / int8_report["bytes_int8"], 4),
+            "max_abs_logit_delta_calib": int8_report["max_abs_logit_delta"],
+            "calib_images": int8_report["calib"]["images"],
+        },
+        "cpu_rehearsal_note": _QUANT_CPU_CAVEAT,
     }
 
 
@@ -910,7 +1077,8 @@ def _chaos_ab(engine, image_sizes, direct_rows, *, seed, n_requests, target_qps,
 
 def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_inflight, with_bf16,
             chaos_requests=0, chaos_qps=0.0, chaos_fault_rate=0.05, chaos_seed=0,
-            fuse_ladder=(), fused_iters=8, structural=False, structural_rounds=3):
+            fuse_ladder=(), fused_iters=8, structural=False, structural_rounds=3,
+            quant=False, quant_iters=5, quant_rounds=3, quant_top1_min=0.9):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1006,6 +1174,18 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
             conc_iters=conc_iters, max_inflight=max_inflight, staging_slots=2,
             run_max=4, fuse_ladder=fuse_ladder or (2, 4), rng=rng,
         )
+    if quant:
+        # the pipeline's ImageNet normalization constants: the realistic
+        # (nonzero-mean, delta-gated) denorm; the zero-mean bitwise regime
+        # is pinned inside the A/B with its own engine pair
+        from yet_another_mobilenet_series_tpu.config import DataConfig
+
+        dc = DataConfig()
+        ab["quant"] = _quant_ab(
+            net, bundle.params, buckets, base_size, max(1, quant_iters),
+            max(1, quant_rounds), rng, mean=dc.mean, std=dc.std,
+            top1_min=quant_top1_min,
+        )
     chaos = None
     if chaos_requests > 0:
         chaos = _chaos_ab(
@@ -1077,6 +1257,20 @@ def main(argv=None) -> int:
                          "wakeup + steady-state achieved-FLOPS deltas — the r05 shape)")
     ap.add_argument("--structural-rounds", type=int, default=3,
                     help="interleaved rounds per mode in the structural sweep")
+    ap.add_argument("--quant", action="store_true",
+                    help="run the quantized-serving A/B: one interleaved f32 / "
+                         "uint8-wire / int8 sweep per bucket with per-request "
+                         "serve.h2d_bytes + serve.dispatched_bytes registry "
+                         "deltas and the parity verdicts (the r07 shape)")
+    ap.add_argument("--quant-iters", type=int, default=5,
+                    help="timed predicts per bucket, mode, and round in the quant A/B")
+    ap.add_argument("--quant-rounds", type=int, default=3,
+                    help="interleaved rounds per mode in the quant A/B")
+    ap.add_argument("--quant-top1-min", type=float, default=0.9,
+                    help="int8 top-1 agreement gate for the bench's random-init "
+                         "model (BELOW the 0.98 production default: random-init "
+                         "logits are near-ties, the worst case for argmax "
+                         "stability — the caveat is recorded in the artifact)")
     ap.add_argument("--fleet", action="store_true",
                     help="run the REPLICA-FLEET measurement instead of the single-"
                          "process suites: N cli/serve.py replica subprocesses behind "
@@ -1173,7 +1367,10 @@ def main(argv=None) -> int:
                     fuse_ladder=tuple(int(k) for k in args.fuse_ladder.split(",")) if args.fused else (),
                     fused_iters=max(1, args.fused_iters),
                     structural=args.structural,
-                    structural_rounds=args.structural_rounds)
+                    structural_rounds=args.structural_rounds,
+                    quant=args.quant, quant_iters=args.quant_iters,
+                    quant_rounds=args.quant_rounds,
+                    quant_top1_min=args.quant_top1_min)
         out.update(m)
         out["value"] = m["peak_qps"]
     except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0

@@ -1,0 +1,41 @@
+"""YAMT016 bad fixture: wire-typed (narrow) staging buffers silently widened
+back to f32 with literal dtypes — the conversion a serve.quant.wire config
+flip can never reach."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def stage_request(image):
+    # the batcher's historical hazard shape: a buffer deliberately staged
+    # uint8 (the quantized wire), then force-converted with a literal f32
+    buf = np.zeros((8, 24, 24, 3), np.uint8)
+    buf[: len(image)] = image
+    return np.asarray(buf, np.float32)
+
+
+def explicit_astype(pixels):
+    wire = pixels.astype(np.uint8)
+    return wire.astype(np.float32)  # silent 4x widening of the wire buffer
+
+
+def dtype_less_device_conversion(batch):
+    staged = np.asarray(batch, "uint8")
+    # erases the wire contract at the host/device boundary: whatever dtype
+    # arrives rides through unstated
+    return jnp.asarray(staged)
+
+
+def mark_survives_views(image):
+    buf = np.empty((4, 16, 16, 3), dtype=np.uint8)
+    flat = buf.reshape(4, -1)  # views share the wire dtype
+    return jnp.asarray(flat, dtype=jnp.float32)
+
+
+def staging_loop(batches):
+    out = []
+    buf = np.zeros((8, 32, 32, 3), np.int8)
+    for batch in batches:
+        buf[: len(batch)] = batch
+        out.append(buf.astype("float32"))  # per-iteration widening
+    return out

@@ -1,0 +1,45 @@
+"""YAMT016 clean fixture: every conversion of a wire-typed buffer routes its
+dtype through a config-resolved variable (the serve/engine.py +
+serve/batcher.py discipline), or never touches a narrow buffer at all."""
+
+import jax.numpy as jnp
+import numpy as np
+
+WIRE_DTYPE = np.uint8  # resolved from serve.quant.wire in real code
+
+
+def stage_request(image, wire_dtype):
+    # the sanctioned idiom: the dtype is a VARIABLE a config flip reaches
+    buf = np.zeros((8, 24, 24, 3), wire_dtype)
+    buf[: len(image)] = image
+    return np.asarray(buf, wire_dtype)
+
+
+def explicit_wire_dtype(pixels):
+    wire = pixels.astype(np.uint8)
+    # stating the dtype is the point — the contract is visible, not erased
+    return jnp.asarray(wire, WIRE_DTYPE)
+
+
+def np_asarray_preserves(batch):
+    staged = np.asarray(batch, np.uint8)
+    # dtype-less NUMPY conversions preserve dtype (no device boundary) and
+    # never flag; only the jnp device hop must state the wire
+    return np.ascontiguousarray(staged)
+
+
+def f32_path_untouched(image):
+    # a genuinely-f32 pipeline may say so: the buffer was never narrow
+    buf = np.zeros((8, 24, 24, 3), np.float32)
+    buf[: len(image)] = image
+    return jnp.asarray(buf, jnp.float32)
+
+
+def rebound_name_clears(image):
+    buf = np.zeros((4, 8), np.uint8)
+    buf = compute_floats(buf)  # rebinding to an opaque call clears the mark
+    return buf.astype(np.float32)
+
+
+def compute_floats(x):
+    return x.sum(axis=-1)

@@ -117,6 +117,45 @@ def _assert_fleet(fl, *, rehearsal=False):
     assert "cpu_rehearsal" in fl["cpu_rehearsal_note"]  # the caveat is recorded
 
 
+def _assert_quant_ab(q):
+    """The --quant contract (shared by the tiny fast run and the checked-in
+    r07 rehearsal artifact): the three precision modes present with their
+    quant_mode labels, the uint8 wire moving >= 3.5x fewer transferred
+    bytes per request than the f32 wire (registry math — exactly 4x modulo
+    nothing, on ANY host), the zero-mean denorm pinned BITWISE, the
+    mean/std wire delta inside the configured atol, the int8 export's
+    top-1 agreement over its gate with the resident-byte shrink recorded,
+    and the CPU caveat explaining why QPS magnitude is not asserted."""
+    assert set(q["modes"]) == {"f32", "uint8_wire", "int8"}
+    assert q["modes"]["f32"]["quant_mode"] == "wire=float32,weights=float32"
+    assert q["modes"]["uint8_wire"]["quant_mode"] == "wire=uint8,weights=float32"
+    assert q["modes"]["int8"]["quant_mode"] == "wire=uint8,weights=int8"
+    for m, v in q["modes"].items():
+        assert v["h2d_bytes_per_request"] > 0, m
+        assert v["dispatched_bytes_per_request"] > 0, m  # CPU XLA reports cost
+    # the headline byte claim: per-request transferred bytes quarter
+    assert q["wire_bytes_ratio"] >= 3.5
+    assert q["modes"]["uint8_wire"]["h2d_bytes_per_request"] == (
+        q["modes"]["int8"]["h2d_bytes_per_request"])  # same u8 wire
+    # wire bytes are exact registry math: cap * S * S * 3 * width
+    f32_per_req = q["modes"]["f32"]["h2d_bytes_per_request"]
+    assert f32_per_req == 4 * q["modes"]["uint8_wire"]["h2d_bytes_per_request"]
+    p = q["parity"]
+    assert p["identity_norm_bitwise"] is True  # the 'fold is exact' regime
+    assert p["wire_parity_ok"] and p["wire_max_abs_logit_delta"] <= p["wire_atol"]
+    assert p["int8_top1_agreement_calib"] >= p["int8_top1_min"]
+    assert p["int8_top1_agreement_heldout"] >= p["int8_top1_min"]
+    x = q["int8_export"]
+    assert x["quantized_tensors"] >= 5
+    assert x["resident_shrink"] > 2.0  # int8 weights + f32 biases/scales/SE
+    assert x["bytes_int8"] < x["bytes_f32"]
+    assert x["calib_images"] >= 16
+    for row in q["per_bucket"]:
+        for m in q["modes"]:
+            assert row[f"qps_{m}"] > 0 and row[f"p99_ms_{m}"] >= row[f"p50_ms_{m}"] > 0, (m, row)
+    assert "cpu_rehearsal" in q["cpu_rehearsal_note"]  # the caveat is recorded
+
+
 def _assert_fused_ab(fz):
     """The chained-vs-fused A/B contract (shared by the tiny fast run and
     the checked-in r04 rehearsal artifact): one row per ladder K plus one
@@ -200,6 +239,7 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
          "--arch", "tiny", "--image-sizes", "24,32", "--buckets", "2,4", "--iters", "3",
          "--concurrent-iters", "2", "--ab-iters", "2", "--fused", "--fused-iters", "3",
          "--structural", "--structural-rounds", "2",
+         "--quant", "--quant-iters", "2", "--quant-rounds", "2",
          "--chaos-requests", "40", "--chaos-fault-rate", "0.3", "--out", str(out_path)],
         capture_output=True, text=True, timeout=420, cwd=REPO,
     )
@@ -258,6 +298,9 @@ def test_serve_bench_emits_parsed_artifact(tmp_path):
     assert bf["max_abs_logit_delta"] >= 0
     assert bf["parity_ok"] and bf["max_abs_logit_delta"] <= bf["parity_atol"]
     _assert_fused_ab(out["ab"]["fused_vs_chained"])
+    # quantized-serving A/B: the three precision modes with the exact
+    # transferred-byte quartering and all parity verdicts (the r07 shape)
+    _assert_quant_ab(out["ab"]["quant"])
     # structural sweep: the four serving structures interleaved; the tiny
     # preset pins structure + invariants only (saturation depth is timing-
     # dependent at sub-ms executables — the checked-in r05 rehearsal pins
@@ -331,6 +374,30 @@ def test_serve_bench_fleet_emits_parsed_artifact(tmp_path):
     _assert_fleet(out["fleet"])
     assert out["value"] == out["fleet"]["hedge_ab"]["unhedged"]["qps"] > 0
     assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_r07_quant_rehearsal_artifact():
+    """The r07 cpu_rehearsal artifact pins the quantized-serving acceptance:
+    per-request serve.h2d_bytes on the uint8 wire >= 3.5x lower than the
+    f32 wire (registry math, host-independent — measured exactly 4x), the
+    zero-mean denorm BITWISE-identical to the f32 wire, the mean/std wire
+    delta recorded under the configured atol, and the int8 export's top-1
+    agreement over its gate with scales + calibration provenance
+    accounted. QPS magnitude between modes is the deferred accelerator
+    measurement; the caveat is recorded in the artifact — r02/r04/r05
+    discipline."""
+    with open(os.path.join(REPO, "BENCH_SERVE_r07_cpu_rehearsal.json")) as f:
+        out = json.load(f)
+    assert out["platform"] == "cpu" and "error" not in out
+    assert out["value"] is not None and out["value"] > 0
+    prov = out["provenance"]
+    assert prov["cpu_rehearsal"] is True and prov["jax_version"]
+    _assert_quant_ab(out["ab"]["quant"])
+    # the rehearsal artifact additionally pins the exact quartering and a
+    # realistic (224px-scale) per-request byte magnitude
+    q = out["ab"]["quant"]
+    assert q["wire_bytes_ratio"] == 4.0
+    assert q["modes"]["f32"]["h2d_bytes_per_request"] >= 4 * q["image_size"] ** 2 * 3
 
 
 def test_serve_bench_r06_fleet_rehearsal_artifact():

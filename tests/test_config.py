@@ -86,6 +86,36 @@ def test_nested_serve_blocks_parse_and_override():
         cfg_lib.config_from_dict({"serve": {"admission": {"breaker": 1}}})
 
 
+def test_quant_block_parses_validates_and_overrides():
+    """serve.quant (the quantized-serving knobs) is a validated section:
+    enum wire/weights values, positive thresholds, dotted CLI overrides."""
+    cfg = cfg_lib.config_from_dict({
+        "serve": {"quant": {"wire": "uint8", "weights": "int8",
+                            "calib_batches": 3, "int8_top1_min": 0.95}}
+    })
+    assert cfg.serve.quant.wire == "uint8" and cfg.serve.quant.weights == "int8"
+    assert cfg.serve.quant.calib_batches == 3
+    assert cfg.serve.quant.int8_top1_min == 0.95
+    assert cfg.serve.quant.wire_atol > 0  # default preserved
+    cfg = cfg_lib.parse_cli(["serve.quant.wire=uint8", "serve.quant.calib_seed=7"])
+    assert cfg.serve.quant.wire == "uint8" and cfg.serve.quant.calib_seed == 7
+    # the defaults are the f32 status quo: quantization is strictly opt-in
+    assert cfg_lib.Config().serve.quant.wire == "float32"
+    assert cfg_lib.Config().serve.quant.weights == "float32"
+    with pytest.raises(ValueError, match="wire"):
+        cfg_lib.config_from_dict({"serve": {"quant": {"wire": "int4"}}})
+    with pytest.raises(ValueError, match="weights"):
+        cfg_lib.config_from_dict({"serve": {"quant": {"weights": "fp8"}}})
+    with pytest.raises(ValueError, match="calib"):
+        cfg_lib.config_from_dict({"serve": {"quant": {"calib_batches": 0}}})
+    with pytest.raises(ValueError, match="wire_atol"):
+        cfg_lib.config_from_dict({"serve": {"quant": {"wire_atol": 0}}})
+    with pytest.raises(ValueError, match="top1"):
+        cfg_lib.config_from_dict({"serve": {"quant": {"int8_top1_min": 1.5}}})
+    with pytest.raises(KeyError):
+        cfg_lib.config_from_dict({"serve": {"quant": {"wier": "uint8"}}})
+
+
 def test_shipped_apps_parse():
     apps_dir = os.path.join(os.path.dirname(cfg_lib.__file__), "apps")
     ymls = [f for f in os.listdir(apps_dir) if f.endswith(".yml")]

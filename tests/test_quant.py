@@ -1,0 +1,409 @@
+"""Quantized serving tests (docs/SERVING.md "Quantized serving").
+
+The load-bearing claims, each pinned:
+
+- **uint8 wire parity**: the u8 wire serves the SAME answers as the f32
+  wire fed :func:`serve.quant.normalize_reference` pixels — BITWISE when
+  the denorm is shift-free (zero mean: a single per-channel multiply the
+  backend cannot re-associate), and within ``serve.quant.wire_atol`` for
+  the general mean/std case (the backend may FMA-fuse the prelude). The
+  matrix crosses buckets x fused K in {1, 2, 4} x overlap on/off x the
+  sharded path, so every existing serving structure is pinned under the
+  quantized wire.
+- **wire byte accounting**: a u8 dispatch puts EXACTLY 1/4 of the f32
+  wire's bytes on the H2D wire (``serve.h2d_bytes``).
+- **int8 weights**: export-time per-output-channel symmetric quantization
+  is deterministic (same weights + batch + seed -> identical scales and
+  ranges), top-1-agreement gated (a failing gate REFUSES to export), and
+  the bundle round-trips through disk with scales + calibration provenance
+  intact (``load_bundle`` -> identical logits bitwise).
+- **composition**: int8 weights + uint8 wire + fused K + overlapped staging
+  in one engine still match the chained reference bitwise.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.config import ModelConfig, QuantConfig
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+from yet_another_mobilenet_series_tpu.parallel import mesh as mesh_lib
+from yet_another_mobilenet_series_tpu.serve import quant
+from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.export import (
+    InferenceBundle,
+    apply_folded,
+    export_bundle,
+    flatten_tree,
+    fold_network,
+    load_bundle,
+)
+from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+# the configured uint8-wire parity bar for the NON-bitwise (nonzero-mean)
+# case: measured deltas are ~0..1e-5 on the test nets (the backend usually
+# compiles the prelude identically; the gate is for the FMA-fusing case)
+WIRE_ATOL = QuantConfig().wire_atol
+
+
+def _small_net(num_classes=10, image_size=24, atom=False):
+    specs = [
+        {"t": 2, "c": 8, "n": 1, "s": 2, "k": [3, 5] if atom else 3, "se": 0.25 if atom else 0},
+        {"t": 3, "c": 16, "n": 2, "s": 2},
+    ]
+    return get_model(
+        ModelConfig(arch="mobilenet_v2", num_classes=num_classes, block_specs=specs, dropout=0.0),
+        image_size=image_size,
+    )
+
+
+def _folded_bundle(seed=0, atom=False):
+    net = _small_net(atom=atom)
+    params, state = net.init(jax.random.PRNGKey(seed))
+    k = jax.random.PRNGKey(seed + 1)
+    leaves, treedef = jax.tree.flatten(state)
+    keys = jax.random.split(k, len(leaves))
+    state = jax.tree.unflatten(
+        treedef,
+        [l + 0.1 * jnp.abs(jax.random.normal(kk, l.shape)) + 0.01 for l, kk in zip(leaves, keys)],
+    )
+    folded = fold_network(net, params, state)
+    return net, folded, InferenceBundle(net=net, params=folded, meta={})
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return _folded_bundle()
+
+
+def _raw(n, size=24, seed=0):
+    return np.random.RandomState(seed).randint(0, 256, (n, size, size, 3)).astype(np.uint8)
+
+
+def _engines(bundle, *, mean=None, std=None, overlap=False, fuse=(2, 4), mesh=None):
+    """(f32-wire, u8-wire) engine pair sharing one bundle and structure."""
+    common = dict(buckets=(2, 4), image_size=24, fuse_ladder=fuse, mesh=mesh,
+                  overlap_staging=overlap)
+    return (
+        InferenceEngine(bundle, **common),
+        InferenceEngine(bundle, wire="uint8", wire_mean=mean, wire_std=std, **common),
+    )
+
+
+# ---------------------------------------------------------------------------
+# rung 1: the uint8 wire
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+@pytest.mark.parametrize("k", [1, 2, 4])
+def test_wire_u8_bitwise_shift_free(bundle, k, overlap):
+    """Zero-mean denorm is a single per-channel multiply: u8-wire logits are
+    BITWISE identical to the f32 wire fed the host reference pixels, across
+    fused K and both staging modes (the 'fold is exact' regime)."""
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b, overlap=overlap)
+    assert e_u8.wire_parity_exact
+    raw = _raw(k * 4, seed=k)
+    handle = e_u8.predict_async(raw)
+    assert handle.dispatches == (1 if k in (1, 2, 4) else None)
+    got = handle.result()
+    ref = e_f32.predict(quant.normalize_reference(raw))
+    assert np.array_equal(got, ref)
+    assert got.dtype == np.float32
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_wire_u8_imagenet_norm_delta_gated(bundle, k):
+    """Nonzero mean: the prelude carries an additive shift the backend may
+    FMA-fuse, so parity is the measured-delta gate (recorded; usually 0)."""
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b, mean=IMAGENET_MEAN, std=IMAGENET_STD)
+    assert not e_u8.wire_parity_exact
+    raw = _raw(k * 4, seed=10 + k)
+    got = e_u8.predict(raw)
+    ref = e_f32.predict(quant.normalize_reference(raw, IMAGENET_MEAN, IMAGENET_STD))
+    delta = float(np.max(np.abs(got - ref)))
+    assert delta <= WIRE_ATOL, delta
+
+
+def test_wire_u8_padded_small_buckets(bundle):
+    """Off-bucket sizes pad with u8 zeros; real rows stay bitwise."""
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b)
+    for n in (1, 3, 5):  # pads into bucket 2 / 4 / fused tail territory
+        raw = _raw(n, seed=20 + n)
+        assert np.array_equal(
+            e_u8.predict(raw), e_f32.predict(quant.normalize_reference(raw)))
+
+
+def test_wire_u8_float_inputs_round_not_truncate(bundle):
+    """A float client array on the u8 wire is rounded-and-clipped to the
+    pixel range (astype alone would truncate and wrap negatives)."""
+    _, _, b = bundle
+    _, e_u8 = _engines(b)
+    raw = _raw(2, seed=30)
+    as_float = raw.astype(np.float64) + 0.4  # rounds back down to raw
+    assert np.array_equal(e_u8.predict(as_float), e_u8.predict(raw))
+    clipped = np.full((2, 24, 24, 3), -7.0, np.float32)  # clips to 0
+    assert np.array_equal(e_u8.predict(clipped), e_u8.predict(np.zeros((2, 24, 24, 3), np.uint8)))
+
+
+def test_wire_u8_h2d_bytes_quarter(bundle):
+    """The precise wire instrument: a u8 dispatch puts exactly 1/4 of the
+    f32 wire's bytes on H2D (serve.h2d_bytes registry deltas)."""
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b)
+    raw = _raw(4, seed=40)
+    reg = get_registry()
+    e_u8.predict(raw)  # warm both so the measured window is steady-state
+    e_f32.predict(quant.normalize_reference(raw))
+    s0 = reg.snapshot().get("serve.h2d_bytes", 0)
+    e_u8.predict(raw)
+    s1 = reg.snapshot().get("serve.h2d_bytes", 0)
+    e_f32.predict(quant.normalize_reference(raw))
+    s2 = reg.snapshot().get("serve.h2d_bytes", 0)
+    u8_bytes, f32_bytes = s1 - s0, s2 - s1
+    assert u8_bytes == 4 * 24 * 24 * 3
+    assert f32_bytes == 4 * u8_bytes
+
+
+def test_wire_u8_sharded_path(bundle):
+    """The mesh path stages u8, snapshots u8, and denormalizes on device:
+    sharded u8 == sharded f32-wire reference bitwise (and the sharded
+    result equals the unsharded one, the existing dp-engine invariant)."""
+    _, _, b = bundle
+    mesh = mesh_lib.make_mesh()
+    if mesh.size < 2:
+        pytest.skip("needs >= 2 devices (conftest fakes 8 CPU devices)")
+    buckets = (mesh.size,)  # sharded buckets must divide the mesh
+    e_f32 = InferenceEngine(b, buckets=buckets, image_size=24, fuse_ladder=(), mesh=mesh)
+    e_u8 = InferenceEngine(b, buckets=buckets, image_size=24, fuse_ladder=(), mesh=mesh,
+                           wire="uint8")
+    raw = _raw(mesh.size // 2, seed=50)  # padded: the staging pool engages
+    ref = e_f32.predict(quant.normalize_reference(raw))
+    assert np.array_equal(e_u8.predict(raw), ref)
+    # vs the UNSHARDED u8 engine: a different XLA partitioning, so f32
+    # rounding only (the same bar the existing dp-engine test uses)
+    e_plain = InferenceEngine(b, buckets=buckets, image_size=24, fuse_ladder=(), wire="uint8")
+    np.testing.assert_allclose(e_plain.predict(raw), ref, atol=1e-5, rtol=0)
+
+
+def test_wire_u8_overlap_slot_reuse(bundle):
+    """u8 staging slots recycle under overlap exactly like f32 ones: a
+    stream of distinct batches through a 2-slot pool stays bitwise per
+    batch (torn-write protection is dtype-independent)."""
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b, overlap=True)
+    batches = [_raw(3, seed=60 + i) for i in range(6)]  # padded: slots engaged
+    handles = [e_u8.predict_async(r) for r in batches]
+    for raw, h in zip(batches, handles):
+        assert np.array_equal(h.result(), e_f32.predict(quant.normalize_reference(raw)))
+
+
+def test_wire_u8_through_pipelined_batcher(bundle):
+    """End to end through the real batcher: the wire dtype rides the engine
+    (PipelinedBatcher inherits it), submit coerces once, and every client
+    row comes back bitwise-correct."""
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b)
+    batcher = PipelinedBatcher(e_u8, max_batch=4, max_wait_ms=5.0).start()
+    try:
+        assert batcher._wire_dtype == np.uint8
+        raw = _raw(6, seed=70)
+        futs = [batcher.submit(raw[i]) for i in range(6)]
+        rows = np.stack([f.result(timeout=30) for f in futs])
+    finally:
+        batcher.stop()
+    ref = e_f32.predict(quant.normalize_reference(raw))
+    assert np.array_equal(rows, ref)
+
+
+# ---------------------------------------------------------------------------
+# rung 2: int8 weights
+# ---------------------------------------------------------------------------
+
+
+def _calib(n=16, seed=3):
+    return quant.normalize_reference(_raw(n, seed=seed), IMAGENET_MEAN, IMAGENET_STD)
+
+
+def test_int8_quantize_deterministic(bundle):
+    """Same weights + same batch + same everything -> identical scales,
+    identical quantized ints, identical activation ranges (the calibration
+    determinism contract)."""
+    net, folded, _ = bundle
+    calib = _calib()
+    q1, r1 = quant.calibrate_and_quantize(net, folded, calib, top1_min=0.5)
+    q2, r2 = quant.calibrate_and_quantize(net, folded, calib, top1_min=0.5)
+    f1, f2 = flatten_tree(q1), flatten_tree(q2)
+    assert f1.keys() == f2.keys()
+    for k in f1:
+        assert np.array_equal(f1[k], f2[k]), k
+    assert r1["calib"]["activation_ranges"] == r2["calib"]["activation_ranges"]
+    assert r1["top1_agreement"] == r2["top1_agreement"]
+
+
+def test_int8_scales_per_output_channel(bundle):
+    """Per-output-channel symmetric: every quantized pair carries a scale
+    per OUTPUT channel (the last weight axis), int8 storage, f32 bias."""
+    net, folded, _ = bundle
+    q, n = quant.quantize_folded(folded)
+    assert n >= 8  # stem + expands + dws + projects + classifier at least
+    flat = flatten_tree(q)
+    qkeys = [k for k in flat if k.endswith("/w_q")]
+    assert qkeys
+    for k in qkeys:
+        base = k[: -len("/w_q")]
+        w_q, scale = flat[k], flat[base + "/w_scale"]
+        assert w_q.dtype == np.int8 and scale.dtype == np.float32
+        assert scale.shape == (w_q.shape[-1],)
+        assert np.abs(w_q).max() <= 127
+        # dequantization reconstructs within half a quantization step
+        orig = flatten_tree(folded)[base + "/w"]
+        step = scale.reshape((1,) * (orig.ndim - 1) + (-1,))
+        assert np.max(np.abs(quant.dequantize_array(w_q, scale) - orig) / step) <= 0.5 + 1e-6
+
+
+def test_int8_gate_refuses_bad_agreement(bundle):
+    """An unmeetable gate refuses the export loudly (QuantParityError) —
+    never a silently-wrong artifact."""
+    net, folded, _ = bundle
+    with pytest.raises(quant.QuantParityError, match="top-1 agreement"):
+        quant.calibrate_and_quantize(net, folded, _calib(), top1_min=1.0 + 1e-9)
+
+
+def test_int8_export_roundtrip(tmp_path, bundle):
+    """export_bundle(quant_weights='int8') -> load_bundle round-trips the
+    int8 ints, the f32 scales, and the calibration provenance; the loaded
+    bundle serves bitwise-identically to the in-memory quantized tree."""
+    net = _small_net(atom=True)
+    params, state = net.init(jax.random.PRNGKey(7))
+    calib = _calib()
+    out = export_bundle(
+        net, params, state, str(tmp_path / "b"),
+        quant_weights="int8", calib_images=calib, int8_top1_min=0.5,
+    )
+    loaded = load_bundle(out)
+    assert loaded.quant is not None
+    assert loaded.quant["weights"] == "int8"
+    assert loaded.quant["scheme"] == "per_output_channel_symmetric"
+    assert 0.5 <= loaded.quant["top1_agreement"] <= 1.0
+    assert loaded.quant["top1_min"] == 0.5
+    assert loaded.quant["bytes_int8"] < 0.5 * loaded.quant["bytes_f32"]
+    assert loaded.quant["calib"]["images"] == calib.shape[0]
+    assert loaded.quant["calib"]["activation_ranges"]  # ranges serialized
+    flat = flatten_tree(loaded.params)
+    assert any(k.endswith("/w_q") for k in flat)
+    assert all(flat[k].dtype == np.int8 for k in flat if k.endswith("/w_q"))
+    # the loaded tree serves identically to a freshly quantized one
+    folded = fold_network(net, params, state)
+    q, _ = quant.quantize_folded(folded)
+    x = _calib(4, seed=9)
+    assert np.array_equal(
+        np.asarray(apply_folded(net, loaded.params, x)),
+        np.asarray(apply_folded(net, q, x)),
+    )
+
+
+def test_int8_top1_agreement_on_heldout(bundle):
+    """The exported int8 forward agrees with f32 top-1 on a batch the
+    calibration never saw (the gate generalizes past its own batch)."""
+    net, folded, _ = bundle
+    q, report = quant.calibrate_and_quantize(net, folded, _calib(), top1_min=0.5)
+    x = _calib(24, seed=99)
+    ref = np.asarray(apply_folded(net, folded, x))
+    got = np.asarray(apply_folded(net, q, x))
+    assert float(np.mean(np.argmax(got, -1) == np.argmax(ref, -1))) >= report["top1_min"]
+
+
+@pytest.mark.slow
+def test_int8_gate_across_seeds(bundle):
+    """Calibration-heavy: the default gate holds across weight seeds (the
+    quantization error of per-channel symmetric int8 stays far inside the
+    top-1 bar on these nets)."""
+    for seed in range(3):
+        net, folded, _ = _folded_bundle(seed=seed)
+        _, report = quant.calibrate_and_quantize(
+            net, folded, _calib(32, seed=seed), top1_min=QuantConfig().int8_top1_min)
+        assert report["top1_agreement"] >= QuantConfig().int8_top1_min
+
+
+# ---------------------------------------------------------------------------
+# composition: both rungs + every serving structure
+# ---------------------------------------------------------------------------
+
+
+def test_int8_u8_wire_fused_overlap_compose(bundle):
+    """The cheap-request end state: int8 weights + uint8 wire + fused K +
+    overlapped staging in ONE engine. Structure invariance (fused/overlap
+    vs chained, same quantized params) is bitwise; accuracy vs the f32
+    bundle is the top-1 gate."""
+    net, folded, b_f32 = bundle
+    q, report = quant.calibrate_and_quantize(net, folded, _calib(), top1_min=0.5)
+    b_q = InferenceBundle(net=net, params=q, meta={"quant": report})
+    common = dict(buckets=(2, 4), image_size=24, wire="uint8",
+                  wire_mean=IMAGENET_MEAN, wire_std=IMAGENET_STD)
+    e_chained = InferenceEngine(b_q, fuse_ladder=(), **common)
+    e_full = InferenceEngine(b_q, fuse_ladder=(2, 4), overlap_staging=True,
+                             staging_slots=2, **common)
+    assert e_full.quant_mode == "wire=uint8,weights=int8"
+    raw = _raw(8, seed=80)  # 2 fused chunks of bucket 4
+    ref_q = e_chained.predict(raw)
+    h = e_full.predict_async(raw)
+    assert h.dispatches == 1  # the fused scan covered the whole request
+    assert np.array_equal(h.result(), ref_q)
+    # and the composed engine still agrees with the full-precision bundle
+    e_ref = InferenceEngine(b_f32, buckets=(2, 4), image_size=24, fuse_ladder=())
+    ref = e_ref.predict(quant.normalize_reference(raw, IMAGENET_MEAN, IMAGENET_STD))
+    agree = float(np.mean(np.argmax(ref_q, -1) == np.argmax(ref, -1)))
+    assert agree >= report["top1_min"]
+
+
+def test_cost_keys_do_not_collide_across_modes(bundle):
+    """Two engines with different quant modes in one process must not
+    cross-write each other's per-executable cost gauges (the A/B bench runs
+    exactly this shape): the keys carry wire/weight tags."""
+    from yet_another_mobilenet_series_tpu.obs import device as obs_device
+
+    _, _, b = bundle
+    e_f32, e_u8 = _engines(b, fuse=())
+    e_f32.predict(quant.normalize_reference(_raw(2, seed=90)))
+    e_u8.predict(_raw(2, seed=90))
+    report = obs_device.compile_report()
+    assert "serve_b2_s24_k1" in report
+    assert "serve_b2_s24_k1_u8" in report
+    # the u8 program's cost bytes must not be (silently) the f32 one's
+    assert report["serve_b2_s24_k1"] != report["serve_b2_s24_k1_u8"]
+
+
+# ---------------------------------------------------------------------------
+# quant.py unit edges
+# ---------------------------------------------------------------------------
+
+
+def test_denorm_constants_identity_and_validation():
+    scale, shift = quant.denorm_constants(None, None)
+    assert np.allclose(scale, np.float32(1.0 / 255.0)) and quant.shift_free(shift)
+    scale, shift = quant.denorm_constants(IMAGENET_MEAN, IMAGENET_STD)
+    assert not quant.shift_free(shift)
+    with pytest.raises(ValueError, match="positive"):
+        quant.denorm_constants(None, (0.0, 1.0, 1.0))
+    with pytest.raises(ValueError, match="3-channel"):
+        quant.denorm_constants((0.5,), None)
+    with pytest.raises(ValueError, match="wire"):
+        quant.wire_np_dtype("int4")
+
+
+def test_quantize_zero_channel_never_divides_by_zero():
+    w = np.zeros((3, 3, 4, 8), np.float32)
+    w[..., :4] = np.random.RandomState(0).normal(0, 1, (3, 3, 4, 4))
+    w_q, scale = quant.quantize_array_int8(w)
+    assert np.all(scale[4:] == 1.0)  # dead channels get the safe scale
+    assert np.all(w_q[..., 4:] == 0)
+    assert np.isfinite(quant.dequantize_array(w_q, scale)).all()

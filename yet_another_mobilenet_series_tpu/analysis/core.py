@@ -246,6 +246,7 @@ def load_rules() -> list[Rule]:
         rules_async_staging,
         rules_config,
         rules_donation,
+        rules_dtype,
         rules_imports,
         rules_logging,
         rules_prng_flow,

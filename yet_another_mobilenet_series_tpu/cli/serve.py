@@ -5,7 +5,10 @@ Three phases, all optional, driven by the ``serve:`` config block:
 
 1. **export** (``serve.export_from`` set): checkpoint -> InferenceBundle at
    ``serve.bundle`` — prune masks hard-applied, EMA weights selected, BN
-   folded into conv weights (serve/export.py).
+   folded into conv weights (serve/export.py). With
+   ``serve.quant.weights=int8`` the export additionally runs the gated
+   post-training quantization pass (seeded synthetic calibration batch
+   normalized with ``data.mean/std``; refused below the top-1 gate).
 2. **synthetic load** (``serve.requests`` > 0): load the bundle, AOT-warm
    the engine's (bucket, image_size) ladder, and drive a synthetic
    closed-loop load of ``serve.requests`` single-image requests from
@@ -54,6 +57,7 @@ from ..serve.engine import InferenceEngine
 from ..serve.faults import FaultyEngine
 from ..serve.frontend import Frontend, write_listen_addr
 from ..serve.pipeline import PipelinedBatcher
+from ..serve import quant
 from ..serve.export import export_checkpoint, load_bundle
 from ..utils.logging import Logger
 
@@ -65,13 +69,22 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def _synthetic_image(rng, image_size: int, wire: str) -> np.ndarray:
+    """One synthetic client image in the configured wire's input space:
+    normalized f32 pixels on the float32 wire (pipeline semantics), raw u8
+    pixels on the uint8 wire (the engine denormalizes on device)."""
+    if wire == "uint8":
+        return rng.randint(0, 256, (image_size, image_size, 3)).astype(np.uint8)
+    return rng.normal(0, 1, (image_size, image_size, 3)).astype(np.float32)
+
+
 def _drive_load(cfg: Config, batcher: MicroBatcher, image_size: int, log: Logger) -> dict:
     """Closed-loop synthetic clients: each thread submits one request, waits
     for its logits, repeats. Returns the latency/QPS summary."""
     n_total = cfg.serve.requests
     n_clients = max(1, cfg.serve.clients)
     rng = np.random.RandomState(0)
-    image = rng.normal(0, 1, (image_size, image_size, 3)).astype(np.float32)
+    image = _synthetic_image(rng, image_size, cfg.serve.quant.wire)
     latencies: list[float] = []
     errors = {"shed": 0, "rejected": 0, "crashed": 0}
     lock = threading.Lock()
@@ -141,6 +154,9 @@ def _make_batcher(cfg: Config, engine) -> MicroBatcher:
         queue_depth=cfg.serve.queue_depth,
         default_deadline_ms=cfg.serve.deadline_ms,
         drain_timeout_s=cfg.serve.drain_timeout_s,
+        # submit-side coercion follows the engine's wire (serve.quant.wire);
+        # FaultyEngine proxies the attribute, bare doubles default to f32
+        wire_dtype=getattr(engine, "wire_np_dtype", np.float32),
     )
     if cfg.serve.pipelined:
         return PipelinedBatcher(
@@ -290,8 +306,27 @@ def run(cfg: Config) -> dict:
         if cfg.serve.export_from:
             if not bundle_dir:
                 bundle_dir = os.path.join(cfg.train.log_dir, "bundle")
-            export_checkpoint(cfg.serve.export_from, bundle_dir, use_ema=cfg.serve.use_ema)
-            log.log(f"exported {cfg.serve.export_from} -> {bundle_dir}")
+            calib = None
+            if cfg.serve.quant.weights == "int8":
+                # held-out calibration batch for the int8 gate: seeded
+                # synthetic u8 pixels normalized with the pipeline's
+                # mean/std (no dataset is wired into the serve CLI; the
+                # bundle's provenance records the synthetic source)
+                q = cfg.serve.quant
+                crng = np.random.RandomState(q.calib_seed)
+                raw = crng.randint(
+                    0, 256,
+                    (q.calib_batches * q.calib_batch_size,
+                     cfg.data.image_size, cfg.data.image_size, 3),
+                ).astype(np.uint8)
+                calib = quant.normalize_reference(raw, cfg.data.mean, cfg.data.std)
+            export_checkpoint(
+                cfg.serve.export_from, bundle_dir, use_ema=cfg.serve.use_ema,
+                quant_weights=cfg.serve.quant.weights, calib_images=calib,
+                int8_top1_min=cfg.serve.quant.int8_top1_min,
+            )
+            log.log(f"exported {cfg.serve.export_from} -> {bundle_dir}"
+                    + (" (int8 weights, parity-gated)" if calib is not None else ""))
             result["bundle"] = bundle_dir
         if not bundle_dir:
             raise ValueError("serve: needs serve.bundle and/or serve.export_from")
@@ -310,7 +345,13 @@ def run(cfg: Config) -> dict:
             offladder_cache=cfg.serve.offladder_cache,
             overlap_staging=cfg.serve.overlap.enable,
             staging_slots=cfg.serve.overlap.staging_slots,
+            wire=cfg.serve.quant.wire,
+            wire_mean=cfg.data.mean,
+            wire_std=cfg.data.std,
         )
+        # quantization mode rides the build_info family (/metrics, /varz):
+        # a scraped fleet can group replicas by the bytes they serve with
+        reg.set_build_info({**obs_device.build_info(), "quant_mode": engine.quant_mode})
         if cfg.serve.warmup:
             t0 = time.perf_counter()
             engine.warmup()

@@ -588,6 +588,51 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class QuantConfig:
+    """Quantized serving (serve/quant.py, docs/SERVING.md "Quantized
+    serving"): the two parity-gated rungs that shrink every transferred and
+    resident serving byte. ``wire="uint8"`` ships clients' RAW pixels as u8
+    — staging slots, AOT signatures, and the H2D transfer all quarter — and
+    the compiled executable denormalizes on device with ``data.mean/std``
+    (bitwise-identical to the f32 wire when the mean is zero; measured-delta
+    gated otherwise). ``weights="int8"`` is the export-time post-training
+    pass: per-output-channel symmetric int8 weights with calibration
+    provenance in the bundle, refused below the top-1 agreement gate."""
+
+    # what clients submit and what crosses H2D: "float32" (normalized
+    # pixels, the historical contract) | "uint8" (raw pixels, device denorm)
+    wire: str = "float32"
+    # bundle weight storage at export time: "float32" | "int8"
+    weights: str = "float32"
+    # int8 calibration batch: calib_batches x calib_batch_size seeded
+    # held-out images at data.image_size (cli/serve.py synthesizes them when
+    # no dataset is wired; provenance records the source)
+    calib_batches: int = 2
+    calib_batch_size: int = 8
+    calib_seed: int = 0
+    # uint8-wire parity gate: max |logit delta| vs the f32 wire tolerated
+    # when the denorm is NOT the bitwise (zero-mean) case — the backend may
+    # FMA-fuse the prelude's multiply+add (~1-ulp input deltas)
+    wire_atol: float = 1e-3
+    # int8-weight parity gate: minimum top-1 agreement with the f32 bundle
+    # on the calibration batch; export REFUSES to write below it
+    int8_top1_min: float = 0.98
+
+    def __post_init__(self):
+        if self.wire not in ("float32", "uint8"):
+            raise ValueError(f"serve.quant.wire must be float32|uint8, got {self.wire!r}")
+        if self.weights not in ("float32", "int8"):
+            raise ValueError(f"serve.quant.weights must be float32|int8, got {self.weights!r}")
+        if self.calib_batches < 1 or self.calib_batch_size < 1:
+            raise ValueError("serve.quant.calib_batches/calib_batch_size must be >= 1")
+        if self.wire_atol <= 0:
+            raise ValueError(f"serve.quant.wire_atol must be > 0, got {self.wire_atol}")
+        if not 0.0 < self.int8_top1_min <= 1.0:
+            raise ValueError(
+                f"serve.quant.int8_top1_min must be in (0, 1], got {self.int8_top1_min}")
+
+
+@dataclass(frozen=True)
 class FuseChunksConfig:
     """Fused multi-chunk dispatch (serve/engine.py): a request larger than
     the biggest bucket rolls its chunk loop INTO the compiled program — all
@@ -678,6 +723,8 @@ class ServeConfig:
     # entries are pinned): a size-scanning client cannot OOM the server;
     # evictions count serve.evicted_executables
     offladder_cache: int = 8
+    # quantized serving: uint8 wire + int8 weight export (parity-gated)
+    quant: QuantConfig = field(default_factory=QuantConfig)
     # fused multi-chunk dispatch: whole-request inference in one dispatch
     fuse_chunks: FuseChunksConfig = field(default_factory=FuseChunksConfig)
     # overlapped staging + back-to-back dispatch: the device-resident
@@ -766,6 +813,7 @@ _SECTION_TYPES = {
     "AutoscaleConfig": AutoscaleConfig,
     "FleetChaosConfig": FleetChaosConfig,
     "FleetConfig": FleetConfig,
+    "QuantConfig": QuantConfig,
     "FuseChunksConfig": FuseChunksConfig,
     "OverlapConfig": OverlapConfig,
     "ServeConfig": ServeConfig,

@@ -11,6 +11,12 @@ training graph re-run with train=False):
   adjacent conv weights (a real weight transform), and emit an
   ``InferenceBundle`` (spec JSON via models/serialize schema v2 + npz
   weights) — plus the folded forward pass the engine runs.
+- :mod:`.quant` — quantized serving substrate: the uint8 wire's
+  denormalization constants + host reference + client coercion, and the
+  gated post-training int8 weight pass (per-output-channel symmetric
+  scales, calibration provenance, top-1 agreement gate). Module-level
+  imports are numpy-only so jax-free supervisors can keep importing
+  batcher/client.
 - :mod:`.engine` — bucketed batch shapes with pad-and-slice dispatch to an
   AOT-compiled ``(bucket, image_size)`` executable cache, async no-sync
   dispatch (``predict_async`` -> ``PendingPrediction``), reused staging

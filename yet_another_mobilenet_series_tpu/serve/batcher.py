@@ -71,6 +71,7 @@ import numpy as np
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
+from .quant import coerce_wire
 
 # queue sentinel: wakes the (blocking) collect thread for shutdown. FIFO
 # ordering makes everything enqueued before stop() drain ahead of it.
@@ -134,12 +135,18 @@ class MicroBatcher:
         queue_depth: int = 256,
         default_deadline_ms: float = 0.0,
         drain_timeout_s: float = 0.0,
+        wire_dtype=np.float32,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         self._predict = predict_fn
+        # the serving WIRE dtype (serve.quant.wire via the engine): submit
+        # coerces every image to it ONCE, so stacked batches reach the
+        # engine already wire-typed — never a hardcoded np.float32 (the
+        # pre-quantization literal YAMT016 now lints against)
+        self._wire_dtype = np.dtype(wire_dtype)
         self._max_batch = max_batch
         self._max_wait_s = max_wait_ms / 1e3
         self._default_deadline_s = default_deadline_ms / 1e3 if default_deadline_ms > 0 else None
@@ -261,7 +268,7 @@ class MicroBatcher:
         if self._thread is None:
             raise RuntimeError("batcher not started")
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
-        req = _Request(np.asarray(image, np.float32), deadline_s, priority, ctx)
+        req = _Request(coerce_wire(image, self._wire_dtype), deadline_s, priority, ctx)
         with self._live_lock:
             self._live.add(req)
         try:

@@ -71,9 +71,24 @@ idiom for). A dispatch that FAILS between the device_put and fence arming
 (device OOM, a trace callback raising) orphans the slot's buffer — fresh
 storage replaces it and the in-flight transfer keeps the old memory — so
 the pipeline's keep-serving-after-engine-errors policy can never recycle a
-possibly-in-transfer buffer. Overlapped and sync staging move the same float32 bytes, so
+possibly-in-transfer buffer. Overlapped and sync staging move the same wire bytes, so
 logits are **bitwise identical** across the two modes (pinned by
 tests/test_overlap.py across buckets, sizes, fused K, and bf16).
+
+**Quantized wire** (``wire="uint8"``, serve.quant config, serve/quant.py):
+clients submit RAW pixels, every staging slot / ``ShapeDtypeStruct`` /
+transfer is ``uint8`` — exactly 1/4 of the f32 wire's bytes per image,
+counted precisely by ``serve.h2d_bytes`` — and the compiled program
+denormalizes on device with the pipeline's mean/std before the folded
+forward (a fused prelude: one dispatch, no host normalize pass; a single
+per-channel multiply when the mean is zero, which is the bitwise-parity
+regime). Every other structure composes unchanged: fused K scans u8 chunk
+buffers, overlap fences u8 slots, the sharded path snapshots u8. Int8-weight
+bundles (``serve.quant.weights``, serve/export.py) need no engine plumbing
+at all — ``apply_folded`` dequantizes ``w_q * w_scale`` in-program, so HBM
+holds int8 while compute stays f32/bf16. There is ONE wire dtype per
+engine, resolved from config at construction (never a per-call fork):
+flipping ``serve.quant.wire`` is a config change, not a code path change.
 
 **Compilation never blocks warm traffic**: a cold (off-ladder) key compiles
 under a dedicated compile lock with a double-checked insert, OUTSIDE the
@@ -139,6 +154,7 @@ from ..obs import device as obs_device
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..parallel import mesh as mesh_lib
+from . import quant
 from .export import InferenceBundle, apply_folded
 
 # bf16 serving parity bar vs the fp32 forward on the same folded weights:
@@ -153,10 +169,13 @@ def _dtype(name: str):
     return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[name]
 
 
-def _cost_key(bucket: int, size: int, k: int) -> str:
+def _cost_key(bucket: int, size: int, k: int, tag: str = "") -> str:
     """Registry-safe executable key for the per-key cost gauges
-    (``obs.cost_flops.serve_b8_s224_k1``) and the hang report's table."""
-    return f"serve_b{bucket}_s{size}_k{k}"
+    (``obs.cost_flops.serve_b8_s224_k1``) and the hang report's table.
+    ``tag`` distinguishes quantized variants (``_u8`` wire, ``_w8``
+    weights) so an A/B running several engines in one process never
+    cross-writes another mode's cost gauges."""
+    return f"serve_b{bucket}_s{size}_k{k}{tag}"
 
 
 class _StagingSlot:
@@ -188,8 +207,10 @@ class _SlotPool:
 
     __slots__ = ("slots", "_next")
 
-    def __init__(self, shape: tuple[int, ...], n: int):
-        self.slots = [_StagingSlot(np.zeros(shape, np.float32)) for _ in range(n)]
+    def __init__(self, shape: tuple[int, ...], n: int, dtype=np.float32):
+        # the buffer dtype IS the wire dtype (serve.quant.wire): uint8 slots
+        # hold, and transfer, exactly 1/4 of the f32 bytes
+        self.slots = [_StagingSlot(np.zeros(shape, dtype)) for _ in range(n)]
         self._next = 0
 
     def acquire(self, reg) -> _StagingSlot:
@@ -289,6 +310,9 @@ class InferenceEngine:
         offladder_cache: int = 8,
         overlap_staging: bool = False,
         staging_slots: int = 2,
+        wire: str = "float32",
+        wire_mean: Sequence[float] | None = None,
+        wire_std: Sequence[float] | None = None,
     ):
         if not buckets:
             raise ValueError("engine needs at least one batch bucket")
@@ -314,6 +338,25 @@ class InferenceEngine:
         self._overlap = bool(overlap_staging)
         self._staging_slots = int(staging_slots) if self._overlap else 1
         self._compute_dtype = _dtype(compute_dtype)
+        # the WIRE dtype (serve.quant.wire): what clients submit, what the
+        # staging slots hold, and what crosses H2D. "uint8" ships RAW pixels
+        # at 1/4 the bytes; the compiled program denormalizes on device with
+        # the pipeline's mean/std (serve/quant.py — a single per-channel
+        # multiply when the mean is zero, which is the bitwise-parity case).
+        self._wire = wire
+        self._wire_np = quant.wire_np_dtype(wire)  # validates the name too
+        self._wire_jnp = jnp.uint8 if wire == "uint8" else jnp.float32
+        self._denorm_scale, self._denorm_shift = quant.denorm_constants(wire_mean, wire_std)
+        # int8-weight bundles (serve.quant.weights) need no engine plumbing
+        # — apply_folded dequantizes in-program — but the cost-gauge keys
+        # must not collide with an f32 engine's in the same process
+        self._weights = "int8" if any(
+            "w_q" in leaf for leaf in jax.tree.leaves(
+                bundle.params, is_leaf=lambda x: isinstance(x, dict) and "w_q" in x)
+            if isinstance(leaf, dict)
+        ) else "float32"
+        self._cost_tag = ("_u8" if wire == "uint8" else "") + (
+            "_w8" if self._weights == "int8" else "")
         self._mesh = mesh
         self._donate = donate_input
         if mesh is not None:
@@ -347,6 +390,39 @@ class InferenceEngine:
         obs_device.install_memory_gauges(self._reg)
         obs_device.install_dispatch_efficiency_gauge(self._reg)
 
+    # -- quantization surface ----------------------------------------------
+
+    @property
+    def wire(self) -> str:
+        """The wire mode name ("float32" | "uint8")."""
+        return self._wire
+
+    @property
+    def wire_np_dtype(self):
+        """numpy dtype clients' batches are coerced to (the batchers read
+        this so submit-side coercion matches the staging buffers)."""
+        return self._wire_np
+
+    @property
+    def weights(self) -> str:
+        """Weight storage of the loaded bundle ("float32" | "int8")."""
+        return self._weights
+
+    @property
+    def quant_mode(self) -> str:
+        """One label summarizing both quantization rungs — the
+        ``serve.quant_mode`` build-info value (docs/OBSERVABILITY.md)."""
+        return f"wire={self._wire},weights={self._weights}"
+
+    @property
+    def wire_parity_exact(self) -> bool:
+        """True when the u8 wire's device denorm is a single per-channel
+        multiply (zero mean): logits are BITWISE identical to the f32 wire
+        fed :func:`serve.quant.normalize_reference` pixels. With a nonzero
+        mean the backend may fuse the multiply+add into an FMA, so parity is
+        the measured-delta gate instead (serve/quant.py)."""
+        return quant.shift_free(self._denorm_shift)
+
     # -- compilation --------------------------------------------------------
 
     def _on_ladder(self, key: tuple[int, int, int]) -> bool:
@@ -359,11 +435,17 @@ class InferenceEngine:
 
     def _build(self, bucket: int, size: int, k: int):
         def run_one(params, x):
+            if self._wire == "uint8":
+                # the uint8 wire's in-program denorm prelude: raw pixels ->
+                # the f32 values the f32 wire would have carried (a single
+                # per-channel multiply when the mean is zero — the bitwise
+                # case; serve/quant.py). Fused into the same dispatch.
+                x = quant.denormalize_device(x, self._denorm_scale, self._denorm_shift)
             return apply_folded(self.net, params, x, compute_dtype=self._compute_dtype)
 
         if k == 1:
             run = run_one
-            x_shape = jax.ShapeDtypeStruct((bucket, size, size, 3), jnp.float32)
+            x_shape = jax.ShapeDtypeStruct((bucket, size, size, 3), self._wire_jnp)
         else:
             # the chunk loop, in-program: scan the SAME per-chunk forward
             # over the leading chunk axis — one dispatch for K chunks
@@ -374,7 +456,7 @@ class InferenceEngine:
                 _, ys = jax.lax.scan(body, None, xs)
                 return ys
 
-            x_shape = jax.ShapeDtypeStruct((k, bucket, size, size, 3), jnp.float32)
+            x_shape = jax.ShapeDtypeStruct((k, bucket, size, size, 3), self._wire_jnp)
         kwargs = {}
         if self._mesh is not None:
             kwargs["in_shardings"] = (
@@ -388,7 +470,7 @@ class InferenceEngine:
             # cost_analysis flops/bytes -> per-executable obs.cost_* gauges —
             # every warmed executable is cost-accounted in the obs snapshot
             compiled = obs_device.timed_compile(
-                fn.lower(self._params, x_shape), _cost_key(bucket, size, k),
+                fn.lower(self._params, x_shape), _cost_key(bucket, size, k, self._cost_tag),
                 registry=self._reg,
             )
         self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
@@ -493,11 +575,11 @@ class InferenceEngine:
         with self._cache_lock:
             pool = self._staging.get(key)
             if pool is None:
-                pool = self._staging[key] = _SlotPool(shape, self._staging_slots)
+                pool = self._staging[key] = _SlotPool(shape, self._staging_slots, self._wire_np)
         slot = pool.acquire(self._reg)
         flat = slot.buf.reshape(total, size, size, 3)
         flat[:n] = rows_arr
-        flat[n:] = 0.0
+        flat[n:] = 0
         self._reg.counter("serve.padded_rows").inc(total - n)
         return slot.buf, slot
 
@@ -514,9 +596,11 @@ class InferenceEngine:
         tracer = obs_trace.get_tracer()
         t0 = time.perf_counter()
         slot = None
+        wire_nbytes = 0
         try:
             with tracer.span("serve/stage", "serve", bucket=bucket, rows=rows, k=k):
                 staged, slot = self._stage(images[start : start + rows], key)
+                wire_nbytes = staged.nbytes
                 if self._mesh is not None:
                     # pinned copy semantics: shard_batch's device_put reads the
                     # host buffer on a backend-defined schedule, so a pool-owned
@@ -575,6 +659,12 @@ class InferenceEngine:
             self._reg.counter("serve.fused_dispatches").inc()
             self._reg.counter("serve.fused_chunks").inc(k)
         self._reg.counter(f"serve.bucket_hits.{bucket}").inc(k)
+        # the EXACT bytes this dispatch put on the H2D wire (the staged host
+        # array's nbytes — wire-dtype-sized, so the uint8 wire shows the 4x
+        # drop precisely): the instrument the quant A/B reads, next to the
+        # cost-analysis whole-program serve.dispatched_bytes below
+        if wire_nbytes:
+            self._reg.counter("serve.h2d_bytes").inc(wire_nbytes)
         # cost-analysis FLOPs + bytes this dispatch put on the device: the
         # numerator of serve.achieved_flops_per_s (dispatch efficiency) and
         # its transfer-side twin serve.dispatched_bytes (obs/device.py).
@@ -584,9 +674,9 @@ class InferenceEngine:
             ("serve.dispatched_flops", obs_device.flops_for),
             ("serve.dispatched_bytes", obs_device.bytes_for),
         ):
-            cost = lookup(_cost_key(bucket, size, k))
+            cost = lookup(_cost_key(bucket, size, k, self._cost_tag))
             if k > 1:
-                per_chunk = lookup(_cost_key(bucket, size, 1))
+                per_chunk = lookup(_cost_key(bucket, size, 1, self._cost_tag))
                 if per_chunk:
                     cost = per_chunk * k
             if cost:
@@ -594,8 +684,13 @@ class InferenceEngine:
         return logits, rows
 
     def predict_async(self, images: np.ndarray, ctxs=None) -> PendingPrediction:
-        """Dispatch without syncing: (N, S, S, 3) float32 -> handle whose
-        ``result()`` yields (N, num_classes) float32 logits. An oversized
+        """Dispatch without syncing: (N, S, S, 3) in the WIRE dtype -> handle
+        whose ``result()`` yields (N, num_classes) float32 logits. On the
+        float32 wire inputs are already-normalized pixels (pipeline
+        semantics, the historical contract); on the uint8 wire they are RAW
+        pixels 0..255 (integer arrays pass through; float arrays are
+        rounded-and-clipped, serve/quant.py) and the compiled program
+        denormalizes on device. An oversized
         request becomes ONE fused dispatch per ladder piece (a whole
         on-ladder request is a single dispatch + single transfer); every
         piece is dispatched before the caller can sync, so the device
@@ -611,7 +706,7 @@ class InferenceEngine:
         not be mutated until ``result()`` returns (the batchers always pass
         freshly-stacked arrays; with ``overlap_staging=False`` the transfer
         copies synchronously and no such constraint exists)."""
-        images = np.asarray(images, np.float32)
+        images = quant.coerce_wire(images, self._wire_np)
         if images.ndim != 4 or images.shape[1] != images.shape[2]:
             raise ValueError(f"predict expects (N, S, S, 3), got shape {images.shape}")
         n = images.shape[0]
@@ -641,7 +736,8 @@ class InferenceEngine:
         return PendingPrediction(self, parts, t_start, time.perf_counter(), ctxs=ctxs)
 
     def predict(self, images: np.ndarray, ctxs=None) -> np.ndarray:
-        """(N, S, S, 3) float32 (already normalized, pipeline semantics) ->
+        """(N, S, S, 3) in the wire dtype (float32 wire: already-normalized
+        pipeline pixels; uint8 wire: raw pixels, denormalized on device) ->
         (N, num_classes) float32 logits. N is unconstrained: > max bucket is
         served fused (one dispatch per ladder piece), all dispatched before
         the single sync."""

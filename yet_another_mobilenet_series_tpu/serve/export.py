@@ -24,10 +24,18 @@ On disk a bundle is a directory::
     bundle/
       spec.json     network_to_dict(net, inference=True)  (schema v2)
       weights.npz   folded params, tree paths joined with '/'
-      meta.json     provenance: source step, ema, prune report
+      meta.json     provenance: source step, ema, prune report, and — for
+                    an int8 export — the "quant" block (scheme, scales
+                    accounting, calibration ranges, measured top-1
+                    agreement; serve/quant.py)
 
 ``inference: true`` in the spec marks the weights as folded: the training
 loader must never resume from a bundle (models/serialize.spec_is_inference).
+An int8 bundle (``serve.quant.weights="int8"``) stores each quantized
+conv/dense pair as ``w_q`` (int8) + ``w_scale`` (f32 per output channel) +
+the f32 bias — npz round-trips the dtypes — and :func:`apply_folded`
+dequantizes them in-program, so the loaded artifact and the device-resident
+tree stay ~4x smaller than the f32 fold.
 """
 
 from __future__ import annotations
@@ -139,19 +147,49 @@ def fold_network(net: Network, params: dict, state: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def apply_folded(net: Network, params: dict, x, *, compute_dtype=jnp.float32):
+def _dense_params(p):
+    """Folded dense params with int8 weights dequantized in-program (see
+    :func:`_weight`); f32 params pass through untouched."""
+    if "w_q" in p:
+        return {**{k: v for k, v in p.items() if k not in ("w_q", "w_scale")},
+                "w": _weight(p)}
+    return p
+
+
+def _weight(p):
+    """The f32 weight of a folded conv/dense param dict. An int8-quantized
+    pair ({'w_q', 'w_scale'}, serve/quant.py) dequantizes IN-PROGRAM —
+    ``w_q.astype(f32) * w_scale`` — so the device-resident tree stays int8
+    (~4x less HBM) and only the compute reads full width."""
+    if "w_q" in p:
+        return p["w_q"].astype(jnp.float32) * p["w_scale"]
+    return p["w"]
+
+
+def apply_folded(net: Network, params: dict, x, *, compute_dtype=jnp.float32, collect=None):
     """Inference forward over folded params: conv(+bias) -> act, no BN, no
     dropout, no masks (pruning was applied physically at export). Mirrors
     Network.apply's eval path structurally; the spec tree is the same
-    Network — only the param tree shape differs."""
+    Network — only the param tree shape differs. int8-quantized weight pairs
+    (``w_q``/``w_scale``, serve/quant.py) dequantize in-program.
+
+    ``collect`` (a dict, optional) receives per-stage activation (min, max)
+    pairs — the int8 export's calibration instrument. Pass it only on EAGER
+    calls (export-time calibration): under jit the collected values would be
+    tracers."""
+
+    def observe(name, h):
+        if collect is not None:
+            collect[name] = (jnp.min(h), jnp.max(h))
+        return h
 
     def conv_bias_act(spec: Conv2D, p, h, act_name):
-        h = spec.apply({"w": p["w"]}, h, compute_dtype=compute_dtype)
+        h = spec.apply({"w": _weight(p)}, h, compute_dtype=compute_dtype)
         h = h + p["b"].astype(h.dtype)
         return get_activation(act_name)(h)
 
     h = x.astype(compute_dtype)
-    h = conv_bias_act(net.stem.conv, params["stem"], h, net.stem.active_fn)
+    h = observe("stem", conv_bias_act(net.stem.conv, params["stem"], h, net.stem.active_fn))
     for i, blk in enumerate(net.blocks):
         pb = params["blocks"][str(i)]
         act = get_activation(blk.active_fn)
@@ -164,7 +202,7 @@ def apply_folded(net: Network, params: dict, x, *, compute_dtype=jnp.float32):
         for bi, kz, g, _off in blk._branches():
             sl = h[..., _off : _off + g]
             p = pb[f"dw{bi}_k{kz}"]
-            y = Conv2D(g, g, kz, blk.stride, groups=g).apply({"w": p["w"]}, sl, compute_dtype=compute_dtype)
+            y = Conv2D(g, g, kz, blk.stride, groups=g).apply({"w": _weight(p)}, sl, compute_dtype=compute_dtype)
             branches.append(y + p["b"].astype(y.dtype))
         h = branches[0] if len(branches) == 1 else jnp.concatenate(branches, axis=-1)
         h = act(h)
@@ -175,13 +213,17 @@ def apply_folded(net: Network, params: dict, x, *, compute_dtype=jnp.float32):
         h = conv_bias_act(Conv2D(blk.expanded_channels, blk.out_channels, 1), pb["project"], h, blk.project_act)
         if blk.has_residual:
             h = h + hin.astype(h.dtype)
+        h = observe(f"block{i}", h)
     if net.head is not None:
-        h = conv_bias_act(net.head.conv, params["head"], h, net.head.active_fn)
+        h = observe("head", conv_bias_act(net.head.conv, params["head"], h, net.head.active_fn))
     h = global_avg_pool(h)
     if net.feature is not None:
-        h = net.feature.apply(params["feature"], h, compute_dtype=compute_dtype)
+        h = net.feature.apply(_dense_params(params["feature"]), h, compute_dtype=compute_dtype)
         h = get_activation(net.feature_act)(h)
-    return net.classifier.apply(params["classifier"], h.astype(jnp.float32))
+    return observe(
+        "logits",
+        net.classifier.apply(_dense_params(params["classifier"]), h.astype(jnp.float32)),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -191,11 +233,21 @@ def apply_folded(net: Network, params: dict, x, *, compute_dtype=jnp.float32):
 
 @dataclass(frozen=True)
 class InferenceBundle:
-    """A loaded serving artifact: the (pruned) Network spec + folded params."""
+    """A loaded serving artifact: the (pruned) Network spec + folded params.
+    ``params`` may carry int8-quantized weight pairs (``w_q``/``w_scale``,
+    serve/quant.py) when the bundle was exported with
+    ``serve.quant.weights="int8"`` — :func:`apply_folded` dequantizes them
+    in-program, so the engine needs no special handling."""
 
     net: Network
     params: dict
     meta: dict[str, Any]
+
+    @property
+    def quant(self) -> dict | None:
+        """The int8 export's provenance block (scheme, calibration ranges,
+        measured top-1 agreement) — None for an f32 bundle."""
+        return self.meta.get("quant")
 
 
 def export_bundle(
@@ -206,10 +258,24 @@ def export_bundle(
     *,
     masks: dict | None = None,
     extra_meta: dict[str, Any] | None = None,
+    quant_weights: str = "float32",
+    calib_images: np.ndarray | None = None,
+    int8_top1_min: float = 0.98,
 ) -> str:
     """Write an InferenceBundle directory. ``masks`` (a live AtomNAS mask
     dict) are hard-applied via nas/rematerialize first; pass the EMA trees as
-    (params, state) to export the shadow weights."""
+    (params, state) to export the shadow weights.
+
+    ``quant_weights="int8"`` additionally runs the gated post-training
+    quantization pass (serve/quant.py): per-output-channel symmetric int8
+    weights, top-1 agreement vs the f32 fold measured on ``calib_images``
+    (required in this mode) and refused below ``int8_top1_min``; scales and
+    calibration provenance land in ``meta.json["quant"]`` and round-trip
+    through :func:`load_bundle`."""
+    from .quant import WEIGHT_DTYPES, calibrate_and_quantize
+
+    if quant_weights not in WEIGHT_DTYPES:
+        raise ValueError(f"quant_weights must be one of {WEIGHT_DTYPES}, got {quant_weights!r}")
     with obs_trace.get_tracer().span("serve/export", "serve"):
         meta: dict[str, Any] = dict(extra_meta or {})
         if masks:
@@ -226,6 +292,13 @@ def export_bundle(
                     "dropped_blocks": report.dropped_blocks,
                 }
         folded = fold_network(net, params, state)
+        if quant_weights == "int8":
+            if calib_images is None:
+                raise ValueError("int8 export needs a calibration batch (calib_images)")
+            folded, meta["quant"] = calibrate_and_quantize(
+                net, folded, calib_images, top1_min=int8_top1_min
+            )
+            get_registry().counter("serve.int8_exports").inc()
         os.makedirs(out_dir, exist_ok=True)
         with open(os.path.join(out_dir, "spec.json"), "w") as f:
             json.dump(network_to_dict(net, inference=True), f, indent=1)
@@ -236,9 +309,19 @@ def export_bundle(
     return out_dir
 
 
-def export_checkpoint(ckpt_dir: str, out_dir: str, *, use_ema: bool = True, step: int | None = None) -> str:
+def export_checkpoint(
+    ckpt_dir: str,
+    out_dir: str,
+    *,
+    use_ema: bool = True,
+    step: int | None = None,
+    quant_weights: str = "float32",
+    calib_images: np.ndarray | None = None,
+    int8_top1_min: float = 0.98,
+) -> str:
     """Orbax checkpoint directory -> bundle: two-phase restore (spec first,
-    pruned-shape ordering), EMA selection, then :func:`export_bundle`."""
+    pruned-shape ordering), EMA selection, then :func:`export_bundle` (which
+    the int8 quantization knobs pass straight through to)."""
     from ..ckpt.manager import CheckpointManager
 
     mgr = CheckpointManager(ckpt_dir, barrier_prefix="serve_export")
@@ -260,6 +343,8 @@ def export_checkpoint(ckpt_dir: str, out_dir: str, *, use_ema: bool = True, step
         masks=tree.get("masks") or None,
         extra_meta={"source": ckpt_dir, "step": int(np.asarray(tree["step"])), "ema": ema_ok,
                     "epoch": (extra or {}).get("epoch")},
+        quant_weights=quant_weights, calib_images=calib_images,
+        int8_top1_min=int8_top1_min,
     )
 
 

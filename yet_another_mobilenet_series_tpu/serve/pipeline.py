@@ -127,11 +127,17 @@ class PipelinedBatcher(MicroBatcher):
         queue_depth: int = 256,
         default_deadline_ms: float = 0.0,
         drain_timeout_s: float = 0.0,
+        wire_dtype=None,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         if run_max < 1:
             raise ValueError(f"run_max must be >= 1, got {run_max}")
+        # the wire dtype rides the engine (serve.quant.wire): submit-side
+        # coercion must match the engine's staging buffers, so inherit it
+        # unless the caller overrides (bare test doubles default to f32)
+        if wire_dtype is None:
+            wire_dtype = getattr(engine, "wire_np_dtype", np.float32)
         super().__init__(
             engine.predict,
             max_batch=max_batch,
@@ -139,6 +145,7 @@ class PipelinedBatcher(MicroBatcher):
             queue_depth=queue_depth,
             default_deadline_ms=default_deadline_ms,
             drain_timeout_s=drain_timeout_s,
+            wire_dtype=wire_dtype,
         )
         self._engine = engine
         self._max_inflight = max_inflight
