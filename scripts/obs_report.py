@@ -237,16 +237,30 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
             hits = {k.rsplit(".", 1)[-1]: v for k, v in snap.items() if k.startswith("serve.bucket_hits.")}
             if hits:
                 lines.append("  bucket hits: " + ", ".join(f"{b}: {v:.0f}" for b, v in sorted(hits.items(), key=lambda kv: int(kv[0]))))
+            if snap.get("serve.brownout_transitions") or snap.get("serve.brownout_level"):
+                # the degradation ladder (serve/brownout.py): where it sits
+                # now and how much it moved — recovery to L0 with up == down
+                # transition counts is the healthy end state of a storm
+                lines.append(
+                    f"  brownout: level = L{snap.get('serve.brownout_level', 0):.0f}, "
+                    f"transitions = {snap.get('serve.brownout_transitions', 0):.0f} "
+                    f"(up {snap.get('serve.brownout_transitions.up', 0):.0f}, "
+                    f"down {snap.get('serve.brownout_transitions.down', 0):.0f}), "
+                    f"shed at door = {snap.get('serve.rejected_brownout', 0):.0f}, "
+                    f"hedges suppressed = {snap.get('serve.hedges_suppressed', 0):.0f}"
+                )
             if snap.get("fleet.routed") or snap.get("fleet.spawns"):
                 # the replica-fleet tier (serve/router.py + cli/fleet.py):
                 # routing, hedging, supervision, and scaling accounting
                 lines.append(
                     f"  fleet: routed = {snap.get('fleet.routed', 0):.0f} "
                     f"(retries {snap.get('fleet.route_retries', 0):.0f}, "
-                    f"errors {snap.get('fleet.route_errors', 0):.0f}), "
+                    f"errors {snap.get('fleet.route_errors', 0):.0f}, "
+                    f"backpressure {snap.get('fleet.backpressure', 0):.0f}), "
                     f"replicas routable = {snap.get('fleet.replicas_routable', 0):.0f}"
                     f"/{snap.get('fleet.replicas', 0):.0f}, "
-                    f"ejections = {snap.get('fleet.ejections', 0):.0f}, "
+                    f"ejections = {snap.get('fleet.ejections', 0):.0f} "
+                    f"(slow {snap.get('fleet.slow_ejections', 0):.0f}), "
                     f"readmissions = {snap.get('fleet.readmissions', 0):.0f}, "
                     f"restarts detected = {snap.get('fleet.replica_restarts', 0):.0f}"
                 )
@@ -255,7 +269,8 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
                     f"(failed {snap.get('fleet.spawn_failures', 0):.0f}), "
                     f"restarts = {snap.get('fleet.restarts', 0):.0f}, "
                     f"rolling restarts = {snap.get('fleet.rolling_restarts', 0):.0f}, "
-                    f"chaos kills = {snap.get('fleet.chaos_kills', 0):.0f}, "
+                    f"chaos kills = {snap.get('fleet.chaos_kills', 0):.0f} "
+                    f"(degrades {snap.get('fleet.chaos_degrades', 0):.0f}), "
                     f"scale ups/downs = {snap.get('fleet.scale_ups', 0):.0f}"
                     f"/{snap.get('fleet.scale_downs', 0):.0f}"
                 )

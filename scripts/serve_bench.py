@@ -77,6 +77,20 @@ does not depend on trained weight values.
    autoscaler's N-over-time trace across a diurnal low/high/low open-loop
    schedule (cooldown respected). Emits the BENCH_SERVE_r06 shape.
 
+9. **overload** (``--overload``, standalone mode) — the brownout ladder's
+   acceptance experiment (serve/brownout.py): ONE seeded open-loop Poisson
+   storm at ``--overload-multiple`` x the measured closed-loop capacity
+   (the engine paced by a seeded per-dispatch latency floor so capacity is
+   box-independent), played through fresh batcher+admission stacks twice —
+   brownout OFF vs ON. Pinned: interactive availability ON > OFF, zero
+   unresolved futures in both arms, the ladder stepping up during the
+   storm AND fully recovering to L0 after it. Then the GRAY-FAILURE round:
+   a real fleet with a latency-injected (never crashing) straggler, soft
+   ejection armed mid-round — time-to-eject from the arming instant, and
+   the p99 of requests submitted after the ejection vs before (the
+   submit-time split makes the recovery claim routing-honest). Emits the
+   BENCH_SERVE_r08 shape.
+
 Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--image-sizes 224] [--buckets 1,8,32] [--iters 10]
            [--concurrent-iters 6] [--ab-iters 5] [--no-bf16]
@@ -89,6 +103,10 @@ Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
        python scripts/serve_bench.py --fleet [--fleet-replicas 2]
            [--fleet-requests 40] [--fleet-qps 0] [--fleet-straggler-ms 400]
            [--fleet-phase-s 5,20,10] [--fleet-seed 0] [--out f.json]
+       python scripts/serve_bench.py --overload [--overload-storm-s 5]
+           [--overload-multiple 3] [--overload-pace-ms 20]
+           [--overload-replicas 2] [--overload-gray-requests 60]
+           [--overload-straggler-ms 300] [--overload-seed 0] [--out f.json]
 """
 
 from __future__ import annotations
@@ -924,6 +942,394 @@ def measure_fleet(arch, image_size, buckets, *, replicas, requests, target_qps,
         fleet.stop()
 
 
+_OVERLOAD_CPU_CAVEAT = (
+    "cpu_rehearsal: engine, batcher, controller, and load generator share "
+    "this box's core(s), so absolute QPS/latency are contention-dominated. "
+    "The pinned structural claims are host-independent: interactive-class "
+    "availability under the SAME seeded 3x-capacity storm is higher with "
+    "the brownout ladder on than off, the ladder steps up during the storm "
+    "and fully recovers to L0 after it, every submitted future resolves "
+    "(zero unresolved), and the gray-failure round shows the latency-based "
+    "soft ejection firing within the configured window followed by tail "
+    "recovery. Absolute capacity is an accelerator measurement — the same "
+    "caveat discipline as r02/r04/r05/r06."
+)
+
+_OVERLOAD_CLASS_MIX = {"interactive": 0.4, "batch": 0.2, "best_effort": 0.4}
+
+
+def _overload_round(admission, images, *, seed, n_requests, target_qps,
+                    deadline_ms_by_class):
+    """One open-loop Poisson storm through an admission controller. Same
+    discipline as ``_chaos_round``: pre-drawn arrivals fire on schedule,
+    EVERY future resolves (a hang is ``unresolved`` > 0), per-class books
+    balance. Latencies are stamped at resolution via callbacks so the p99
+    does not silently include the tail of the arrival schedule."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.serve.batcher import DeadlineExceeded, DrainTimeout
+
+    rs = np.random.RandomState(seed)
+    classes, probs = zip(*sorted(_OVERLOAD_CLASS_MIX.items()))
+    draws_cls = [classes[i] for i in rs.choice(len(classes), size=n_requests, p=probs)]
+    gaps = rs.exponential(1.0 / target_qps, size=n_requests)
+    stats = {c: {"submitted": 0, "completed": 0, "rejected": 0, "shed": 0, "failed": 0}
+             for c in classes}
+    lat = {c: [] for c in classes}
+    lat_lock = threading.Lock()
+    pending = []
+    t_start = time.perf_counter()
+    t_next = t_start
+    for i in range(n_requests):
+        t_next += gaps[i]
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)  # open loop: the schedule paces us, not completions
+        cls = draws_cls[i]
+        stats[cls]["submitted"] += 1
+        t0 = time.perf_counter()
+        try:
+            fut = admission.submit(images[cls], priority=cls,
+                                   deadline_ms=deadline_ms_by_class.get(cls))
+        except Exception:  # noqa: BLE001 — typed arrival rejection (quota/brownout/deadline)
+            stats[cls]["rejected"] += 1
+            continue
+
+        def _stamp(fut, cls=cls, t0=t0):
+            if fut.exception() is None:
+                with lat_lock:
+                    lat[cls].append(time.perf_counter() - t0)
+
+        fut.add_done_callback(_stamp)
+        pending.append((cls, fut))
+    unresolved = 0
+    for cls, fut in pending:
+        try:
+            fut.result(timeout=300)
+            stats[cls]["completed"] += 1
+        except (DeadlineExceeded, DrainTimeout):
+            stats[cls]["shed"] += 1
+        except FutTimeout:
+            unresolved += 1  # a real hang: the no-client-ever-hangs invariant broke
+        except Exception:  # noqa: BLE001 — typed rejection or engine failure
+            stats[cls]["failed"] += 1
+    wall = time.perf_counter() - t_start
+    out = {"wall_s": round(wall, 3), "unresolved": unresolved, "classes": {}}
+    for cls in classes:
+        s = stats[cls]
+        ls = sorted(lat[cls])
+        avail = s["completed"] / s["submitted"] if s["submitted"] else None
+        out["classes"][cls] = {
+            **s,
+            "availability": round(avail, 4) if avail is not None else None,
+            "p50_ms": round(_percentile(ls, 0.50) * 1e3, 3),
+            "p99_ms": round(_percentile(ls, 0.99) * 1e3, 3),
+        }
+    return out
+
+
+def measure_overload(arch, image_size, buckets, *, storm_s, multiple, seed,
+                     pace_ms, replicas, gray_requests, straggler_ms, log_root):
+    """The ``--overload`` measurement, two halves:
+
+    1. **brownout A/B** (in-process): ONE seeded open-loop Poisson storm at
+       ``multiple`` x the measured closed-loop capacity, run twice through
+       fresh batcher+admission stacks — brownout OFF vs ON — with
+       interactive deadlines derived from the warm p50. The engine is
+       PACED (seeded FaultyEngine latency floor of ``pace_ms`` per
+       dispatch) so capacity is deterministic on any box — a tiny model on
+       a fast host would otherwise absorb any finite storm before the
+       ladder could tick. The pinned claim: interactive availability
+       (completed/submitted) is higher with the ladder on, the ladder
+       steps up under the storm and fully recovers to L0 after it, and
+       nothing hangs in either arm.
+    2. **gray-failure round** (real fleet): replica subprocesses behind the
+       router, the highest slot latency-injected (slow-but-alive, never
+       crashing). Soft ejection is armed at the round start (a known t0),
+       so time-to-eject is measured, and completion-stamped latencies
+       split at the ejection instant pin the tail recovering after it.
+    """
+    import jax
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.cli.fleet import FleetSupervisor
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.admission import AdmissionController
+    from yet_another_mobilenet_series_tpu.serve.brownout import BrownoutController
+    from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+    from yet_another_mobilenet_series_tpu.serve.export import InferenceBundle, export_bundle, fold_network
+    from yet_another_mobilenet_series_tpu.serve.faults import FaultyEngine
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+    from yet_another_mobilenet_series_tpu.serve.router import Router
+    from yet_another_mobilenet_series_tpu.serve.signals import SignalReader
+
+    reg = get_registry()
+    if arch == "tiny":  # same contract-test preset as measure()
+        mc = ModelConfig(arch="mobilenet_v2", num_classes=16, dropout=0.0,
+                         block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}, {"t": 2, "c": 16, "n": 1, "s": 2}])
+    else:
+        mc = ModelConfig(arch=arch)
+    net = get_model(mc, image_size)
+    params, state = net.init(jax.random.PRNGKey(0))
+    bundle = InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
+    engine = InferenceEngine(bundle, buckets=buckets, image_size=image_size)
+    engine.warmup()
+    # deterministic capacity ceiling: every dispatch pays pace_ms at sync,
+    # so "3x capacity" means the same storm on a laptop and a server
+    paced = FaultyEngine(engine, seed=seed, latency_s=pace_ms / 1e3, latency_rate=1.0)
+    rng = np.random.RandomState(seed)
+    images = {c: rng.normal(0, 1, (image_size, image_size, 3)).astype("float32")
+              for c in _OVERLOAD_CLASS_MIX}
+    max_batch = max(buckets)
+    out = {"image_size": image_size, "seed": seed, "storm_s": storm_s,
+           "pace_ms": pace_ms, "class_mix": dict(_OVERLOAD_CLASS_MIX)}
+
+    def _stack():
+        b = PipelinedBatcher(paced, max_batch=max_batch, max_wait_ms=5.0,
+                             queue_depth=128, drain_timeout_s=60.0).start()
+        a = AdmissionController(b, max_retries=1, retry_backoff_ms=5.0,
+                                breaker_threshold=50, breaker_cooldown_s=0.5, seed=seed)
+        return b, a
+
+    # -- capacity calibration (closed loop, brownout off) --------------------
+    b, a = _stack()
+    warm_lat = []
+    n_warm, n_clients = 48, max_batch
+
+    def _warm_client(n):
+        img = images["interactive"]
+        for _ in range(n):
+            t0 = time.perf_counter()
+            a.submit(img, priority="interactive").result(timeout=60)
+            warm_lat.append(time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=_warm_client, args=(n_warm // n_clients,), daemon=True)
+               for _ in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    warm_wall = time.perf_counter() - t0
+    b.stop()
+    warm_lat.sort()
+    capacity_qps = len(warm_lat) / warm_wall if warm_wall > 0 else 1.0
+    p50_ms = max(_percentile(warm_lat, 0.5) * 1e3, 0.5)
+    storm_qps = multiple * capacity_qps
+    # duration-driven storm: the ladder needs seconds of sustained overload
+    # to climb, so the request count follows the rate, not vice versa
+    requests = max(40, int(storm_qps * storm_s))
+    out["requests"] = requests
+    # interactive deadline: far above the healthy latency, far below what a
+    # sustained 3x backlog produces — the availability instrument
+    interactive_deadline_ms = max(8.0 * p50_ms, 100.0)
+    deadlines = {"interactive": interactive_deadline_ms}
+    out["capacity"] = {
+        "closed_loop_qps": round(capacity_qps, 2), "clients": n_clients,
+        "warm_p50_ms": round(p50_ms, 3), "storm_qps": round(storm_qps, 2),
+        "multiple": multiple,
+        "interactive_deadline_ms": round(interactive_deadline_ms, 1),
+    }
+
+    # -- the A/B: one seeded storm, brownout off vs on -----------------------
+    arms = {}
+    for mode in ("off", "on"):
+        b, a = _stack()
+        controller = None
+        if mode == "on":
+            controller = BrownoutController(
+                SignalReader(latency_family="serve.latency_seconds",
+                             signal_class="interactive",
+                             queue_depth_fn=a.queued_total),
+                (b, a),
+                interval_s=0.1,
+                up_p99_ms=max(4.0 * p50_ms, 40.0),
+                down_p99_ms=max(1.5 * p50_ms, 10.0),
+                up_queue_depth=1.5 * max_batch,
+                down_queue_depth=0.5 * max_batch,
+                hold_up_s=0.3, cooldown_s=0.5,
+                retry_after_s=1.0,
+                # stdout is the ONE-JSON-line artifact: transitions -> stderr
+                log_fn=lambda m: print(m, file=sys.stderr, flush=True),
+            ).start()
+        s0 = reg.snapshot()
+        rnd = _overload_round(a, images, seed=seed + 1, n_requests=requests,
+                              target_qps=storm_qps, deadline_ms_by_class=deadlines)
+        s1 = reg.snapshot()
+        rnd["shed_at_door_brownout"] = int(s1.get("serve.rejected_brownout", 0)
+                                           - s0.get("serve.rejected_brownout", 0))
+        if controller is not None:
+            # recovery: idle windows are relaxed; one level per cooldown
+            settle_until = time.monotonic() + 6 * controller._cooldown_s + 2.0
+            while controller.level > 0 and time.monotonic() < settle_until:
+                time.sleep(0.1)
+            trace = controller.trace
+            controller.stop()
+            rnd["brownout"] = {
+                "peak_level": max((r["level"] for r in trace), default=0),
+                "final_level": trace[-1]["level"] if trace else None,
+                "recovered_to_l0": bool(trace and trace[-1]["level"] == 0),
+                "transitions_up": sum(1 for r in trace if r["action"] == "up"),
+                "transitions_down": sum(1 for r in trace if r["action"] == "down"),
+                "trace": trace,
+            }
+        b.stop()
+        arms[mode] = rnd
+    out["storm"] = {
+        "off": arms["off"], "on": arms["on"],
+        "interactive_availability_off": arms["off"]["classes"]["interactive"]["availability"],
+        "interactive_availability_on": arms["on"]["classes"]["interactive"]["availability"],
+    }
+
+    # -- gray failure: slow-but-alive replica, soft ejection + recovery ------
+    bundle_dir = os.path.join(log_root, "bundle")
+    export_bundle(net, params, state, bundle_dir)
+    replica_argv = [
+        f"serve.bundle={bundle_dir}",
+        f"data.image_size={image_size}",
+        f"serve.buckets=[{','.join(str(x) for x in buckets)}]",
+        "serve.max_wait_ms=2.0",
+        "serve.drain_timeout_s=10",
+    ]
+    straggler_slot = replicas - 1
+    per_slot = {straggler_slot: [
+        "serve.faults.enable=true",
+        f"serve.faults.latency_ms={straggler_ms}",
+        "serve.faults.latency_rate=1.0",  # EVERY dispatch is slow: gray, not flaky
+        "serve.faults.fail_at=result",
+        f"serve.faults.seed={seed + 7}",
+    ]}
+
+    class _StderrLog:
+        def log(self, msg):
+            print(msg, file=sys.stderr, flush=True)
+
+    # soft ejection configured but DISARMED for the warm phase: arming it at
+    # the round start gives time-to-eject a known zero point
+    router = Router(poll_interval_s=0.25, eject_failures=2, route_attempts=3,
+                    client_timeout_s=60.0, seed=seed,
+                    slow_eject=False, slow_factor=3.0, slow_eject_after=3,
+                    slow_cooldown_s=60.0, slow_min_ms=1.0)
+    fleet = FleetSupervisor(
+        replica_argv=replica_argv, log_dir=log_root, replicas=replicas,
+        per_slot_argv=per_slot, spawn_timeout_s=240.0, drain_timeout_s=30.0,
+        on_change=router.set_backends, logger=_StderrLog(),
+    )
+    gray = {"replicas": replicas, "straggler": {"slot": straggler_slot,
+                                                "latency_ms": straggler_ms,
+                                                "latency_rate": 1.0}}
+    try:
+        t0 = time.perf_counter()
+        fleet.start()
+        router.start()
+        gray["spawn_s"] = round(time.perf_counter() - t0, 2)
+        img = images["interactive"]
+        warm = []
+        for _ in range(24):  # teaches every replica's per-leg EWMA
+            t1 = time.perf_counter()
+            router.submit(img).result(timeout=60)
+            warm.append(time.perf_counter() - t1)
+        warm.sort()
+        healthy_p50_s = max(warm[len(warm) // 4], 1e-3)  # lower quartile ~ healthy replicas
+        # capped well below capacity: this round measures DETECTION and the
+        # tail, not throughput — the round must outlast eject + recovery
+        gray_qps = min(max(3.0, 0.4 / healthy_p50_s), 20.0)
+        gray["target_qps"] = round(gray_qps, 2)
+        s_before = reg.snapshot()
+        slow0 = s_before.get("fleet.slow_ejections", 0)
+        eject0 = s_before.get("fleet.ejections", 0)
+        armed = {}  # set mid-round: the detector's zero point
+        eject_at = {}
+
+        def _watch():
+            while "t" not in eject_at:
+                t_armed = armed.get("t")
+                if t_armed is not None and time.perf_counter() - t_armed > 120:
+                    return
+                if reg.snapshot().get("fleet.slow_ejections", 0) > slow0:
+                    eject_at["t"] = time.perf_counter()
+                    return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+        lat_rows = []
+        lat_lock = threading.Lock()
+        rs = np.random.RandomState(seed + 9)
+        gaps = rs.exponential(1.0 / gray_qps, size=gray_requests)
+        pending = []
+        t_next = time.perf_counter()
+        for i in range(gray_requests):
+            t_next += gaps[i]
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            if i == gray_requests // 3 and "t" not in armed:
+                # arm the detector MID-round: the first third measures the
+                # straggler-poisoned tail, then time-to-eject runs from here
+                armed["t"] = time.perf_counter()
+                router.set_slow_ejection(True)
+            t1 = time.perf_counter()
+            fut = router.submit(img)
+
+            def _stamp(fut, t1=t1):
+                # keyed by SUBMIT time: a request submitted after the
+                # ejection can only have been routed to healthy replicas,
+                # so the before/after split is routing-honest even for
+                # straggler-queued requests completing late
+                if fut.exception() is None:
+                    with lat_lock:
+                        lat_rows.append((t1, time.perf_counter() - t1))
+
+            fut.add_done_callback(_stamp)
+            pending.append(fut)
+        unresolved = failed = 0
+        for fut in pending:
+            try:
+                fut.result(timeout=120)
+            except Exception as e:  # noqa: BLE001 — typed verdicts; hangs counted apart
+                from concurrent.futures import TimeoutError as FutTimeout
+
+                if isinstance(e, FutTimeout):
+                    unresolved += 1
+                else:
+                    failed += 1
+        watcher.join(timeout=5)
+        t_eject = eject_at.get("t")
+        t_armed = armed.get("t")
+        s_end = reg.snapshot()
+        gray.update({
+            "submitted": gray_requests,
+            "completed": len(lat_rows),
+            "failed": failed,
+            "unresolved": unresolved,
+            "slow_ejections": int(s_end.get("fleet.slow_ejections", 0) - slow0),
+            "ejections_total": int(s_end.get("fleet.ejections", 0) - eject0),
+            "time_to_eject_s": (round(t_eject - t_armed, 3)
+                                if t_eject is not None and t_armed is not None else None),
+        })
+        if t_eject is not None:
+            before = sorted(d for t, d in lat_rows if t <= t_eject)
+            after = sorted(d for t, d in lat_rows if t > t_eject)
+            gray["p99_ms_before_eject"] = round(_percentile(before, 0.99) * 1e3, 3)
+            gray["p99_ms_after_eject"] = round(_percentile(after, 0.99) * 1e3, 3)
+            gray["post_eject_samples"] = len(after)
+            gray["tail_recovery"] = (
+                round(gray["p99_ms_before_eject"] / gray["p99_ms_after_eject"], 3)
+                if gray["p99_ms_after_eject"] else None
+            )
+        out["gray"] = gray
+        out["cpu_rehearsal_note"] = _OVERLOAD_CPU_CAVEAT
+        return out
+    finally:
+        router.stop()
+        fleet.stop()
+
+
 _CHAOS_CLASS_MIX = {"interactive": 0.5, "batch": 0.3, "best_effort": 0.2}
 
 
@@ -1287,6 +1693,27 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-phase-s", default="5,20,10",
                     help="low,high,trough durations (s) of the autoscaler's diurnal schedule")
     ap.add_argument("--fleet-seed", type=int, default=0)
+    ap.add_argument("--overload", action="store_true",
+                    help="run the OVERLOAD measurement instead of the single-"
+                         "process suites: brownout-off vs brownout-on on one "
+                         "seeded 3x-capacity open-loop storm (in-process), plus "
+                         "a gray-failure fleet round measuring time-to-soft-"
+                         "eject and tail recovery (the r08 shape)")
+    ap.add_argument("--overload-storm-s", type=float, default=5.0,
+                    help="duration of each storm arm (requests = rate x duration)")
+    ap.add_argument("--overload-multiple", type=float, default=3.0,
+                    help="storm arrival rate as a multiple of measured capacity")
+    ap.add_argument("--overload-pace-ms", type=float, default=20.0,
+                    help="seeded per-dispatch latency floor pacing the engine so "
+                         "capacity (and thus the storm) is box-independent")
+    ap.add_argument("--overload-replicas", type=int, default=2,
+                    help="fleet size for the gray-failure round (straggler is the "
+                         "highest slot)")
+    ap.add_argument("--overload-gray-requests", type=int, default=60,
+                    help="open-loop requests in the gray-failure round")
+    ap.add_argument("--overload-straggler-ms", type=float, default=300.0,
+                    help="injected completion latency on the gray straggler")
+    ap.add_argument("--overload-seed", type=int, default=0)
     ap.add_argument("--chaos-requests", type=int, default=80,
                     help="open-loop Poisson requests per chaos round (healthy + faulty)")
     ap.add_argument("--chaos-qps", type=float, default=0.0,
@@ -1300,6 +1727,52 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
     image_sizes = tuple(int(s) for s in args.image_sizes.split(","))
+
+    if args.overload:
+        # standalone like --fleet: the storm arms own their batcher stacks
+        # and the gray round owns replica subprocesses
+        import shutil
+        import tempfile
+
+        out = {
+            "metric": f"{args.arch}_overload_interactive_availability",
+            "value": None,
+            "unit": "completed/submitted",
+            "vs_baseline": None,
+            "vs_baseline_note": "the A/B is internal: brownout-off is the baseline arm",
+            "image_size": image_sizes[0],
+            "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        log_root = tempfile.mkdtemp(prefix="serve_bench_overload_")
+        try:
+            m = measure_overload(
+                args.arch, image_sizes[0], buckets,
+                storm_s=max(1.0, args.overload_storm_s),
+                multiple=max(1.5, args.overload_multiple),
+                pace_ms=max(1.0, args.overload_pace_ms),
+                seed=args.overload_seed,
+                replicas=max(2, args.overload_replicas),
+                gray_requests=max(20, args.overload_gray_requests),
+                straggler_ms=args.overload_straggler_ms,
+                log_root=log_root,
+            )
+            import jax
+
+            from bench import provenance
+
+            dev = jax.devices()[0]
+            out.update({"platform": dev.platform, "device_kind": dev.device_kind,
+                        "provenance": provenance(), "overload": m})
+            out["value"] = m["storm"]["interactive_availability_on"]
+            shutil.rmtree(log_root, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0
+            out["error"] = f"{type(e).__name__}: {e} (replica logs under {log_root})"
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
 
     if args.fleet:
         # the fleet measurement is standalone: replica subprocesses own the

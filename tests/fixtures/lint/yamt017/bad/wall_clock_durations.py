@@ -1,0 +1,29 @@
+"""Bad: time.time() readings differenced into durations/deadlines — every
+one of these jumps when NTP steps the wall clock."""
+
+import time
+from time import time as now
+
+
+def measure(work):
+    t0 = time.time()
+    work()
+    return time.time() - t0  # duration off the wall clock
+
+
+def wait_with_deadline(poll, timeout_s):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:  # deadline comparison off the wall clock
+        if poll():
+            return True
+    return False
+
+
+def backoff_elapsed(last_attempt_t):
+    # both operands tainted through names (one via the aliased import)
+    t1 = now()
+    return t1 - last_attempt_t if last_attempt_t else None
+
+
+def cooldown_ok(opened_at, cooldown_s):
+    return time.time() - opened_at >= cooldown_s

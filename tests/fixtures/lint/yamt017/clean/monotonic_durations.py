@@ -1,0 +1,38 @@
+"""Clean: the sanctioned shapes — monotonic durations, wall-clock readings
+used as TIMESTAMPS (stored/compared for identity, never differenced), and
+an explicitly suppressed intentional wall-clock age."""
+
+import time
+
+# a process birth timestamp other processes compare for IDENTITY (restart
+# detection): the reading is the point, nothing subtracts it
+PROC_START_UNIX = time.time()
+
+
+def measure(work):
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0
+
+
+def wait_with_deadline(poll, timeout_s):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if poll():
+            return True
+    return False
+
+
+def stamp_row(row):
+    # wall clock as data: provenance rows carry absolute timestamps
+    row["measured_unix"] = time.time()
+    return row
+
+
+def restarted(previous_identity, current_identity):
+    # equality of wall timestamps is identity, not a duration
+    return previous_identity["start_unix"] != current_identity["start_unix"]
+
+
+def log_age_s(mtime):
+    return time.time() - mtime  # yamt-lint: disable=YAMT017 — mtime IS wall clock

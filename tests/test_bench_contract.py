@@ -117,6 +117,60 @@ def _assert_fleet(fl, *, rehearsal=False):
     assert "cpu_rehearsal" in fl["cpu_rehearsal_note"]  # the caveat is recorded
 
 
+def _assert_overload(ov, *, rehearsal=False):
+    """The --overload contract (shared by the tiny fast run and the
+    checked-in r08 rehearsal artifact): one seeded 3x-capacity open-loop
+    storm played through both arms with per-class books balanced and ZERO
+    unresolved futures (nobody ever hangs, storm or not); brownout-on beats
+    brownout-off on interactive availability; the ladder steps up during
+    the storm AND fully recovers to L0 after it, with door sheds counted;
+    and the gray-failure round soft-ejects the latency-degraded (never
+    crashing) replica within the window and shows the tail recovering
+    after the ejection. Absolute capacity is never asserted (1-core
+    caveat, recorded in the artifact)."""
+    cap = ov["capacity"]
+    assert cap["closed_loop_qps"] > 0 and cap["storm_qps"] > cap["closed_loop_qps"]
+    assert cap["multiple"] >= 1.5 and cap["interactive_deadline_ms"] > 0
+    storm = ov["storm"]
+    for arm in ("off", "on"):
+        rnd = storm[arm]
+        assert rnd["unresolved"] == 0, f"{arm}: a client hung"
+        for cls, s in rnd["classes"].items():
+            assert s["submitted"] == s["completed"] + s["rejected"] + s["shed"] + s["failed"], (
+                arm, cls, s)
+            assert s["failed"] == 0, (arm, cls, s)  # overload is never an error
+        assert sum(s["submitted"] for s in rnd["classes"].values()) == ov["requests"]
+    # the headline: quality-for-goodput really bought interactive goodput
+    assert storm["interactive_availability_on"] > storm["interactive_availability_off"]
+    assert storm["off"]["shed_at_door_brownout"] == 0  # the control arm was a control
+    assert storm["on"]["shed_at_door_brownout"] >= 1
+    bo = storm["on"]["brownout"]
+    assert bo["transitions_up"] >= 1, "the ladder never stepped up under the storm"
+    assert 1 <= bo["peak_level"] <= 5
+    assert bo["recovered_to_l0"] and bo["final_level"] == 0
+    assert bo["transitions_up"] == bo["transitions_down"]  # every climb unwound
+    assert bo["trace"], "controller never ticked"
+    levels = [r["level"] for r in bo["trace"]]
+    assert all(0 <= lv <= 5 for lv in levels)
+    # one level per tick, up or down — the ladder is ordered, never a jump
+    assert all(abs(b - a) <= 1 for a, b in zip(levels, levels[1:]))
+    gray = ov["gray"]
+    assert gray["replicas"] >= 2
+    assert gray["unresolved"] == 0 and gray["failed"] == 0, gray
+    assert gray["slow_ejections"] >= 1, "the gray replica was never soft-ejected"
+    assert gray["time_to_eject_s"] is not None and 0 < gray["time_to_eject_s"] < 60
+    assert gray["p99_ms_before_eject"] > 0 and gray["p99_ms_after_eject"] > 0
+    if rehearsal:
+        # the recovery claim with margin: post-eject tail well under the
+        # straggler-poisoned one, and enough post-eject samples to mean it
+        assert gray["tail_recovery"] is not None and gray["tail_recovery"] > 2.0
+        assert gray["post_eject_samples"] >= 10
+        assert gray["p99_ms_before_eject"] >= gray["straggler"]["latency_ms"]
+    else:
+        assert gray["tail_recovery"] is not None and gray["tail_recovery"] > 1.0
+    assert "cpu_rehearsal" in ov["cpu_rehearsal_note"]  # the caveat is recorded
+
+
 def _assert_quant_ab(q):
     """The --quant contract (shared by the tiny fast run and the checked-in
     r07 rehearsal artifact): the three precision modes present with their
@@ -374,6 +428,58 @@ def test_serve_bench_fleet_emits_parsed_artifact(tmp_path):
     _assert_fleet(out["fleet"])
     assert out["value"] == out["fleet"]["hedge_ab"]["unhedged"]["qps"] > 0
     assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_overload_emits_parsed_artifact(tmp_path):
+    """scripts/serve_bench.py --overload: the brownout A/B on one seeded
+    3x-capacity storm (paced engine, in-process) plus the gray-failure
+    fleet round (real replica subprocesses, latency-based soft ejection) —
+    one JSON line in the bench artifact shape, the r08 contract."""
+    out_path = tmp_path / "BENCH_SERVE_overload_test.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--overload", "--arch", "tiny", "--image-sizes", "24", "--buckets", "1,4",
+         "--overload-storm-s", "3", "--overload-gray-requests", "48",
+         "--out", str(out_path)],
+        capture_output=True, text=True, timeout=540, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "tiny_overload_interactive_availability"
+    assert "error" not in out, out.get("error")
+    assert out["unit"] == "completed/submitted" and out["vs_baseline"] is None
+    prov = out["provenance"]
+    assert prov["jax_version"] and prov["platform"] == out["platform"]
+    _assert_overload(out["overload"])
+    assert out["value"] == out["overload"]["storm"]["interactive_availability_on"] > 0
+    assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_r08_overload_rehearsal_artifact():
+    """The r08 cpu_rehearsal artifact pins the brownout + gray-failure
+    acceptance: under the SAME seeded 3x-capacity storm the ladder arm
+    completes a strictly larger share of interactive traffic than the
+    control arm (quality traded for goodput at the door, with Retry-After),
+    the ladder climbs during the storm and walks all the way back to L0
+    after it (up-count == down-count, one level per transition), zero
+    futures unresolved in either arm, and the latency-degraded never-
+    crashing replica is soft-ejected within the window with the fleet tail
+    recovering afterwards. Absolute capacity is the deferred accelerator
+    measurement; the caveat is recorded in the artifact — r02..r07
+    discipline."""
+    with open(os.path.join(REPO, "BENCH_SERVE_r08_cpu_rehearsal.json")) as f:
+        out = json.load(f)
+    assert out["platform"] == "cpu" and "error" not in out
+    assert out["value"] is not None and out["value"] > 0
+    prov = out["provenance"]
+    assert prov["cpu_rehearsal"] is True and prov["jax_version"]
+    _assert_overload(out["overload"], rehearsal=True)
+    # the rehearsal artifact additionally pins a MATERIAL availability win,
+    # not a statistical sliver
+    storm = out["overload"]["storm"]
+    assert storm["interactive_availability_on"] >= 2.0 * storm["interactive_availability_off"]
 
 
 def test_serve_bench_r07_quant_rehearsal_artifact():

@@ -118,6 +118,26 @@ def test_faulty_engine_schedule_is_deterministic():
     assert schedule(7) != schedule(11)
 
 
+def test_latency_after_n_gates_the_degrade_onset():
+    """latency_after_n: the first N dispatches run CLEAN, then the latency
+    injection begins — the mid-run gray-failure knob (a replica that was
+    healthy when the router learned its baseline, then degraded)."""
+    reg = get_registry()
+    eng = FaultyEngine(_EchoEngine(), seed=0, latency_s=0.05, latency_rate=1.0,
+                       latency_after_n=3)
+    d0 = reg.snapshot().get("serve.faults.delays", 0)
+    clean_t0 = time.perf_counter()
+    for _ in range(3):
+        eng.predict(_img()[None])
+    clean_s = time.perf_counter() - clean_t0
+    assert reg.snapshot().get("serve.faults.delays", 0) == d0  # onset not reached
+    t0 = time.perf_counter()
+    eng.predict(_img()[None])  # dispatch #3: the onset
+    assert time.perf_counter() - t0 >= 0.05
+    assert reg.snapshot().get("serve.faults.delays", 0) == d0 + 1
+    assert clean_s < 0.05  # the pre-onset dispatches really were undelayed
+
+
 @pytest.mark.parametrize("fail_at", ["dispatch", "result"])
 def test_fail_n_batches_only_those_clients_error(fail_at):
     """The first N dispatches fail (at either failure edge): exactly those

@@ -148,6 +148,7 @@ class _FakeReplicaClient:
         self.health = (200, {"breaker_state": 0, "queued_total": 0, "draining": False,
                              "replica": {"replica_id": self.key, "start_unix": 1.0}})
         self.predicts = 0
+        self.polls = 0
         self.closed = False
 
     def predict(self, image, **kw):
@@ -155,6 +156,7 @@ class _FakeReplicaClient:
         return self.predict_fn(image, **kw)
 
     def healthz(self, timeout_s=None):
+        self.polls += 1
         h = self.health
         if isinstance(h, Exception):
             raise h
@@ -315,6 +317,192 @@ def test_router_hedges_straggler_to_second_replica_first_answer_wins():
         assert elapsed < 0.9  # did not wait out the straggler
         snap = get_registry().snapshot()
         assert snap["serve.hedges"] >= 1 and snap["serve.hedge_wins"] >= 1
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# gray-failure soft ejection (latency outlier -> weight decay -> eject ->
+# probation readmission), backpressure 503s, and the jittered poll schedule
+# ---------------------------------------------------------------------------
+
+
+def _set_leg_latency(router, key, seconds):
+    """Install a per-leg latency estimate directly (the EWMA the router
+    builds from measured dispatch legs) so sweep decisions are clock-free."""
+    with router._lock:
+        router._replicas[key].lat_ewma_s = seconds
+
+
+def test_slow_replica_soft_ejection_lifecycle():
+    """A slow-but-alive replica: weight decays on the first outlier sweeps,
+    ejection lands after slow_eject_after consecutive ones
+    (fleet.slow_ejections), probation blocks the healthy-poll readmission
+    until the cooldown, then readmission grants a FRESH estimate."""
+    get_registry().reset()
+    router, fakes = _fake_router(3, slow_eject=True, slow_factor=3.0,
+                                 slow_eject_after=3, slow_cooldown_s=5.0,
+                                 slow_min_ms=1.0)
+    try:
+        slow_key = "127.0.0.1:9000"
+        for key in fakes:
+            _set_leg_latency(router, key, 0.2 if key == slow_key else 0.004)
+        router.poll_once()  # sweep 1: strike, weight halves
+        state = {r["key"]: r for r in router.replicas_state()}
+        assert state[slow_key]["routable"]  # decay first, never instant ejection
+        assert state[slow_key]["slow_strikes"] == 1
+        assert state[slow_key]["weight_scale"] == pytest.approx(0.5)
+        assert all(state[k]["weight_scale"] == 1.0 for k in fakes if k != slow_key)
+        router.poll_once()  # sweep 2
+        router.poll_once()  # sweep 3: ejected
+        state = {r["key"]: r for r in router.replicas_state()}
+        assert not state[slow_key]["routable"]
+        assert _snap("fleet.slow_ejections") == 1
+        assert _snap("fleet.ejections") == 1
+        assert state[slow_key]["lat_ewma_ms"] is None  # probation starts clean
+        assert state[slow_key]["weight_scale"] == 1.0
+        # the replica keeps answering /healthz 200 — but probation holds it
+        # out until the cooldown passes (fake-clock polls)
+        t0 = time.monotonic()
+        router.poll_once(now=t0 + 1.0)
+        # force-refresh every schedule so the due-filter can't skip it
+        router.poll_once()
+        assert not next(r for r in router.replicas_state()
+                        if r["key"] == slow_key)["routable"]
+        # after the cooldown, the next healthy poll readmits it
+        with router._lock:
+            until = router._replicas[slow_key].slow_until
+        router.poll_once(now=until + 0.1)
+        assert next(r for r in router.replicas_state()
+                    if r["key"] == slow_key)["routable"]
+        assert _snap("fleet.readmissions") == 1
+    finally:
+        router.stop()
+
+
+def test_slow_ejection_needs_a_fleet_and_respects_floor():
+    """No ejection with a single scored replica (no fleet to be an outlier
+    of), and sub-floor absolute latencies never look like gray failures
+    however large the RATIO is."""
+    get_registry().reset()
+    router, fakes = _fake_router(2, slow_eject=True, slow_factor=3.0,
+                                 slow_eject_after=1, slow_min_ms=50.0)
+    try:
+        # 10x ratio but both under the 50ms floor: fast jitter, not gray
+        _set_leg_latency(router, "127.0.0.1:9000", 0.020)
+        _set_leg_latency(router, "127.0.0.1:9001", 0.002)
+        for _ in range(4):
+            router.poll_once()
+        assert router.n_routable() == 2
+        assert _snap("fleet.slow_ejections") == 0
+        # only one replica has data: nothing to compare against
+        _set_leg_latency(router, "127.0.0.1:9000", 10.0)
+        with router._lock:
+            router._replicas["127.0.0.1:9001"].lat_ewma_s = None
+        router.poll_once()
+        assert router.n_routable() == 2
+    finally:
+        router.stop()
+
+
+def test_slow_ejection_off_by_default_and_crash_path_unchanged():
+    """Routers built without slow_eject never latency-eject (r06 bench
+    compatibility), and crash ejection still uses the same consecutive-
+    failure counter it always did."""
+    get_registry().reset()
+    router, fakes = _fake_router(2)  # slow_eject defaults False
+    try:
+        _set_leg_latency(router, "127.0.0.1:9000", 10.0)
+        _set_leg_latency(router, "127.0.0.1:9001", 0.001)
+        for _ in range(5):
+            router.poll_once()
+        assert router.n_routable() == 2
+        assert _snap("fleet.slow_ejections") == 0
+    finally:
+        router.stop()
+
+
+def test_router_learns_per_leg_latency_ewma_from_real_legs():
+    get_registry().reset()
+    router, fakes = _fake_router(2, slow_eject=True)
+    try:
+        for _ in range(6):
+            router.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+        states = router.replicas_state()
+        served = [r for r in states if r["lat_ewma_ms"] is not None]
+        assert served, "no replica learned a latency estimate"
+        assert all(r["lat_ewma_ms"] > 0 for r in served)
+    finally:
+        router.stop()
+
+
+def test_retry_after_503_is_backpressure_not_ejection():
+    """A Retry-After-bearing 503 (breaker cooldown / brownout shed) re-routes
+    but never scores the replica's ejection counter; a 503 WITHOUT the hint
+    (draining, nothing routable behind it) ejects after eject_failures."""
+    get_registry().reset()
+    router, fakes = _fake_router(2, eject_failures=2)
+    try:
+        sick = fakes["127.0.0.1:9000"]
+        # pin the first pick onto the sick replica: the healthy one reports
+        # a huge backlog so its weight collapses
+        fast = fakes["127.0.0.1:9001"]
+        fast.health = (200, {"breaker_state": 0, "queued_total": 100_000, "draining": False,
+                             "replica": {"replica_id": fast.key, "start_unix": 1.0}})
+        router.poll_once()
+        sick.predict_fn = lambda image, **kw: (_ for _ in ()).throw(
+            ClientHTTPError(503, "brownout", "shed", retry_after=1.0))
+        for _ in range(6):
+            out = router.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+            assert float(out[0]) == 9001.0  # re-routed and served
+        assert _snap("fleet.backpressure") >= 6
+        assert router.n_routable() == 2, "backpressure 503s must never eject"
+        assert _snap("fleet.ejections") == 0
+        # the SAME shape without Retry-After scores toward ejection
+        sick.predict_fn = lambda image, **kw: (_ for _ in ()).throw(
+            ClientHTTPError(503, "draining", "going away"))
+        for _ in range(4):
+            router.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+        assert not next(r for r in router.replicas_state()
+                        if r["key"] == sick.key)["routable"]
+    finally:
+        router.stop()
+
+
+def test_poll_schedule_jitter_on_fake_clock():
+    """Per-replica jittered poll deadlines: seeded, distinct across
+    replicas, inside [interval*(1-j), interval*(1+j)], and the due-filter
+    only polls replicas whose deadline has passed."""
+    get_registry().reset()
+    router, fakes = _fake_router(4, poll_interval_s=1.0, poll_jitter=0.2)
+    try:
+        router.poll_once(now=100.0)  # all due at t=0 schedule start
+        assert all(c.polls == 1 for c in fakes.values())
+        with router._lock:
+            deadlines = {r.key: r.next_poll_t for r in router._replicas.values()}
+        assert all(100.0 + 0.8 <= t <= 100.0 + 1.2 for t in deadlines.values()), deadlines
+        # seeded jitter really staggers them (not one synchronized herd)
+        assert len({round(t, 6) for t in deadlines.values()}) == len(deadlines)
+        # before any deadline: nothing polls
+        router.poll_once(now=100.5)
+        assert all(c.polls == 1 for c in fakes.values())
+        # between the earliest and latest deadline: exactly the due subset
+        mid = sorted(deadlines.values())[1]
+        router.poll_once(now=mid)
+        polled = sum(c.polls - 1 for c in fakes.values())
+        assert polled == sum(1 for t in deadlines.values() if t <= mid) >= 1
+        # a bare poll_once (tests / bench) still force-polls everything
+        router.poll_once()
+        assert all(c.polls >= 2 for c in fakes.values())
+        # determinism: the same seed reproduces the same schedule
+        router2, fakes2 = _fake_router(4, poll_interval_s=1.0, poll_jitter=0.2)
+        try:
+            router2.poll_once(now=100.0)
+            with router2._lock:
+                deadlines2 = {r.key: r.next_poll_t for r in router2._replicas.values()}
+            assert deadlines2 == deadlines
+        finally:
+            router2.stop()
     finally:
         router.stop()
 
@@ -545,6 +733,58 @@ def test_supervisor_seeded_chaos_kills_a_live_replica():
         while _snap("fleet.restarts") < 1 and time.monotonic() < deadline:
             time.sleep(0.02)
         assert _snap("fleet.restarts") >= 1
+    finally:
+        sup.stop()
+
+
+class _StunnableHandle(_FakeHandle):
+    """Records delivered signals WITHOUT dying: SIGSTOP/SIGCONT pulses
+    leave a real process alive, and the fake must match or the degrade
+    drill would look like a kill."""
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        return self._alive
+
+
+def test_supervisor_seeded_chaos_degrades_without_killing():
+    """mode=degrade: the seeded victim gets a bounded SIGSTOP/SIGCONT pulse
+    train, stays ALIVE throughout, always ends released (trailing SIGCONT),
+    and the episode is counted fleet.chaos_degrades — never a chaos kill."""
+    get_registry().reset()
+    spawned = []
+    lock = threading.Lock()
+
+    def stunnable(slot):
+        with lock:
+            spawned.append(slot)
+            return _StunnableHandle(slot, len(spawned))
+
+    sup = FleetSupervisor(
+        replica_argv=[], log_dir="/tmp/unused", replicas=2,
+        restart_backoff_ms=1.0, restart_backoff_max_s=0.05,
+        supervise_poll_s=0.02, spawn_fn=stunnable,
+    )
+    sup.start()
+    try:
+        chaos = FleetChaos(sup, seed=3, mode="degrade", kill_after_s=0.02,
+                           degrade_stop_ms=10.0, degrade_period_ms=30.0,
+                           degrade_duration_s=0.2)
+        chaos.start()
+        deadline = time.monotonic() + 5
+        while _snap("fleet.chaos_degrades") < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.4)  # let the pulse train finish
+        chaos.stop()
+        assert _snap("fleet.chaos_degrades") == 1
+        assert _snap("fleet.chaos_kills") == 0
+        victims = [s.handle for s in sup._slots.values() if s.handle.signals]
+        assert len(victims) == 1  # one seeded victim
+        sigs = victims[0].signals
+        assert signal.SIGSTOP in sigs and signal.SIGCONT in sigs
+        assert sigs[-1] == signal.SIGCONT, "a degrade drill must end released"
+        assert victims[0].alive(), "degrade must not kill"
+        assert _snap("fleet.restarts") == 0  # the supervisor saw no exit
     finally:
         sup.stop()
 

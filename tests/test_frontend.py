@@ -95,6 +95,82 @@ def _post_image(base, val, *, priority=None, deadline_ms=None):
 # ---------------------------------------------------------------------------
 
 
+def test_retry_after_on_overload_verdicts_and_typed_on_client():
+    """Every overload-shaped 429/503 carries Retry-After (quota 429s,
+    brownout 503s with the shed's OWN bound), the shared client surfaces it
+    typed (ClientHTTPError.retry_after — the router's backpressure
+    discriminator), and non-overload errors carry no header."""
+    from yet_another_mobilenet_series_tpu.serve.brownout import build_ladder
+    from yet_another_mobilenet_series_tpu.serve.client import ClientHTTPError, ReplicaClient
+
+    get_registry().reset()
+    blocker = threading.Event()
+    b, ac, fe = _stack(_EchoEngine(block=blocker), weights=(98.0, 1.0, 1.0), queue_depth=8)
+    client = ReplicaClient("127.0.0.1", fe.port)
+    try:
+        base = fe.url
+        # a quota 429: with the engine blocked, concurrent best_effort
+        # submits pile onto a 1-slot quota — overload-shaped -> Retry-After
+        results = []
+        lock = threading.Lock()
+
+        def push():
+            st, body, hdrs = _post_image(base, 1.0, priority="best_effort")
+            with lock:
+                results.append((st, body.get("error"), hdrs.get("Retry-After")))
+
+        threads = [threading.Thread(target=push, daemon=True) for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # let the stragglers hit the saturated quota
+        blocker.set()
+        for t in threads:
+            t.join(timeout=15)
+        statuses = list(results)
+        quota_hits = [s for s in statuses if s[0] == 429]
+        assert quota_hits, statuses
+        assert all(ra is not None and float(ra) >= 0 for _, _, ra in quota_hits)
+        # brownout shed: 503 + the policy's own Retry-After, typed on the client
+        ac.apply_brownout(build_ladder(retry_after_s=7.0)[3])
+        st, body, hdrs = _post_image(base, 1.0, priority="best_effort")
+        assert st == 503 and body["error"] == "brownout"
+        assert float(hdrs["Retry-After"]) == 7.0
+        with pytest.raises(ClientHTTPError) as ei:
+            client.predict(np.zeros((4, 4, 3), np.float32), priority="best_effort")
+        assert ei.value.status == 503 and ei.value.tag == "brownout"
+        assert ei.value.retry_after == 7.0
+        ac.apply_brownout(build_ladder()[0])
+        # a 400 (non-overload) carries no Retry-After
+        st, _, hdrs = _request(base + "/predict", data=b"{}",
+                               headers={"Content-Type": "application/json"})
+        assert st == 400 and "Retry-After" not in hdrs
+    finally:
+        client.close()
+        fe.stop()
+        b.stop()
+
+
+def test_healthz_reports_brownout_level():
+    from yet_another_mobilenet_series_tpu.serve.brownout import build_ladder
+
+    get_registry().reset()
+    b, ac, fe = _stack(_EchoEngine())
+    try:
+        st, body, _ = _request(fe.url + "/healthz")
+        assert st == 200 and body["brownout_level"] == 0
+        assert body["brownout"]["level"] == 0
+        get_registry().gauge("serve.brownout_level").set(4)
+        ac.apply_brownout(build_ladder()[4])
+        st, body, _ = _request(fe.url + "/healthz")
+        assert st == 200  # degraded, not down: the breaker still gates 503
+        assert body["brownout_level"] == 4
+        assert body["brownout"]["shed_classes"] == ["batch", "best_effort"]
+        assert body["brownout"]["retries_enabled"] is True
+    finally:
+        fe.stop()
+        b.stop()
+
+
 def test_predict_json_round_trip_with_priority_and_deadline():
     b, ac, fe = _stack()
     try:

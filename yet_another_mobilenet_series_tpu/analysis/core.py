@@ -256,6 +256,7 @@ def load_rules() -> list[Rule]:
         rules_subprocess,
         rules_swallow,
         rules_threads,
+        rules_time,
         rules_tracing,
     )
 

@@ -51,9 +51,11 @@ from ..obs import device as obs_device
 from ..obs import registry as obs_registry
 from ..obs import trace as obs_trace
 from ..serve.autoscale import Autoscaler
+from ..serve.brownout import BrownoutController
 from ..serve.frontend import Frontend, write_listen_addr
-from ..serve.hedge import Hedger
+from ..serve.hedge import ROUTER_LATENCY, Hedger
 from ..serve.router import Router
+from ..serve.signals import SignalReader
 from ..utils.logging import Logger, emit
 
 # repo root (the package's parent): child interpreters must resolve the
@@ -511,17 +513,59 @@ class FleetSupervisor:
             return None
         return target.idx
 
+    def pick_live_slot(self, rng: random.Random | None = None) -> int | None:
+        """One seeded-random live slot index (the degrade chaos victim)."""
+        with self._lock:
+            live = [s for s in self._slots.values()
+                    if s.wanted and s.handle is not None and s.handle.alive()]
+        return (rng or random).choice(live).idx if live else None
+
+    def signal_replica(self, slot: int, sig: int) -> bool:
+        """Deliver ``sig`` to one slot's live replica with NO lifecycle
+        bookkeeping — the degrade-chaos pulse path (SIGSTOP/SIGCONT leave
+        the process alive; the supervisor must not treat it as an exit)."""
+        with self._lock:
+            s = self._slots.get(slot)
+            handle = s.handle if s is not None and s.wanted else None
+        if handle is None:
+            return False
+        return handle.send_signal(sig)
+
 
 class FleetChaos:
-    """Seeded kill schedule against the live fleet (serve.fleet.chaos)."""
+    """Seeded chaos schedule against the live fleet (serve.fleet.chaos).
+
+    Two modes:
+
+    - ``kill`` — the PR-12 crash drill: SIGKILL/SIGTERM a seeded live
+      replica after ``kill_after_s`` (repeating every ``kill_period_s``);
+      exercises restart-on-exit, crash ejection, transport retry.
+    - ``degrade`` — the GRAY-failure drill: the seeded victim is pulsed
+      SIGSTOP for ``degrade_stop_ms`` out of every ``degrade_period_ms``
+      over ``degrade_duration_s``, then released with a final SIGCONT. The
+      process never exits — sockets stay open, /healthz still answers
+      between pulses — it just gets SLOW (a GC pause / noisy-neighbor
+      stand-in), which only the router's latency-based soft ejection can
+      act on. Counted ``fleet.chaos_degrades``; pulses are bounded and the
+      stop path always delivers the releasing SIGCONT so a cancelled drill
+      cannot leave a replica frozen.
+    """
 
     def __init__(self, fleet: FleetSupervisor, *, seed: int = 0, kill_after_s: float = 2.0,
-                 kill_period_s: float = 0.0, sig: int = signal.SIGKILL):
+                 kill_period_s: float = 0.0, sig: int = signal.SIGKILL,
+                 mode: str = "kill", degrade_stop_ms: float = 150.0,
+                 degrade_period_ms: float = 500.0, degrade_duration_s: float = 10.0):
+        if mode not in ("kill", "degrade"):
+            raise ValueError(f"chaos mode must be kill|degrade, got {mode!r}")
         self._fleet = fleet
         self._rng = random.Random(seed)
         self._kill_after_s = kill_after_s
         self._kill_period_s = kill_period_s
         self._sig = sig
+        self._mode = mode
+        self._degrade_stop_s = degrade_stop_ms / 1e3
+        self._degrade_period_s = degrade_period_ms / 1e3
+        self._degrade_duration_s = degrade_duration_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -534,12 +578,36 @@ class FleetChaos:
         try:  # YAMT011: silent chaos death = a drill that never ran
             if self._stop.wait(self._kill_after_s):
                 return
+            if self._mode == "degrade":
+                self._degrade_once()
+                return
             self._fleet.kill_replica(rng=self._rng, sig=self._sig)
             while self._kill_period_s > 0 and not self._stop.wait(self._kill_period_s):
                 self._fleet.kill_replica(rng=self._rng, sig=self._sig)
         except Exception as e:  # noqa: BLE001 — contain, count, report
             obs_registry.get_registry().counter("serve.thread_crashes").inc()
             emit(f"[fleet] chaos thread crashed: {type(e).__name__}: {e}")
+
+    def _degrade_once(self) -> None:
+        slot = self._fleet.pick_live_slot(rng=self._rng)
+        if slot is None:
+            return
+        obs_registry.get_registry().counter("fleet.chaos_degrades").inc()
+        emit(f"[fleet] CHAOS: degrading replica r{slot} "
+             f"(SIGSTOP {self._degrade_stop_s * 1e3:.0f}ms / "
+             f"{self._degrade_period_s * 1e3:.0f}ms for {self._degrade_duration_s:.0f}s)")
+        deadline = time.monotonic() + self._degrade_duration_s
+        try:
+            while time.monotonic() < deadline and not self._stop.is_set():
+                if not self._fleet.signal_replica(slot, signal.SIGSTOP):
+                    return  # the victim died (supervisor will respawn): drill over
+                # a bounded freeze, then resume — stop() mid-pulse still
+                # falls through to the finally's releasing SIGCONT
+                self._stop.wait(self._degrade_stop_s)
+                self._fleet.signal_replica(slot, signal.SIGCONT)
+                self._stop.wait(self._degrade_period_s - self._degrade_stop_s)
+        finally:
+            self._fleet.signal_replica(slot, signal.SIGCONT)
 
     def stop(self) -> None:
         self._stop.set()
@@ -587,6 +655,13 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         route_attempts=fc.route_attempts,
         client_timeout_s=fc.client_timeout_s,
         hedger=hedger,
+        poll_jitter=fc.poll_jitter,
+        slow_eject=fc.slow_eject.enable,
+        slow_factor=fc.slow_eject.slow_factor,
+        slow_eject_after=fc.slow_eject.eject_after,
+        slow_cooldown_s=fc.slow_eject.cooldown_s,
+        slow_min_ms=fc.slow_eject.min_ms,
+        lat_alpha=fc.slow_eject.lat_alpha,
     ).start()
     fleet = FleetSupervisor(
         replica_argv=replica_argv,
@@ -600,7 +675,7 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         logger=log,
     )
     result: dict = {}
-    frontend = autoscaler = chaos = None
+    frontend = autoscaler = chaos = brownout = None
     try:
         fleet.start()
         frontend = Frontend(
@@ -627,13 +702,34 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
                 up_queue_depth=a.up_queue_depth, down_queue_depth=a.down_queue_depth,
                 signal_class=a.signal_class,
             ).start()
+        if cfg.serve.brownout.enable:
+            # brownout at the ROUTER tier: signals from the fleet-side
+            # latency family + routable backlog; actuates hedging (L1) and
+            # fleet-door class shedding (L3+). Replica-tier batcher/
+            # admission degradation rides each replica's own controller
+            # (cli/serve.py) off the same config block.
+            brownout = BrownoutController.from_config(
+                cfg.serve.brownout,
+                SignalReader(
+                    latency_family=ROUTER_LATENCY,
+                    signal_class=cfg.serve.brownout.signal_class,
+                    queue_depth_fn=router.mean_queue_depth,
+                ),
+                targets=(router,),
+            ).start()
+            log.log(f"brownout ladder armed at the router tier "
+                    f"(L0..L{cfg.serve.brownout.max_level})")
         if fc.chaos.enable:
             chaos = FleetChaos(
                 fleet, seed=fc.chaos.seed, kill_after_s=fc.chaos.kill_after_s,
                 kill_period_s=fc.chaos.kill_period_s,
                 sig=signal.SIGKILL if fc.chaos.signal == "kill" else signal.SIGTERM,
+                mode=fc.chaos.mode,
+                degrade_stop_ms=fc.chaos.degrade_stop_ms,
+                degrade_period_ms=fc.chaos.degrade_period_ms,
+                degrade_duration_s=fc.chaos.degrade_duration_s,
             ).start()
-            log.log(f"CHAOS: replica kills on (seed={fc.chaos.seed}, "
+            log.log(f"CHAOS: replica {fc.chaos.mode} on (seed={fc.chaos.seed}, "
                     f"after={fc.chaos.kill_after_s}s, period={fc.chaos.kill_period_s}s)")
         while not stop_event.wait(0.2):
             if rolling_event.is_set():
@@ -646,6 +742,9 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         t0 = time.perf_counter()
         if chaos is not None:
             chaos.stop()
+        if brownout is not None:
+            brownout.stop()
+            result["brownout_trace"] = brownout.trace
         if autoscaler is not None:
             autoscaler.stop()
             result["autoscale_trace"] = autoscaler.trace

@@ -53,7 +53,9 @@ from ..obs.watchdog import StallWatchdog
 from ..parallel import mesh as mesh_lib
 from ..serve.admission import AdmissionController
 from ..serve.batcher import MicroBatcher, QueueFull
+from ..serve.brownout import BrownoutController
 from ..serve.engine import InferenceEngine
+from ..serve.signals import SignalReader
 from ..serve.faults import FaultyEngine
 from ..serve.frontend import Frontend, write_listen_addr
 from ..serve.pipeline import PipelinedBatcher
@@ -246,6 +248,24 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     if watchdog is not None:
         watchdog.register_info("serving", lambda: _serving_info(batcher, admission))
         watchdog.start()
+    # brownout ladder at the REPLICA tier: the controller reads this
+    # process's own admission-side signals (windowed per-class p99 +
+    # admitted backlog + breaker) and actuates the batcher (fill-or-flush)
+    # and the admission controller (class shed / margin / retries)
+    brownout = None
+    if cfg.serve.brownout.enable:
+        brownout = BrownoutController.from_config(
+            cfg.serve.brownout,
+            SignalReader(
+                latency_family="serve.latency_seconds",
+                signal_class=cfg.serve.brownout.signal_class,
+                queue_depth_fn=admission.queued_total,
+            ),
+            targets=(batcher, admission),
+        ).start()
+        log.log(f"brownout ladder armed (L0..L{cfg.serve.brownout.max_level}, "
+                f"up p99 > {cfg.serve.brownout.up_p99_ms:.0f}ms or "
+                f"queue > {cfg.serve.brownout.up_queue_depth:.0f})")
     # HTTP-triggered jax.profiler capture (obs/device.py): xplane dumps land
     # in <log_dir>/trace (or serve.listen.profile_dir) for trace_ops.py; the
     # drain path below guarantees a still-open window closes at shutdown
@@ -275,6 +295,8 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     finally:
         t0 = time.perf_counter()
         frontend.stop()
+        if brownout is not None:
+            brownout.stop()
         if profiler is not None:
             # a capture the operator never stopped must not outlive the
             # server (the drain-path half of the YAMT013 discipline)

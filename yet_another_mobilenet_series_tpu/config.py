@@ -486,6 +486,10 @@ class FaultsConfig:
     # injected completion latency, applied with probability latency_rate
     latency_ms: float = 0.0
     latency_rate: float = 1.0
+    # dispatches that run CLEAN before the latency injection begins: a
+    # replica that degrades mid-run (the gray-failure drill — the router
+    # learned its baseline while it was healthy). 0 = degraded from birth
+    latency_after_n: int = 0
     # dispatch index that HANGS until FaultyEngine.hang_release is set
     # (drain-timeout / watchdog drills); -1 = never
     hang_at: int = -1
@@ -540,21 +544,69 @@ class AutoscaleConfig:
 
 @dataclass(frozen=True)
 class FleetChaosConfig:
-    """Replica-level chaos (cli/fleet.py): a seeded schedule of kill -9
-    against live replicas mid-load — the process-granular twin of
-    serve/faults.py's in-process injection. The supervisor's restart-on-exit
-    and the router's ejection/retry are dead code until a replica actually
-    dies. Off in production."""
+    """Replica-level chaos (cli/fleet.py): a seeded schedule of kill -9 OR
+    gray degradation against live replicas mid-load — the process-granular
+    twin of serve/faults.py's in-process injection. The supervisor's
+    restart-on-exit, the router's ejection/retry, and (degrade mode) the
+    latency-based soft ejection are dead code until a replica actually dies
+    or limps. Off in production."""
 
     enable: bool = False
     seed: int = 0
-    # first kill this long after the fleet is up
+    # "kill" = crash chaos (the signal below); "degrade" = gray-failure
+    # chaos: the seeded victim is SIGSTOP/SIGCONT-pulsed so it stays alive
+    # but slow (a GC-pause/noisy-neighbor stand-in) — the router must
+    # soft-eject it on measured latency, never on a crash signal
+    mode: str = "kill"
+    # first kill/degradation this long after the fleet is up
     kill_after_s: float = 2.0
-    # subsequent kills every this often; 0 = exactly one kill
+    # subsequent kills every this often; 0 = exactly one kill (kill mode)
     kill_period_s: float = 0.0
     # "kill" = SIGKILL (no drain, the real chaos); "term" = SIGTERM
     # (graceful — drills the drain path instead)
     signal: str = "kill"
+    # degrade mode: pulse shape (stopped degrade_stop_ms out of every
+    # degrade_period_ms) and how long the episode lasts
+    degrade_stop_ms: float = 150.0
+    degrade_period_ms: float = 500.0
+    degrade_duration_s: float = 10.0
+
+    def __post_init__(self):
+        if self.mode not in ("kill", "degrade"):
+            raise ValueError(f"fleet.chaos.mode must be kill|degrade, got {self.mode!r}")
+        if not 0.0 < self.degrade_stop_ms < self.degrade_period_ms:
+            raise ValueError("fleet.chaos needs 0 < degrade_stop_ms < degrade_period_ms")
+
+
+@dataclass(frozen=True)
+class SlowEjectConfig:
+    """Gray-failure soft ejection (serve/router.py): a replica whose per-leg
+    latency EWMA is a multiplicative outlier vs the fleet median first has
+    its routing weight decayed, then is ejected (``fleet.slow_ejections``)
+    and readmitted through the healthy poll after a probation cooldown —
+    the latency twin of crash ejection, for the straggler that never dies."""
+
+    enable: bool = True
+    # outlier bound: ejectable when EWMA > slow_factor x fleet (lower) median
+    slow_factor: float = 3.0
+    # consecutive outlier poll-sweeps before ejection (weight decays first)
+    eject_after: int = 3
+    # probation: a slow-ejected replica stays out at least this long; the
+    # next healthy poll after it readmits with a FRESH latency estimate
+    cooldown_s: float = 5.0
+    # absolute floor on the outlier threshold: sub-ms jitter between fast
+    # replicas must never look like a gray failure
+    min_ms: float = 1.0
+    # EWMA smoothing for the per-replica per-leg latency estimate
+    lat_alpha: float = 0.3
+
+    def __post_init__(self):
+        if self.slow_factor <= 1.0:
+            raise ValueError(
+                f"fleet.slow_eject.slow_factor must be > 1, got {self.slow_factor}")
+        if self.eject_after < 1:
+            raise ValueError(
+                f"fleet.slow_eject.eject_after must be >= 1, got {self.eject_after}")
 
 
 @dataclass(frozen=True)
@@ -582,9 +634,60 @@ class FleetConfig:
     # how long a spawned replica may take to publish listen_addr.json
     # (includes jax import + AOT warmup) before the spawn counts as failed
     spawn_timeout_s: float = 120.0
+    # per-replica jitter on the health-poll schedule, as a fraction of
+    # poll_interval_s: N routers x M replicas must not phase-lock their
+    # /healthz polls into a thundering herd
+    poll_jitter: float = 0.2
     hedge: HedgeConfig = field(default_factory=HedgeConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     chaos: FleetChaosConfig = field(default_factory=FleetChaosConfig)
+    # gray-failure (latency-based) soft ejection of slow-but-alive replicas
+    slow_eject: SlowEjectConfig = field(default_factory=SlowEjectConfig)
+
+
+@dataclass(frozen=True)
+class BrownoutConfig:
+    """Graceful-degradation ladder under sustained overload
+    (serve/brownout.py, docs/SERVING.md "Overload & brownout"): a controller
+    thread steps L0 (healthy) -> L5 (interactive-only survival) off the
+    measured signals both control loops share (serve/signals.py — windowed
+    per-class p99 via registry bucket-count deltas, queue depth, breaker
+    state), trading response QUALITY for interactive goodput: hedging off
+    first, then fill-or-flush batching, then class shedding with
+    Retry-After, then tightened deadline admission and no retries. Steps up
+    fast (hold_up_s) and recovers one level per cooldown_s — asymmetric
+    hysteresis, so the ladder cannot flap."""
+
+    enable: bool = False
+    interval_s: float = 0.5
+    # step-UP triggers (any): windowed p99 of the signal class above
+    # up_p99_ms, queue depth above up_queue_depth, or an open breaker
+    up_p99_ms: float = 400.0
+    up_queue_depth: float = 16.0
+    # step-DOWN requires ALL below these (strictly under the up thresholds
+    # — the dead band between them is the hysteresis)
+    down_p99_ms: float = 100.0
+    down_queue_depth: float = 2.0
+    # asymmetric pacing: at most one step UP per hold_up_s (react in
+    # seconds), one step DOWN per cooldown_s (recover slowly, prove each
+    # restored degradation holds before the next)
+    hold_up_s: float = 1.0
+    cooldown_s: float = 5.0
+    # deepest level the ladder may reach (5 = interactive-only survival)
+    max_level: int = 5
+    # the Retry-After hint on brownout-shed responses
+    retry_after_s: float = 1.0
+    # the class whose windowed latency histogram is the tail signal
+    signal_class: str = "interactive"
+
+    def __post_init__(self):
+        if self.down_p99_ms >= self.up_p99_ms or self.down_queue_depth >= self.up_queue_depth:
+            raise ValueError("serve.brownout down thresholds must sit strictly below "
+                             "up thresholds (the dead band is the hysteresis)")
+        if not 0 <= self.max_level <= 5:
+            raise ValueError(f"serve.brownout.max_level must be in [0, 5], got {self.max_level}")
+        if self.hold_up_s <= 0 or self.cooldown_s <= 0:
+            raise ValueError("serve.brownout.hold_up_s/cooldown_s must be > 0")
 
 
 @dataclass(frozen=True)
@@ -734,6 +837,10 @@ class ServeConfig:
     listen: ListenConfig = field(default_factory=ListenConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     faults: FaultsConfig = field(default_factory=FaultsConfig)
+    # brownout: the graceful-degradation ladder under sustained overload
+    # (consumed by cli/serve.py at the replica tier and cli/fleet.py at the
+    # router tier — same controller, different actuation targets)
+    brownout: BrownoutConfig = field(default_factory=BrownoutConfig)
     # replica fleet: router tier + hedging + autoscaler + replica chaos
     # (cli/fleet.py; ignored by the single-replica cli/serve.py entry point)
     fleet: FleetConfig = field(default_factory=FleetConfig)
@@ -812,7 +919,9 @@ _SECTION_TYPES = {
     "HedgeConfig": HedgeConfig,
     "AutoscaleConfig": AutoscaleConfig,
     "FleetChaosConfig": FleetChaosConfig,
+    "SlowEjectConfig": SlowEjectConfig,
     "FleetConfig": FleetConfig,
+    "BrownoutConfig": BrownoutConfig,
     "QuantConfig": QuantConfig,
     "FuseChunksConfig": FuseChunksConfig,
     "OverlapConfig": OverlapConfig,

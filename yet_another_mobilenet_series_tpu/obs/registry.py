@@ -62,6 +62,8 @@ PROM_LABEL_FAMILIES: dict[str, str] = {
     "serve.bucket_hits": "bucket",
     # the fleet router's per-class latency (the hedge timer's input)
     "serve.router.latency_seconds": "class",
+    # brownout ladder transitions split by direction (up = degrading)
+    "serve.brownout_transitions": "direction",
 }
 
 

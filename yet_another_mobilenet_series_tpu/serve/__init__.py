@@ -54,6 +54,16 @@ training graph re-run with train=False):
 - :mod:`.autoscale` — the control thread scaling replica count off the
   measured tail-latency + queue-depth families with cooldown hysteresis
   (cli/fleet.py is the supervisor it drives).
+- :mod:`.signals` — the shared windowed-signal reader both control loops
+  consume: per-class tail latency off registry bucket-count deltas (the
+  p99 of THIS tick's completions, not history), queue depth, breaker
+  state.
+- :mod:`.brownout` — the graceful-degradation ladder under sustained
+  overload: L0 (healthy) → L5 (interactive-only survival), stepping off
+  the measured signals with asymmetric hysteresis — hedging off first,
+  then fill-or-flush batching, then class shedding with ``Retry-After``,
+  then tightened deadline admission; one level down per cooldown on
+  recovery, so quality returns as deliberately as it left.
 
 Everything is instrumented through obs/ (``serve/*`` spans, queue-wait and
 run-latency histograms, request/shed counters), so scripts/obs_report.py

@@ -70,6 +70,20 @@ class BreakerOpen(RuntimeError):
     streak); retry after the cooldown."""
 
 
+class BrownoutShed(RuntimeError):
+    """Rejected at arrival: the brownout ladder (serve/brownout.py) is
+    shedding this priority class to protect interactive goodput under
+    sustained overload. Maps to 503 with a ``Retry-After`` hint — the
+    server is healthy, just saturated; come back, don't eject it.
+
+    ``retry_after_s`` rides the exception so the frontend can emit the
+    header and the router can tell backpressure from death."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 class DeadlineUnmeetable(RuntimeError):
     """Rejected at arrival: the predicted wait already exceeds the request's
     deadline — shedding now is strictly cheaper than shedding after queueing."""
@@ -233,6 +247,15 @@ class AdmissionController:
         self._lock = threading.Lock()
         self._in_queue = {cls: 0 for cls in CLASSES}
         self._ewma_s: float | None = None
+        # brownout policy pushed by serve/brownout.py (all neutral at L0):
+        # classes rejected at the door, a multiplier tightening the
+        # deadline-admission margin, and whether transient-failure retries
+        # still run (L5 survival mode spends no capacity on second chances)
+        self._shed_classes: frozenset[str] = frozenset()
+        self._deadline_margin = 1.0
+        self._retries_enabled = True
+        self._brownout_level = 0
+        self._brownout_retry_after_s = 1.0
         # rid -> RequestContext for every request currently in the system:
         # the hang report's "whose request is wedged" section reads this
         self._inflight_ctx: dict[int, RequestContext] = {}
@@ -271,7 +294,32 @@ class AdmissionController:
         if per_request is None:
             return 0.0
         per_batch = max(getattr(self._batcher, "_max_batch", 1), 1)
-        return per_request * (1.0 + backlog / per_batch)
+        # the brownout deadline margin (> 1 at L4+) inflates the estimate,
+        # so deadline-carrying requests shed EARLIER under overload — the
+        # predictor lags a storm by design (it only learns from completions)
+        with self._lock:
+            margin = self._deadline_margin
+        return per_request * (1.0 + backlog / per_batch) * margin
+
+    # -- brownout actuation (serve/brownout.py pushes, never reads) ----------
+
+    def apply_brownout(self, policy) -> None:
+        """Install one :class:`~.brownout.BrownoutPolicy` atomically: the
+        classes to reject at the door, the deadline-margin multiplier, and
+        the retry switch. Called from the controller thread on every ladder
+        transition; in-flight requests keep the policy they admitted under."""
+        with self._lock:
+            self._shed_classes = frozenset(policy.shed_classes)
+            self._deadline_margin = float(policy.deadline_margin)
+            self._retries_enabled = bool(policy.retries)
+            self._brownout_level = int(policy.level)
+            self._brownout_retry_after_s = float(policy.retry_after_s)
+
+    def queued_total(self) -> float:
+        """Total admitted-and-unresolved requests across classes — the
+        replica-tier backlog signal (serve/signals.py queue_depth_fn)."""
+        with self._lock:
+            return float(sum(self._in_queue.values()))
 
     # -- client side --------------------------------------------------------
 
@@ -288,6 +336,20 @@ class AdmissionController:
             raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
         if ctx is None:  # direct callers get an id too; the frontend mints its own
             ctx = RequestContext.mint(cls, deadline_ms)
+        # brownout class shed FIRST (before the breaker can spend a probe
+        # slot): the cheapest possible rejection — no quota, no queue, no
+        # engine load, and a Retry-After so well-behaved clients back off
+        with self._lock:
+            shed_classes = self._shed_classes
+            level = self._brownout_level
+            retry_after_s = self._brownout_retry_after_s
+        if cls in shed_classes:
+            self._reject(cls, "serve.rejected_brownout")
+            raise BrownoutShed(
+                f"class {cls!r} shed at brownout level L{level}; "
+                f"retry after {retry_after_s:.1f}s",
+                retry_after_s=retry_after_s,
+            )
         admit, probe = self.breaker.allow()
         if not admit:
             self._reject(cls, "serve.rejected_breaker")
@@ -378,7 +440,9 @@ class AdmissionController:
         self._reg.counter("serve.engine_failures").inc()
         self.breaker.on_failure(pending.probe)
         pending.probe = False  # the probe verdict is spent; a retry is ordinary traffic
-        if pending.retries_left <= 0 or self.breaker.state == BREAKER_OPEN or (
+        with self._lock:
+            retries_enabled = self._retries_enabled
+        if pending.retries_left <= 0 or not retries_enabled or self.breaker.state == BREAKER_OPEN or (
             pending.t_deadline is not None and time.perf_counter() >= pending.t_deadline
         ):
             self._release(pending.cls)
@@ -435,9 +499,16 @@ class AdmissionController:
         with self._lock:
             in_queue = dict(self._in_queue)
             ewma = self._ewma_s
+            brownout = {
+                "level": self._brownout_level,
+                "shed_classes": sorted(self._shed_classes),
+                "deadline_margin": self._deadline_margin,
+                "retries_enabled": self._retries_enabled,
+            }
         return {
             "breaker": self.breaker.state_name,
             "breaker_state": self.breaker.state,
+            "brownout": brownout,
             "ewma_latency_s": ewma,
             "predictor": self._predictor,
             "predicted_wait_s": self.predicted_wait_s(),

@@ -9,8 +9,10 @@ replica count N inside ``[min_replicas, max_replicas]``:
 
 - **scale up** when the WINDOW p99 (bucket-count deltas since the last
   tick, through the registry's own quantile math — not the whole-run
-  quantile, which old traffic would anchor) exceeds ``up_p99_ms`` OR the
-  mean routable queue depth exceeds ``up_queue_depth``;
+  quantile, which old traffic would anchor; the shared
+  :class:`~.signals.SignalReader` implementation, consumed by the brownout
+  ladder too) exceeds ``up_p99_ms`` OR the mean routable queue depth
+  exceeds ``up_queue_depth``;
 - **scale down** when the window p99 is below ``down_p99_ms`` (or the
   window is empty — an idle fleet drains to ``min_replicas``) AND the mean
   queue depth is below ``down_queue_depth``;
@@ -37,9 +39,10 @@ from __future__ import annotations
 import threading
 import time
 
-from ..obs.registry import get_registry, quantiles_from_counts
+from ..obs.registry import get_registry
 from ..utils.logging import emit
 from .hedge import ROUTER_LATENCY
+from .signals import SignalReader
 
 
 class Autoscaler:
@@ -78,8 +81,13 @@ class Autoscaler:
         self._down_queue = down_queue_depth
         self._cls = signal_class
         self._reg = get_registry()
-        self._hist = self._reg.histogram(f"{ROUTER_LATENCY}.{signal_class}")
-        self._counts_prev = self._hist.bucket_counts()
+        # the shared windowed-signal reader (serve/signals.py): window p99
+        # off bucket-count deltas + the router's polled backlog — one
+        # implementation with the brownout ladder, pinned unchanged here
+        self._signals = SignalReader(
+            latency_family=ROUTER_LATENCY, signal_class=signal_class,
+            quantile=0.99, queue_depth_fn=router.mean_queue_depth,
+        )
         self._last_action_t: float | None = None
         self._t0 = time.perf_counter()
         self._stop = threading.Event()
@@ -87,27 +95,14 @@ class Autoscaler:
         # the N-over-time trajectory: one row per tick, bench-artifact-ready
         self.trace: list[dict] = []
 
-    # -- signals -------------------------------------------------------------
-
-    def _window_p99_s(self) -> float | None:
-        """p99 of the latency observed SINCE the last tick; None when the
-        window saw no completions (idle — only the queue signal speaks)."""
-        counts = self._hist.bucket_counts()
-        delta = [a - b for a, b in zip(counts, self._counts_prev)]
-        self._counts_prev = counts
-        if sum(delta) == 0:
-            return None
-        (p99,) = quantiles_from_counts(self._hist.bounds, delta, (0.99,))
-        return p99
-
     # -- the control step ----------------------------------------------------
 
     def step(self, now: float | None = None) -> dict:
         """One control decision. Separated from the thread so tests drive
         the logic deterministically. Returns the appended trace row."""
         now = time.perf_counter() if now is None else now
-        p99_s = self._window_p99_s()
-        queue_depth = self._router.mean_queue_depth()
+        p99_s = self._signals.window_p99_s()
+        queue_depth = self._signals.queue_depth()
         n = self._fleet.n_replicas
         in_cooldown = (
             self._last_action_t is not None and now - self._last_action_t < self._cooldown_s

@@ -166,6 +166,12 @@ class MicroBatcher:
         # hand, then exit (never re-enqueue the sentinel — a full queue would
         # deadlock the put)
         self._exit_after_batch = False
+        # brownout fill-or-flush (serve/brownout.py L2+): when True the
+        # coalescing linger is skipped — top up from whatever is ALREADY
+        # queued (under saturation that is a full batch) and dispatch
+        # immediately; an idle lull must not add max_wait_ms of latency to
+        # work the storm already queued
+        self._fill_or_flush = False
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -295,17 +301,32 @@ class MicroBatcher:
         self._linger_fill(batch)
         return batch
 
+    def set_fill_or_flush(self, enabled: bool) -> None:
+        """Brownout actuator (L2+): disable the coalescing linger — batches
+        fill only from what is already queued, then dispatch. Idempotent and
+        safe to flip live from the controller thread."""
+        self._fill_or_flush = bool(enabled)
+
+    def apply_brownout(self, policy) -> None:
+        """The batcher's slice of a :class:`~.brownout.BrownoutPolicy`."""
+        self.set_fill_or_flush(policy.fill_or_flush)
+
     def _linger_fill(self, batch: list[_Request]) -> None:
         """Top ``batch`` up from the queue until max_batch or max_wait_s of
         linger, whichever first — the shared coalescing policy (also used by
-        the pipelined back-to-back path when a drain comes up short)."""
+        the pipelined back-to-back path when a drain comes up short). Under
+        brownout fill-or-flush the linger window collapses to zero: only
+        already-queued requests join, then the batch dispatches."""
         t_close = time.perf_counter() + self._max_wait_s
         while len(batch) < self._max_batch:
-            remaining = t_close - time.perf_counter()
-            if remaining <= 0:
-                break
+            if self._fill_or_flush:
+                remaining = 0.0  # no waiting: drain what's there, then go
+            else:
+                remaining = t_close - time.perf_counter()
+                if remaining <= 0:
+                    break
             try:
-                nxt = self._q.get(timeout=remaining)
+                nxt = self._q.get(timeout=remaining) if remaining > 0 else self._q.get_nowait()
             except queue.Empty:
                 break
             if nxt is _STOP:

@@ -60,12 +60,30 @@ class ClientTimeout(ClientError):
 class ClientHTTPError(ClientError):
     """A non-2xx response with the frontend's typed error body. ``status``
     and ``tag`` mirror the wire (``429``/``queue_full``, ``503``/
-    ``breaker_open``, ...), so routers re-raise replica verdicts verbatim."""
+    ``breaker_open``, ...), so routers re-raise replica verdicts verbatim.
+    ``retry_after`` carries the response's ``Retry-After`` seconds when the
+    server sent one — the backpressure signal the router uses to tell an
+    overloaded-but-healthy replica (do NOT eject) from a dead one."""
 
-    def __init__(self, status: int, tag: str, message: str):
+    def __init__(self, status: int, tag: str, message: str,
+                 retry_after: float | None = None):
         super().__init__(f"{status} {tag}: {message}")
         self.status = status
         self.tag = tag
+        self.retry_after = retry_after
+
+
+def _parse_retry_after(headers: dict) -> float | None:
+    """Seconds from a ``Retry-After`` header; None when absent or not the
+    delta-seconds form (the HTTP-date form is never emitted by our
+    frontend, so it is not worth a date parser here)."""
+    raw = headers.get("Retry-After")
+    if raw is None:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
 
 
 class ReplicaClient:
@@ -160,11 +178,12 @@ class ReplicaClient:
             headers["X-Deadline-Ms"] = str(deadline_ms)
         if request_id:
             headers["X-Request-Id"] = str(request_id)
-        status, _, doc = self._request_json(
+        status, resp_headers, doc = self._request_json(
             "POST", "/predict", body=image.tobytes(), headers=headers, timeout_s=timeout_s
         )
         if status != 200:
-            raise ClientHTTPError(status, doc.get("error", "unknown"), doc.get("message", ""))
+            raise ClientHTTPError(status, doc.get("error", "unknown"), doc.get("message", ""),
+                                  retry_after=_parse_retry_after(resp_headers))
         return np.asarray(doc["logits"], np.float32)
 
     def healthz(self, timeout_s: float | None = None) -> tuple[int, dict]:

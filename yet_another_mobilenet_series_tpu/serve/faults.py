@@ -23,7 +23,10 @@ reproducible:
   ``latency_rate`` (compounded like failures), applied inside ``result()``
   (the completion thread's sync), never at dispatch — the device-feeding
   path stays non-blocking exactly as in a real slow-device episode (Kernel
-  Looping discipline);
+  Looping discipline). ``latency_after_n`` delays the onset: the first N
+  dispatches run clean, then the injection begins — a replica that
+  DEGRADES mid-run (the gray-failure drill: the router must notice a
+  replica that was healthy when it learned its baseline);
 - **hang-until-event** — dispatch index ``hang_at`` blocks its ``result()``
   on :attr:`hang_release` indefinitely: the drain-timeout / stall-watchdog
   drill. Setting the event un-wedges the handle, which then serves the
@@ -97,6 +100,7 @@ class FaultyEngine:
         fail_at: str = "dispatch",
         latency_s: float = 0.0,
         latency_rate: float = 1.0,
+        latency_after_n: int = 0,
         hang_at: int | None = None,
     ):
         if fail_at not in ("dispatch", "result"):
@@ -107,6 +111,7 @@ class FaultyEngine:
         self._fail_at = fail_at
         self._latency_s = latency_s
         self._latency_rate = latency_rate
+        self._latency_after_n = max(0, int(latency_after_n))
         self._hang_at = hang_at
         self.hang_release = threading.Event()
         self._rng = random.Random(seed)
@@ -135,6 +140,7 @@ class FaultyEngine:
             delay = (
                 self._latency_s
                 if self._latency_s > 0
+                and idx >= self._latency_after_n  # degrade-onset gate
                 and self._rng.random() < 1.0 - (1.0 - self._latency_rate) ** n_rows
                 else 0.0
             )
@@ -179,6 +185,7 @@ class FaultyEngine:
             fail_at=fc.fail_at,
             latency_s=fc.latency_ms / 1e3,
             latency_rate=fc.latency_rate,
+            latency_after_n=fc.latency_after_n,
             hang_at=fc.hang_at if fc.hang_at >= 0 else None,
         )
         kw.update(overrides)

@@ -60,7 +60,7 @@ import numpy as np
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
-from .admission import BreakerOpen, DeadlineUnmeetable, BREAKER_OPEN
+from .admission import BreakerOpen, BrownoutShed, DeadlineUnmeetable, BREAKER_OPEN
 from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
 from .client import ClientHTTPError, ClientTimeout
 from .context import RequestContext
@@ -68,12 +68,15 @@ from .router import NoHealthyReplicas
 
 # this process's birth time: the replica-identity field a router compares to
 # detect a RESTARTED replica behind an unchanged address (same host:port,
-# new process) — pid alone can recycle
+# new process) — pid alone can recycle. Wall clock BY DESIGN (an identity
+# timestamp routers compare across hosts, never differenced into a duration
+# — the YAMT017 hazard is subtraction, not the reading).
 _PROC_START_UNIX = time.time()
 
 # exception type -> (HTTP status, wire error tag); anything else is a 500
 _ERROR_MAP = [
     (BreakerOpen, 503, "breaker_open"),
+    (BrownoutShed, 503, "brownout"),
     (DeadlineUnmeetable, 429, "deadline_unmeetable"),
     (QueueFull, 429, "queue_full"),  # covers ClassQueueFull too
     (DeadlineExceeded, 504, "deadline_exceeded"),
@@ -81,6 +84,14 @@ _ERROR_MAP = [
     (NoHealthyReplicas, 503, "no_healthy_replicas"),
     (ClientTimeout, 504, "timeout"),
 ]
+
+# 429/503 tags that mean "alive but saturated — come back": these carry a
+# Retry-After header (RFC 9110), which is ALSO the router's backpressure
+# discriminator (a Retry-After-bearing 503 never scores toward ejection).
+# "draining" and "no_healthy_replicas" mean "stop sending here" — no hint.
+_RETRY_AFTER_TAGS = frozenset({
+    "breaker_open", "brownout", "deadline_unmeetable", "queue_full",
+})
 
 
 def _classify(exc: Exception) -> tuple[int, str]:
@@ -92,6 +103,21 @@ def _classify(exc: Exception) -> tuple[int, str]:
         if isinstance(exc, typ):
             return status, tag
     return 500, "engine_error"
+
+
+def _retry_after_s(exc: Exception, status: int, tag: str, default_s: float) -> float | None:
+    """The Retry-After seconds for one error response, or None for no
+    header: an exception-carried hint wins (BrownoutShed's own bound, a
+    replica's header passing through the router verbatim), then the
+    frontend default for every overload-shaped 429/503 tag."""
+    carried = getattr(exc, "retry_after_s", None)  # BrownoutShed
+    if carried is None:
+        carried = getattr(exc, "retry_after", None)  # ClientHTTPError pass-through
+    if carried is not None:
+        return float(carried)
+    if status in (429, 503) and tag in _RETRY_AFTER_TAGS:
+        return default_s
+    return None
 
 
 def write_listen_addr(log_dir: str, addr: dict) -> str:
@@ -138,6 +164,18 @@ class _Handler(BaseHTTPRequestHandler):
         get_registry().counter("serve.http_errors").inc()
         self._send_json(status, {"error": tag, "message": message}, headers)
 
+    def _send_typed_error(self, exc: Exception, rid_hdr: dict) -> None:
+        """Map one typed failure to its wire verdict, attaching Retry-After
+        to every overload-shaped 429/503 (exception-carried hints — a
+        brownout shed's own bound, a replica's header crossing the router —
+        pass through verbatim)."""
+        status, tag = _classify(exc)
+        headers = dict(rid_hdr)
+        retry_after = _retry_after_s(exc, status, tag, self.frontend.retry_after_s)
+        if retry_after is not None:
+            headers["Retry-After"] = f"{max(retry_after, 0.0):.0f}"
+        self._send_error_json(status, tag, str(exc), headers)
+
     # -- GET /healthz, /metrics, /varz --------------------------------------
 
     def do_GET(self):  # noqa: N802 — stdlib method name
@@ -155,6 +193,9 @@ class _Handler(BaseHTTPRequestHandler):
         state = fe.admission.state()
         state["inflight"] = int(get_registry().gauge("serve.inflight").value)
         state["draining"] = fe._draining
+        # the degradation ladder's position (0 = healthy): rides health so a
+        # poller/load balancer sees HOW degraded, not just up-or-down
+        state["brownout_level"] = int(get_registry().gauge("serve.brownout_level").value)
         # replica identity: lets a router/obs_report attribute this health
         # to a specific process and detect a restart behind the same address
         state["replica"] = fe.identity()
@@ -273,11 +314,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_json(400, "bad_request", str(e), rid_hdr)
             return
         except Exception as e:  # noqa: BLE001 — typed arrival rejections
-            status, tag = _classify(e)
-            headers = dict(rid_hdr)
-            if status == 503:
-                headers["Retry-After"] = f"{fe.retry_after_s:.0f}"
-            self._send_error_json(status, tag, str(e), headers)
+            self._send_typed_error(e, rid_hdr)
             return
         # the handler thread is this request's only waiter: a deadline
         # extends the server bound (the admission/batcher layers resolve the
@@ -289,8 +326,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_json(504, "timeout", f"no result within {timeout_s:.1f}s", rid_hdr)
             return
         except Exception as e:  # noqa: BLE001 — typed shed/failure outcomes
-            status, tag = _classify(e)
-            self._send_error_json(status, tag, str(e), rid_hdr)
+            self._send_typed_error(e, rid_hdr)
             return
         self._send_json(
             200,

@@ -35,6 +35,11 @@ resolution across its legs (``primary`` + at most one ``hedge``):
 - ``serve.hedges`` counts fired duplicates (armed timers that actually
   launched a second leg, not armings).
 
+Under brownout (serve/brownout.py) hedging is the FIRST thing to go — L1
+stops duplicating work before anything is shed — and every timer that
+would have armed while disabled counts ``serve.hedges_suppressed``
+(:meth:`Hedger.suppressed`): the duplicate load the ladder declined to add.
+
 The router (serve/router.py) owns the threading: it arms a
 ``threading.Timer`` per eligible request and cancels it when the primary
 resolves first.
@@ -75,6 +80,12 @@ class Hedger:
         if hist.count < self.min_samples:
             return None
         return min(max(hist.quantile(self.quantile), self.min_timer_s), self.max_timer_s)
+
+    def suppressed(self) -> None:
+        """Record one hedge the brownout ladder declined to arm
+        (``serve.hedges_suppressed``) — the router calls this when a timer
+        WOULD have fired but hedging is disabled at L1+."""
+        self._reg.counter("serve.hedges_suppressed").inc()
 
 
 class HedgedCall:

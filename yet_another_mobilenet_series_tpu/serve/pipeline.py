@@ -67,7 +67,10 @@ exists to make impossible).
 Failure semantics are preserved, not weakened:
 
 - ``QueueFull`` backpressure and dispatch-time deadline shedding behave as
-  in the sync batcher (shared code);
+  in the sync batcher (shared code), and so does brownout fill-or-flush
+  (serve/brownout.py L2+): the shared ``_linger_fill`` collapses its linger
+  window to zero, which this batcher's top-up and short-drain paths inherit
+  — under a storm the queue supplies full batches without the wait;
 - deadlines are ALSO checked at completion: a request whose deadline passed
   while its batch was executing gets :class:`~.batcher.DeadlineExceeded`
   instead of a stale answer (``serve.shed_at_completion`` counts these,

@@ -24,6 +24,26 @@ Routing policy, all driven by what the replicas THEMSELVES report:
   poll readmits it (``fleet.readmissions``). Ejection is advisory — with
   every replica ejected the router fails typed
   (:class:`NoHealthyReplicas` -> 503), never silently.
+- **latency-based soft ejection** (gray failure): crash counters never fire
+  for a slow-but-alive replica, so one straggler poisons the fleet p99
+  forever. Each replica carries an EWMA of its per-leg dispatch latency;
+  every poll sweep compares it against the fleet's (lower) median. A
+  multiplicative outlier (``slow_factor`` x median, above an absolute
+  ``slow_min_ms`` floor) first has its routing weight DECAYED (halved per
+  outlier sweep — load skews away before anything is ejected), and after
+  ``slow_eject_after`` consecutive outlier sweeps is ejected
+  (``fleet.slow_ejections``, also counted in ``fleet.ejections``). It
+  readmits through the existing healthy-poll path after a
+  ``slow_cooldown_s`` probation, with a fresh latency estimate — still
+  slow, it walks the same decay-then-eject path again; recovered, it stays.
+- **backpressure vs death**: a 503 carrying ``Retry-After`` is an
+  overloaded-but-healthy replica (breaker cooldown, brownout shed) — the
+  request re-routes (``fleet.backpressure``) but the replica's ejection
+  counter is NOT touched; a 503 without it (draining, nothing routable
+  behind a nested router) scores toward ejection like a transport failure.
+- **poll desynchronization**: each replica's next health poll is scheduled
+  with per-replica seeded jitter around ``poll_interval_s``, so N routers
+  x M replicas cannot phase-lock into a thundering poll herd.
 - **transport retry**: a dead socket (:class:`~.client.ClientConnectError`)
   or a replica-side 503 (draining / its own breaker) re-routes the request
   to the next replica (``fleet.route_retries``), because inference is pure;
@@ -53,7 +73,7 @@ import numpy as np
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
-from .admission import CLASSES
+from .admission import CLASSES, BrownoutShed
 from .client import ClientConnectError, ClientError, ClientHTTPError, ReplicaClient
 from .hedge import ROUTER_LATENCY, HedgedCall, Hedger
 
@@ -67,7 +87,8 @@ class _Replica:
     """Router-side view of one backend: client + polled health."""
 
     __slots__ = ("key", "host", "port", "client", "routable", "consecutive_failures",
-                 "queue_depth", "breaker_state", "draining", "identity")
+                 "queue_depth", "breaker_state", "draining", "identity",
+                 "lat_ewma_s", "slow_strikes", "slow_until", "weight_scale", "next_poll_t")
 
     def __init__(self, host: str, port: int, client):
         self.key = f"{host}:{port}"
@@ -80,9 +101,19 @@ class _Replica:
         self.breaker_state = 0
         self.draining = False
         self.identity: dict = {}
+        # gray-failure bookkeeping: EWMA of per-LEG dispatch latency (None
+        # until the first success), consecutive outlier-sweep strikes, the
+        # probation deadline a slow ejection imposes, and the multiplicative
+        # weight decay applied while this replica is an outlier
+        self.lat_ewma_s: float | None = None
+        self.slow_strikes = 0
+        self.slow_until = 0.0
+        self.weight_scale = 1.0
+        # per-replica jittered poll schedule (monotonic deadline)
+        self.next_poll_t = 0.0
 
     def weight(self) -> float:
-        return 1.0 / (1.0 + max(self.queue_depth, 0.0))
+        return self.weight_scale / (1.0 + max(self.queue_depth, 0.0))
 
     def as_dict(self) -> dict:
         return {
@@ -92,6 +123,9 @@ class _Replica:
             "breaker_state": self.breaker_state,
             "draining": self.draining,
             "consecutive_failures": self.consecutive_failures,
+            "lat_ewma_ms": round(self.lat_ewma_s * 1e3, 3) if self.lat_ewma_s is not None else None,
+            "slow_strikes": self.slow_strikes,
+            "weight_scale": self.weight_scale,
             "identity": self.identity,
         }
 
@@ -112,16 +146,41 @@ class Router:
         seed: int = 0,
         max_workers: int = 32,
         client_factory=None,
+        poll_jitter: float = 0.2,
+        slow_eject: bool = False,
+        slow_factor: float = 3.0,
+        slow_eject_after: int = 3,
+        slow_cooldown_s: float = 5.0,
+        slow_min_ms: float = 1.0,
+        lat_alpha: float = 0.3,
     ):
         if default_class not in CLASSES:
             raise ValueError(f"default_class {default_class!r} not in {CLASSES}")
+        if not 0.0 <= poll_jitter < 1.0:
+            raise ValueError(f"poll_jitter must be in [0, 1), got {poll_jitter}")
+        if slow_factor <= 1.0:
+            raise ValueError(f"slow_factor must be > 1 (a multiplicative outlier), got {slow_factor}")
         self._default_class = default_class
         self._poll_interval_s = poll_interval_s
+        self._poll_jitter = poll_jitter
         self._eject_failures = max(1, int(eject_failures))
         self._route_attempts = max(1, int(route_attempts))
         self._client_timeout_s = client_timeout_s
         self._hedger = hedger
+        self._hedging_enabled = True  # brownout L1+ flips this off
+        self._shed_classes: frozenset[str] = frozenset()
+        self._brownout_level = 0
+        self._brownout_retry_after_s = 1.0
+        self._slow_eject = bool(slow_eject)
+        self._slow_factor = float(slow_factor)
+        self._slow_eject_after = max(1, int(slow_eject_after))
+        self._slow_cooldown_s = float(slow_cooldown_s)
+        self._slow_min_s = slow_min_ms / 1e3
+        self._lat_alpha = float(lat_alpha)
         self._rng = random.Random(seed)
+        # the poll scheduler's own stream: pick draws must not perturb the
+        # deterministic per-replica jitter (and vice versa)
+        self._poll_rng = random.Random(seed + 0x9E37)
         self._client_factory = client_factory or (
             lambda host, port: ReplicaClient(host, port, timeout_s=client_timeout_s)
         )
@@ -182,19 +241,36 @@ class Router:
     def _poll_loop(self) -> None:
         try:  # YAMT011: a silently-dead poll thread would freeze health state
             obs_trace.get_tracer().register_thread()
-            while not self._stop.wait(self._poll_interval_s):
-                self.poll_once()
+            # the loop ticks FASTER than the poll interval and polls only the
+            # replicas whose jittered deadline has passed — per-replica
+            # schedules drift apart instead of firing as one herd
+            tick = max(self._poll_interval_s / 4.0, 0.02)
+            while not self._stop.wait(tick):
+                self.poll_once(now=time.monotonic())
         except Exception as e:  # noqa: BLE001 — contain, count, report
             self._reg.counter("serve.thread_crashes").inc()
             emit(f"[fleet] router poll thread crashed: {type(e).__name__}: {e}")
 
-    def poll_once(self) -> None:
-        """One health sweep over every backend (also callable directly —
-        tests and the autoscaler use it for deterministic refreshes)."""
+    def _next_poll_t(self, now: float) -> float:
+        """The next jittered poll deadline: interval scaled by a seeded draw
+        in [1 - jitter, 1 + jitter], per replica per poll — N routers x M
+        replicas starting together desynchronize within a few intervals
+        instead of thundering every /healthz at once."""
+        factor = 1.0 + self._poll_jitter * self._poll_rng.uniform(-1.0, 1.0)
+        return now + self._poll_interval_s * factor
+
+    def poll_once(self, now: float | None = None) -> None:
+        """One health sweep. With ``now`` (the poll thread's monotonic
+        clock), only replicas whose jittered deadline has passed are polled;
+        called bare (tests, the bench's deterministic refreshes) it polls
+        every backend immediately."""
+        force = now is None
+        now = time.monotonic() if now is None else now
         with self._lock:
-            reps = list(self._replicas.values())
+            reps = [r for r in self._replicas.values() if force or now >= r.next_poll_t]
         poll_timeout = max(2.0, 4 * self._poll_interval_s)
         for rep in reps:
+            rep.next_poll_t = self._next_poll_t(now)
             try:
                 status, doc = rep.client.healthz(timeout_s=poll_timeout)
             except ClientError:
@@ -212,8 +288,50 @@ class Router:
                     self._reg.counter("fleet.replica_restarts").inc()
                 if identity:
                     rep.identity = identity
-                healthy = status == 200 and not rep.draining
+                # a slow-ejected replica serves out its probation before a
+                # healthy poll may readmit it (otherwise the very next sweep
+                # would readmit and the ladder would flap)
+                healthy = status == 200 and not rep.draining and now >= rep.slow_until
                 self._set_routable_locked(rep, healthy)
+        if reps:
+            self._slow_sweep(now)
+
+    # -- gray-failure detection (latency-based soft ejection) ----------------
+
+    def _slow_sweep(self, now: float) -> None:
+        """Compare every routable replica's per-leg latency EWMA against the
+        fleet's LOWER median (robust in 2-replica fleets: the outlier never
+        drags its own threshold up). A multiplicative outlier decays its
+        routing weight first; ``slow_eject_after`` consecutive outlier
+        sweeps eject it (``fleet.slow_ejections``) into a
+        ``slow_cooldown_s`` probation, after which the ordinary healthy
+        poll readmits it with a fresh estimate."""
+        if not self._slow_eject:
+            return
+        with self._lock:
+            scored = [r for r in self._replicas.values()
+                      if r.routable and r.lat_ewma_s is not None]
+            if len(scored) < 2:
+                return  # no fleet to be an outlier OF
+            med = sorted(r.lat_ewma_s for r in scored)[(len(scored) - 1) // 2]
+            threshold = max(med * self._slow_factor, self._slow_min_s)
+            for rep in scored:
+                if rep.lat_ewma_s > threshold:
+                    rep.slow_strikes += 1
+                    # decay first: load skews away before anything ejects
+                    rep.weight_scale = max(rep.weight_scale * 0.5, 1.0 / 16.0)
+                    if rep.slow_strikes >= self._slow_eject_after:
+                        self._reg.counter("fleet.slow_ejections").inc()
+                        self._set_routable_locked(rep, False)
+                        rep.slow_until = now + self._slow_cooldown_s
+                        # probation starts clean: the estimate that ejected
+                        # it must not re-eject it before it serves a request
+                        rep.slow_strikes = 0
+                        rep.weight_scale = 1.0
+                        rep.lat_ewma_s = None
+                else:
+                    rep.slow_strikes = 0
+                    rep.weight_scale = min(1.0, rep.weight_scale * 2.0)
 
     def _set_routable_locked(self, rep: _Replica, routable: bool) -> None:
         if routable and not rep.routable:
@@ -248,6 +366,21 @@ class Router:
         arms through ONE router so replica state is shared)."""
         self._hedger = hedger
 
+    def set_slow_ejection(self, enabled: bool) -> None:
+        """Flip gray-failure soft ejection live (the --overload bench warms
+        the fleet with it off, then arms it at the round start so
+        time-to-eject is measured from a known instant)."""
+        self._slow_eject = bool(enabled)
+
+    def apply_brownout(self, policy) -> None:
+        """The router's slice of a :class:`~.brownout.BrownoutPolicy`:
+        hedging on/off (L1 stops duplicating work first) and the classes
+        the fleet door sheds with Retry-After (L3+)."""
+        self._hedging_enabled = bool(policy.hedging)
+        self._shed_classes = frozenset(policy.shed_classes)
+        self._brownout_level = int(policy.level)
+        self._brownout_retry_after_s = float(policy.retry_after_s)
+
     def n_routable(self) -> int:
         with self._lock:
             return sum(1 for r in self._replicas.values() if r.routable)
@@ -266,6 +399,14 @@ class Router:
         cls = priority or self._default_class
         if cls not in CLASSES:
             raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
+        if cls in self._shed_classes:
+            # brownout at the FLEET door: cheaper than a hop to any replica
+            self._reg.counter("serve.rejected_brownout").inc()
+            raise BrownoutShed(
+                f"class {cls!r} shed at brownout level L{self._brownout_level}; "
+                f"retry after {self._brownout_retry_after_s:.1f}s",
+                retry_after_s=self._brownout_retry_after_s,
+            )
         fut: Future = Future()
         call = HedgedCall(fut)
         image = np.asarray(image, np.float32)
@@ -287,7 +428,15 @@ class Router:
         rid = ctx.wire_id if ctx is not None else None
         timer: threading.Timer | None = None
         primary_at: dict = {}
-        hedge_s = self._hedger.timer_s(cls) if self._hedger is not None else None
+        hedge_s = None
+        if self._hedger is not None:
+            if self._hedging_enabled:
+                hedge_s = self._hedger.timer_s(cls)
+            else:
+                # brownout L1+: a timer that WOULD have armed is counted as
+                # suppressed — the "work we chose not to duplicate" instrument
+                if self._hedger.timer_s(cls) is not None and self.n_routable() >= 2:
+                    self._hedger.suppressed()
         # the hedge timer arms at LEG start, while the histogram it derives
         # from measures submit -> resolution: under router-side overload the
         # timer inflates past per-leg latency, so hedging naturally backs
@@ -333,6 +482,7 @@ class Router:
             if chosen is not None:
                 chosen["key"] = rep.key
             t0 = time.perf_counter() if t_submit is None else t_submit
+            t_leg = time.perf_counter()
             try:
                 logits = rep.client.predict(
                     image, priority=cls, deadline_ms=deadline_ms, request_id=rid,
@@ -348,8 +498,16 @@ class Router:
                 continue
             except ClientHTTPError as e:
                 if e.status == 503:
-                    # replica-local unavailability (draining / its breaker):
-                    # another replica may well serve it
+                    if e.retry_after is not None:
+                        # backpressure: the replica is ALIVE, just saturated
+                        # (breaker cooldown / brownout shed) — re-route, but
+                        # never score its ejection counter: an overloaded
+                        # replica and a dead one are different things
+                        self._reg.counter("fleet.backpressure").inc()
+                    else:
+                        # unavailability with no comeback hint (draining,
+                        # nothing routable behind it): score toward ejection
+                        self._record_failure(rep)
                     self._reg.counter("fleet.route_retries").inc()
                     tried.add(rep.key)
                     last_exc = e
@@ -359,8 +517,16 @@ class Router:
             except ClientError as e:  # timeout: the request burned its budget
                 call.err(leg, e)
                 return
+            leg_s = time.perf_counter() - t_leg
             with self._lock:
                 rep.consecutive_failures = 0
+                # per-replica latency estimate (the gray-failure signal):
+                # per-LEG time, excluding router queueing — a backed-up
+                # router must not make every replica look slow
+                rep.lat_ewma_s = (
+                    leg_s if rep.lat_ewma_s is None
+                    else self._lat_alpha * leg_s + (1 - self._lat_alpha) * rep.lat_ewma_s
+                )
             self._reg.histogram(f"{ROUTER_LATENCY}.{cls}").observe(time.perf_counter() - t0)
             self._reg.counter("fleet.routed").inc()
             call.ok(leg, logits)
@@ -383,5 +549,10 @@ class Router:
             "breaker_state": 0 if routable else 1,
             "breaker": "closed" if routable else "open",
             "queued_total": sum(r["queue_depth"] for r in reps),
+            "brownout": {
+                "level": self._brownout_level,
+                "shed_classes": sorted(self._shed_classes),
+                "hedging": self._hedging_enabled,
+            },
             "fleet": {"total": len(reps), "routable": routable, "replicas": reps},
         }

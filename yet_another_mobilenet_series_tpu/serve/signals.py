@@ -1,0 +1,119 @@
+"""Shared windowed-signal reader: the measured load signals every serving
+control loop consumes.
+
+Two controllers act on measured load — the fleet autoscaler
+(serve/autoscale.py: add capacity) and the brownout ladder
+(serve/brownout.py: trade quality for goodput when capacity cannot grow) —
+and both must answer the same question: *how is the system doing RIGHT NOW,
+not since boot?* The registry's histograms are cumulative, so a whole-run
+quantile is anchored by every request ever served; a controller reading it
+would see yesterday's calm long after today's storm began. The fix, factored
+here so both controllers share ONE implementation instead of drifting
+copies, is **bucket-count deltas**: snapshot the histogram's per-bucket
+counts each tick, subtract the previous snapshot, and run the registry's own
+quantile math (:func:`~..obs.registry.quantiles_from_counts`) over the
+difference — the p99 of exactly the completions that landed since the last
+tick, through the same interpolation /metrics exposes.
+
+:class:`WindowedQuantile` is that one primitive. :class:`SignalReader`
+bundles it with the other two live signals the controllers read:
+
+- **queue depth** — an injected callable (the router's
+  ``mean_queue_depth`` at the fleet tier; the admission controller's
+  ``queued_total`` at the replica tier), read fresh each tick;
+- **breaker state** — the ``serve.breaker_state`` gauge (0 closed / 1 open
+  / 2 half-open): an open breaker means the engine itself is sick, which is
+  overload evidence no latency window can show (rejected requests never
+  reach the histogram).
+
+Both consumers are pinned against this module: tests/test_fleet.py pins the
+autoscaler's decisions unchanged across the refactor, and
+tests/test_brownout.py drives the ladder from scripted
+:class:`Signals` traces.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from ..obs.registry import get_registry, quantiles_from_counts
+
+
+@dataclasses.dataclass(frozen=True)
+class Signals:
+    """One tick's measured-load snapshot.
+
+    ``p99_s`` is None when the window saw no completions (idle — only the
+    queue/breaker signals speak); ``breaker_state`` uses the admission
+    controller's encoding (0 closed / 1 open / 2 half-open).
+    """
+
+    p99_s: float | None
+    queue_depth: float
+    breaker_state: int
+
+    @property
+    def breaker_open(self) -> bool:
+        return self.breaker_state == 1
+
+
+class WindowedQuantile:
+    """The q-quantile of a bucketed histogram's observations SINCE the last
+    read — cumulative bucket counts differenced per tick, quantiled through
+    the registry's own interpolation. Returns None for an empty window."""
+
+    def __init__(self, name: str, quantile: float = 0.99):
+        if not 0.0 < quantile < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {quantile}")
+        self.name = name
+        self.quantile = quantile
+        self._hist = get_registry().histogram(name)
+        self._counts_prev = self._hist.bucket_counts()
+
+    def read(self) -> float | None:
+        counts = self._hist.bucket_counts()
+        delta = [a - b for a, b in zip(counts, self._counts_prev)]
+        self._counts_prev = counts
+        if sum(delta) == 0:
+            return None
+        (q,) = quantiles_from_counts(self._hist.bounds, delta, (self.quantile,))
+        return q
+
+
+class SignalReader:
+    """Windowed per-class tail latency + queue depth + breaker state, read
+    as one consistent :class:`Signals` snapshot per control tick.
+
+    ``latency_family`` names the per-class histogram family
+    (``serve.router.latency_seconds`` at the fleet tier,
+    ``serve.latency_seconds`` at the replica tier); ``queue_depth_fn`` is
+    the tier's backlog source (0 when None). Each :meth:`read` consumes the
+    window — two controllers must each own their OWN reader.
+    """
+
+    def __init__(
+        self,
+        *,
+        latency_family: str,
+        signal_class: str = "interactive",
+        quantile: float = 0.99,
+        queue_depth_fn: Callable[[], float] | None = None,
+    ):
+        self._window = WindowedQuantile(f"{latency_family}.{signal_class}", quantile)
+        self._queue_depth_fn = queue_depth_fn
+        self._breaker_gauge = get_registry().gauge("serve.breaker_state")
+
+    def window_p99_s(self) -> float | None:
+        """The windowed tail alone (the autoscaler's original signal)."""
+        return self._window.read()
+
+    def queue_depth(self) -> float:
+        return float(self._queue_depth_fn()) if self._queue_depth_fn is not None else 0.0
+
+    def read(self) -> Signals:
+        return Signals(
+            p99_s=self._window.read(),
+            queue_depth=self.queue_depth(),
+            breaker_state=int(self._breaker_gauge.value),
+        )
