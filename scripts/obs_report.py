@@ -274,6 +274,35 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
                     f"scale ups/downs = {snap.get('fleet.scale_ups', 0):.0f}"
                     f"/{snap.get('fleet.scale_downs', 0):.0f}"
                 )
+            if (snap.get("fleet.partition_ejections") or snap.get("serve.client.connect_timeouts")
+                    or snap.get("serve.netchaos.connections")):
+                # partition containment (serve/netchaos.py + the connect/
+                # read split): transport-shaped ejections vs crash-shaped,
+                # handshake timeouts, and injected socket chaos accounting
+                lines.append(
+                    f"  partitions: partition ejections = "
+                    f"{snap.get('fleet.partition_ejections', 0):.0f}, "
+                    f"client connect timeouts = "
+                    f"{snap.get('serve.client.connect_timeouts', 0):.0f}, "
+                    f"netchaos conns = {snap.get('serve.netchaos.connections', 0):.0f} "
+                    f"(blackholed {snap.get('serve.netchaos.blackholed', 0):.0f}, "
+                    f"resets {snap.get('serve.netchaos.resets', 0):.0f}, "
+                    f"half-open {snap.get('serve.netchaos.half_open', 0):.0f}, "
+                    f"chaos partitions {snap.get('fleet.chaos_partitions', 0):.0f})"
+                )
+            if snap.get("fleet.registrations") or snap.get("fleet.lease_expirations"):
+                # TTL-leased membership (the multi-host registration path):
+                # joins, heartbeat renewals, and leases that lapsed — a
+                # nonzero expiration count is a replica that VANISHED
+                lines.append(
+                    f"  fleet membership: registrations = "
+                    f"{snap.get('fleet.registrations', 0):.0f} "
+                    f"(renewals {snap.get('fleet.lease_renewals', 0):.0f}, "
+                    f"deregistrations {snap.get('fleet.deregistrations', 0):.0f}), "
+                    f"lease expirations = {snap.get('fleet.lease_expirations', 0):.0f}, "
+                    f"replica heartbeats = {snap.get('serve.register_heartbeats', 0):.0f} "
+                    f"(failed {snap.get('serve.register_failures', 0):.0f})"
+                )
             if snap.get("serve.hedges"):
                 wins = snap.get("serve.hedge_wins", 0)
                 lines.append(

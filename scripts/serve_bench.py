@@ -77,6 +77,20 @@ does not depend on trained weight values.
    autoscaler's N-over-time trace across a diurnal low/high/low open-loop
    schedule (cooldown respected). Emits the BENCH_SERVE_r06 shape.
 
+10. **partition** (``--partition``, standalone mode, jax-free) — the
+   multi-host partition-containment acceptance (serve/netchaos.py): N
+   in-process echo replicas each behind a seeded socket-level fault proxy,
+   one router over the proxy addresses. Seeded blackhole / reset /
+   half-open / flap rounds inject at the SOCKET level a third of the way
+   in and heal at two thirds, measuring detection time (fault onset ->
+   ejection, stamped by a counter watcher), client-visible error rate
+   (the contract is ZERO — transport retry absorbs every shape), and
+   recovery (heal -> fully routable through the probation); then the
+   TTL-lease membership round: a leased replica joins by heartbeat,
+   silently vanishes (heartbeat stops + link blackholed), and must be
+   REMOVED by lease expiry within TTL + one poll sweep. Emits the
+   BENCH_SERVE_r09 shape.
+
 9. **overload** (``--overload``, standalone mode) — the brownout ladder's
    acceptance experiment (serve/brownout.py): ONE seeded open-loop Poisson
    storm at ``--overload-multiple`` x the measured closed-loop capacity
@@ -107,6 +121,11 @@ Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--overload-multiple 3] [--overload-pace-ms 20]
            [--overload-replicas 2] [--overload-gray-requests 60]
            [--overload-straggler-ms 300] [--overload-seed 0] [--out f.json]
+       python scripts/serve_bench.py --partition [--partition-replicas 3]
+           [--partition-requests 120] [--partition-qps 30]
+           [--partition-poll-s 0.1] [--partition-connect-timeout-s 0.4]
+           [--partition-read-timeout-s 2.0] [--partition-lease-ttl-s 1.5]
+           [--partition-seed 0] [--out f.json]
 """
 
 from __future__ import annotations
@@ -1330,6 +1349,348 @@ def measure_overload(arch, image_size, buckets, *, storm_s, multiple, seed,
         fleet.stop()
 
 
+_PARTITION_CPU_CAVEAT = (
+    "cpu_rehearsal: router, replicas, proxies, and the load generator share "
+    "this box's core(s), so absolute latency/QPS are contention-dominated. "
+    "The pinned structural claims are host-independent: under each seeded "
+    "partition shape injected at the SOCKET level (netchaos proxy) every "
+    "submitted request resolves as completed or typed-rejected with zero "
+    "failures, the blackholed replica is ejected within the poll-budget "
+    "bound (eject_failures x (poll interval + connect budget) + slack) "
+    "rather than the read timeout, the healed link readmits after its "
+    "probation, and a silently-vanished leased backend is REMOVED within "
+    "TTL + one poll sweep. Replica count and absolute rates are a real "
+    "multi-host measurement — the same caveat discipline as r02..r08."
+)
+
+
+def _partition_round(router, image, *, n_requests, target_qps, seed,
+                     hooks=(), result_timeout_s=60.0):
+    """One open-loop Poisson round through the fleet router with indexed
+    ``hooks`` [(idx, fn), ...] fired just before their request index (the
+    fault-onset / heal injection points). Every future resolves at the end
+    — a hang is ``unresolved`` > 0, never a stuck bench; latencies stamp at
+    resolution via callbacks."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.serve.client import ClientHTTPError
+
+    rs = np.random.RandomState(seed)
+    gaps = rs.exponential(1.0 / target_qps, size=n_requests)
+    hooks = sorted(hooks)
+    pending = []
+    lat = []
+    lat_lock = threading.Lock()
+
+    def _stamp(t0):
+        def cb(fut):
+            if fut.exception() is None:
+                with lat_lock:
+                    lat.append(time.perf_counter() - t0)
+        return cb
+
+    t_start = time.perf_counter()
+    t_next = t_start
+    hook_i = 0
+    for i in range(n_requests):
+        t_next += gaps[i]
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        while hook_i < len(hooks) and i >= hooks[hook_i][0]:
+            hooks[hook_i][1]()
+            hook_i += 1
+        t0 = time.perf_counter()
+        fut = router.submit(image)
+        fut.add_done_callback(_stamp(t0))
+        pending.append(fut)
+    while hook_i < len(hooks):  # a heal indexed past the end still fires
+        hooks[hook_i][1]()
+        hook_i += 1
+    out = {"submitted": n_requests, "completed": 0, "rejected": 0, "failed": 0,
+           "unresolved": 0}
+    for fut in pending:
+        try:
+            fut.result(timeout=result_timeout_s)
+            out["completed"] += 1
+        except FutTimeout:
+            out["unresolved"] += 1  # a real hang: the router broke its contract
+        except ClientHTTPError as e:
+            out["rejected" if e.status < 500 else "failed"] += 1
+        except Exception:  # noqa: BLE001 — typed route failure = client-visible
+            out["failed"] += 1
+    wall = time.perf_counter() - t_start
+    lat.sort()
+    out.update({
+        "wall_s": round(wall, 3),
+        "qps": round(out["completed"] / wall, 2) if wall else 0.0,
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 3),
+    })
+    return out
+
+
+_PARTITION_ROUND_KEYS = ("fleet.route_retries", "fleet.ejections", "fleet.readmissions",
+                         "fleet.partition_ejections", "serve.client.connect_timeouts")
+
+
+def measure_partition(*, replicas, requests, target_qps, seed, poll_interval_s,
+                      eject_failures, connect_timeout_s, read_timeout_s,
+                      eject_cooldown_s, lease_ttl_s, flap_period_s, flap_down_s):
+    """The ``--partition`` measurement (the r09 shape): N in-process echo
+    replicas (real Frontend + pipelined batcher over a trivial engine — no
+    jax, so the round measures the TRANSPORT, not a model), each behind its
+    own seeded netchaos proxy, one fleet router over the proxy addresses.
+
+    Four seeded fault rounds on one schedule family — ``blackhole``,
+    ``reset``, ``half_open``, ``flap`` — each injecting its shape at the
+    socket level a third of the way in and healing at two thirds, measuring
+    DETECTION (fault onset -> ejection, stamped by a counter watcher, never
+    by the submit loop), client-visible error rate (the contract is ZERO:
+    transport retry absorbs every shape), and RECOVERY (heal -> fully
+    routable again, through the post-ejection probation). Then the
+    ``membership`` round: a leased replica joins via /register-style
+    heartbeats, vanishes silently (heartbeat stops + link blackholed), and
+    must be REMOVED by lease expiry within TTL + one poll sweep while
+    traffic keeps answering."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.admission import AdmissionController
+    from yet_another_mobilenet_series_tpu.serve.frontend import Frontend
+    from yet_another_mobilenet_series_tpu.serve.netchaos import NetChaosProxy
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+    from yet_another_mobilenet_series_tpu.serve.router import Router
+
+    reg = get_registry()
+
+    class _EchoEngine:
+        def predict_async(self, images):
+            class _H:
+                def result(_self):
+                    return images[:, 0, 0, :1].astype(np.float32)
+
+            return _H()
+
+        def predict(self, images):
+            return self.predict_async(images).result()
+
+    def echo_replica(tag):
+        b = PipelinedBatcher(_EchoEngine(), max_batch=8, max_wait_ms=1.0,
+                             queue_depth=256, drain_timeout_s=5.0).start()
+        fe = Frontend(AdmissionController(b), port=0, replica_id=tag).start()
+        return b, fe
+
+    stacks = [echo_replica(f"p{i}") for i in range(replicas)]
+    proxies = [NetChaosProxy("127.0.0.1", fe.port, seed=seed + i).start()
+               for i, (_, fe) in enumerate(stacks)]
+    router = Router(
+        [p.addr for p in proxies],
+        poll_interval_s=poll_interval_s, eject_failures=eject_failures,
+        route_attempts=replicas + 1, client_timeout_s=read_timeout_s,
+        connect_timeout_s=connect_timeout_s, eject_cooldown_s=eject_cooldown_s,
+        lease_ttl_s=lease_ttl_s, seed=seed,
+    ).start()
+    poll_read_s = max(connect_timeout_s, 2 * poll_interval_s)
+    # the acceptance bound: ejection within the POLL budget (+ slack for a
+    # loaded 1-core box), provably far below the read timeout
+    detect_bound_s = eject_failures * (poll_interval_s + poll_read_s) + 2.0
+    # the fault window must OUTLAST the expected detection (else the heal
+    # races the ejection and the round measures nothing), and the round
+    # must outlast lead + window + a recovery tail — auto-extend requests
+    # so operator-tuned rates cannot produce a degenerate round
+    window_s = eject_failures * (poll_interval_s + poll_read_s) + 0.6
+    flap_window_s = max(window_s, 2.2 * flap_period_s)
+    lead_s, tail_s = 1.0, 2.5
+    requests = max(requests, int(target_qps * (lead_s + flap_window_s + tail_s)) + 1)
+    out = {
+        "replicas": replicas, "seed": seed, "requests_per_round": requests,
+        "target_qps": target_qps,
+        "config": {
+            "poll_interval_s": poll_interval_s, "eject_failures": eject_failures,
+            "connect_timeout_s": connect_timeout_s, "read_timeout_s": read_timeout_s,
+            "poll_read_s": poll_read_s, "eject_cooldown_s": eject_cooldown_s,
+            "lease_ttl_s": lease_ttl_s,
+            "flap_period_s": flap_period_s, "flap_down_s": flap_down_s,
+        },
+        "detect_bound_s": round(detect_bound_s, 3),
+    }
+    image = np.full((8, 8, 3), 3.0, np.float32)
+
+    def watch_counter(key, baseline, holder, stamp_key, t0, timeout_s=60.0):
+        def watch():
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if reg.snapshot().get(key, 0) > baseline:
+                    holder[stamp_key] = time.perf_counter() - t0
+                    return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        return t
+
+    def watch_routable(n, holder, stamp_key, t_holder, heal_key, timeout_s=60.0):
+        """Stamps recovery: the first instant ALL n replicas are routable
+        again AFTER the heal hook has fired (t_holder[heal_key])."""
+        def watch():
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                t_heal = t_holder.get(heal_key)
+                if t_heal is not None and router.n_routable() >= n:
+                    holder[stamp_key] = time.perf_counter() - t_heal
+                    return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=watch, daemon=True)
+        t.start()
+        return t
+
+    try:
+        # warm: every replica learns its keep-alive path, the router polls
+        for _ in range(3 * replicas):
+            router.submit(image).result(timeout=30)
+
+        rounds = {}
+        shapes = ("blackhole", "reset", "half_open", "flap")
+        for r_i, shape in enumerate(shapes):
+            victim = proxies[r_i % replicas]
+            s0 = reg.snapshot()
+            stamps: dict = {}
+            rnd_extra: dict = {}
+            t_round0 = time.perf_counter()
+            this_window = flap_window_s if shape == "flap" else window_s
+
+            def heal(victim=victim, stamps=stamps, t_round0=t_round0):
+                stamps["heal_at"] = time.perf_counter() - t_round0
+                stamps["_t_heal"] = time.perf_counter()
+                victim.clear()
+
+            def inject(shape=shape, victim=victim, stamps=stamps,
+                       t_round0=t_round0, s0=s0, heal=heal, this_window=this_window):
+                stamps["fault_at"] = time.perf_counter() - t_round0
+                stamps["_t_fault"] = time.perf_counter()
+                if shape == "flap":
+                    victim.set_fault(None, flap_period_s=flap_period_s,
+                                     flap_down_s=flap_down_s)
+                else:
+                    victim.set_fault(shape)
+                # detection stamps come from a counter watcher, never from a
+                # submit loop that itself blocks on the faulted leg; the
+                # heal rides a TIMER sized to the detection budget so it
+                # can never race the ejection it is there to measure
+                stamps["_watch"] = watch_counter(
+                    "fleet.ejections", s0.get("fleet.ejections", 0),
+                    stamps, "detection_s", stamps["_t_fault"])
+                t = threading.Timer(this_window, heal)
+                t.daemon = True
+                t.start()
+                stamps["_heal_timer"] = t
+
+            recovery_watch = watch_routable(replicas, rnd_extra, "recovery_s",
+                                            stamps, "_t_heal")
+            rnd = _partition_round(
+                router, image, n_requests=requests, target_qps=target_qps,
+                seed=seed + 11 * (r_i + 1),
+                hooks=[(max(1, int(lead_s * target_qps)), inject)],
+            )
+            w = stamps.pop("_watch", None)
+            if w is not None:
+                w.join(timeout=30)
+            timer = stamps.pop("_heal_timer", None)
+            if timer is not None:
+                timer.join(timeout=2 * this_window + 5)
+            recovery_watch.join(timeout=60)
+            # converge back BEFORE reading the delta: each round's books
+            # then include its own readmission instead of bleeding it into
+            # the next round's baseline
+            deadline = time.monotonic() + 30
+            while router.n_routable() < replicas and time.monotonic() < deadline:
+                time.sleep(0.05)
+            rnd.update(_fleet_registry_delta(reg, s0, _PARTITION_ROUND_KEYS))
+            rnd["fault_at_s"] = round(stamps.get("fault_at", 0.0), 3)
+            rnd["heal_at_s"] = round(stamps.get("heal_at", 0.0), 3)
+            rnd["detection_s"] = (round(stamps["detection_s"], 3)
+                                  if "detection_s" in stamps else None)
+            rnd["recovery_s"] = (round(rnd_extra["recovery_s"], 3)
+                                 if "recovery_s" in rnd_extra else None)
+            rnd["routable_after"] = router.n_routable()
+            rounds[shape] = rnd
+        out["rounds"] = rounds
+
+        # -- membership: a leased replica joins, vanishes, expires out ------
+        b_d, fe_d = echo_replica("leased")
+        proxy_d = NetChaosProxy("127.0.0.1", fe_d.port, seed=seed + 99).start()
+        s0 = reg.snapshot()
+        mem: dict = {}
+        router.register(*proxy_d.addr, ttl_s=lease_ttl_s, replica_id="leased")
+        renewing = threading.Event()
+        renewing.set()
+
+        def renew_loop():
+            while renewing.is_set():
+                try:
+                    router.register(*proxy_d.addr, ttl_s=lease_ttl_s)
+                except Exception:  # noqa: BLE001 — bench heartbeat best-effort
+                    pass
+                time.sleep(lease_ttl_s / 3.0)
+
+        renew_thread = threading.Thread(target=renew_loop, daemon=True)
+        renew_thread.start()
+        deadline = time.monotonic() + 30
+        while router.n_routable() < replicas + 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        mem["joined"] = router.n_routable() == replicas + 1
+        stamps_m: dict = {}
+
+        def vanish():
+            # silently gone: the heartbeat stops AND the link blackholes —
+            # nothing will ever refuse a connection or send a FIN. Only the
+            # lease can remove this backend.
+            stamps_m["_t_vanish"] = time.perf_counter()
+            renewing.clear()
+            proxy_d.set_fault("blackhole")
+            stamps_m["_watch"] = watch_counter(
+                "fleet.lease_expirations", s0.get("fleet.lease_expirations", 0),
+                stamps_m, "removed_s", stamps_m["_t_vanish"])
+
+        rnd = _partition_round(
+            router, image, n_requests=requests, target_qps=target_qps,
+            seed=seed + 77, hooks=[(requests // 3, vanish)],
+        )
+        w = stamps_m.pop("_watch", None)
+        if w is not None:
+            w.join(timeout=30)
+        renew_thread.join(timeout=5)
+        rnd.update(_fleet_registry_delta(
+            reg, s0, ("fleet.registrations", "fleet.lease_renewals",
+                      "fleet.lease_expirations", "fleet.route_retries")))
+        rnd["joined"] = mem["joined"]
+        rnd["removed_s"] = (round(stamps_m["removed_s"], 3)
+                            if "removed_s" in stamps_m else None)
+        # removal bound: the TTL plus one jittered poll sweep plus slack
+        rnd["removal_bound_s"] = round(lease_ttl_s + 1.2 * poll_interval_s + 2.0, 3)
+        rnd["total_after"] = len(router.replicas_state())
+        out["membership"] = rnd
+        out["cpu_rehearsal_note"] = _PARTITION_CPU_CAVEAT
+        return out
+    finally:
+        router.stop()
+        for p in proxies:
+            p.stop()
+        try:
+            proxy_d.stop()
+            fe_d.stop()
+            b_d.stop()
+        except NameError:
+            pass
+        for b, fe in stacks:
+            fe.stop()
+            b.stop()
+
+
 _CHAOS_CLASS_MIX = {"interactive": 0.5, "batch": 0.3, "best_effort": 0.2}
 
 
@@ -1714,6 +2075,28 @@ def main(argv=None) -> int:
     ap.add_argument("--overload-straggler-ms", type=float, default=300.0,
                     help="injected completion latency on the gray straggler")
     ap.add_argument("--overload-seed", type=int, default=0)
+    ap.add_argument("--partition", action="store_true",
+                    help="run the PARTITION measurement instead of the single-"
+                         "process suites: in-process echo replicas behind "
+                         "netchaos proxies, seeded blackhole/reset/half_open/"
+                         "flap rounds measuring detection, client-visible "
+                         "error rate (must be zero), and recovery, plus the "
+                         "TTL-lease membership round (the r09 shape). No jax.")
+    ap.add_argument("--partition-replicas", type=int, default=3)
+    ap.add_argument("--partition-requests", type=int, default=120,
+                    help="open-loop requests per partition round")
+    ap.add_argument("--partition-qps", type=float, default=30.0,
+                    help="open-loop arrival rate per partition round")
+    ap.add_argument("--partition-poll-s", type=float, default=0.1,
+                    help="router health-poll interval for the partition rounds")
+    ap.add_argument("--partition-connect-timeout-s", type=float, default=0.4,
+                    help="client TCP-handshake budget (also bounds poll reads)")
+    ap.add_argument("--partition-read-timeout-s", type=float, default=2.0,
+                    help="client read budget (leg timeout) — detection must "
+                         "beat this, proving ejection rides the poll budget")
+    ap.add_argument("--partition-lease-ttl-s", type=float, default=1.5,
+                    help="lease TTL for the membership round")
+    ap.add_argument("--partition-seed", type=int, default=0)
     ap.add_argument("--chaos-requests", type=int, default=80,
                     help="open-loop Poisson requests per chaos round (healthy + faulty)")
     ap.add_argument("--chaos-qps", type=float, default=0.0,
@@ -1727,6 +2110,51 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     buckets = tuple(int(b) for b in args.buckets.split(","))
     image_sizes = tuple(int(s) for s in args.image_sizes.split(","))
+
+    if args.partition:
+        # standalone like --fleet/--overload, but jax-free end to end: the
+        # replicas are echo frontends, because the measurement is the
+        # TRANSPORT (detection/containment/recovery), not a model
+        out = {
+            "metric": "partition_blackhole_detect_seconds",
+            "value": None,
+            "unit": "seconds",
+            "vs_baseline": None,
+            "vs_baseline_note": ("the implicit baseline is the read timeout: without "
+                                 "the connect/read split and poll-budget ejection a "
+                                 "blackholed replica pins legs for read_timeout_s"),
+            "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        try:
+            m = measure_partition(
+                replicas=max(2, args.partition_replicas),
+                requests=max(30, args.partition_requests),
+                target_qps=max(5.0, args.partition_qps),
+                seed=args.partition_seed,
+                poll_interval_s=args.partition_poll_s,
+                eject_failures=2,
+                connect_timeout_s=args.partition_connect_timeout_s,
+                read_timeout_s=args.partition_read_timeout_s,
+                eject_cooldown_s=0.3,
+                lease_ttl_s=args.partition_lease_ttl_s,
+                flap_period_s=1.0,
+                flap_down_s=0.5,
+            )
+            from bench import provenance
+
+            # no backend is ever touched: a loopback rehearsal by
+            # construction (the real multi-host run is the ROADMAP rung)
+            out.update({"platform": "cpu", "provenance": provenance(cpu_rehearsal=True),
+                        "partition": m})
+            out["value"] = m["rounds"]["blackhole"]["detection_s"]
+        except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0
+            out["error"] = f"{type(e).__name__}: {e}"
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
 
     if args.overload:
         # standalone like --fleet: the storm arms own their batcher stacks

@@ -1,0 +1,44 @@
+"""Bad: sockets opened with no timeout — every one of these blocks forever
+against a blackholed or half-open peer."""
+
+import http.client
+import socket
+from http.client import HTTPConnection
+
+
+def dial(host, port):
+    # no timeout argument: connect hangs on a SYN blackhole
+    return socket.create_connection((host, port))
+
+
+def fetch(host, port):
+    # stdlib default timeout is None = block forever
+    conn = http.client.HTTPConnection(host, port)
+    conn.request("GET", "/healthz")
+    return conn.getresponse().read()
+
+
+def fetch_aliased(host, port):
+    conn = HTTPConnection(host, port)
+    conn.request("GET", "/")
+    return conn.getresponse().read()
+
+
+def listen_forever(port):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", port))
+    s.listen(8)
+    return s.accept()  # never bounded: a wedged accept thread
+
+
+class Server:
+    def open(self, port):
+        # self-attr socket never given a timeout in this scope
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", port))
+
+
+def with_block(port):
+    with socket.socket() as s:
+        s.connect(("127.0.0.1", port))
+        return s.recv(1024)

@@ -1,0 +1,61 @@
+"""Clean: every socket carries an explicit bound — a positional/keyword
+timeout, a settimeout() in the same scope, or non-blocking mode. An
+explicit timeout=None is a deliberate operator choice, not a silent
+default, and stays clean."""
+
+import http.client
+import socket
+from http.client import HTTPConnection
+
+
+def dial(host, port):
+    return socket.create_connection((host, port), 5.0)
+
+
+def dial_kw(host, port):
+    return socket.create_connection((host, port), timeout=2.5)
+
+
+def dial_forever_on_purpose(host, port):
+    # loud: the operator said forever
+    return socket.create_connection((host, port), timeout=None)
+
+
+def fetch(host, port):
+    conn = http.client.HTTPConnection(host, port, timeout=10.0)
+    conn.request("GET", "/healthz")
+    return conn.getresponse().read()
+
+
+def fetch_aliased(host, port):
+    conn = HTTPConnection(host, port, timeout=10.0)
+    conn.request("GET", "/")
+    return conn.getresponse().read()
+
+
+def listen_bounded(port):
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.settimeout(0.5)
+    s.bind(("127.0.0.1", port))
+    s.listen(8)
+    return s.accept()
+
+
+class Server:
+    def open(self, port):
+        self.sock = socket.socket()
+        self.sock.settimeout(1.0)
+        self.sock.bind(("127.0.0.1", port))
+
+
+def with_block(port):
+    with socket.socket() as s:
+        s.settimeout(2.0)
+        s.connect(("127.0.0.1", port))
+        return s.recv(1024)
+
+
+def nonblocking(port):
+    s = socket.socket()
+    s.setblocking(False)
+    return s

@@ -171,6 +171,61 @@ def _assert_overload(ov, *, rehearsal=False):
     assert "cpu_rehearsal" in ov["cpu_rehearsal_note"]  # the caveat is recorded
 
 
+def _assert_partition(pt, *, rehearsal=False):
+    """The --partition contract (shared by the tiny fast run and the
+    checked-in r09 rehearsal artifact): four socket-level fault rounds
+    (blackhole / reset / half-open / flap) each with ZERO client-visible
+    failures and zero unresolved futures (transport retry absorbs every
+    partition shape), detection of the hard faults within the POLL-budget
+    bound — eject_failures x (poll interval + connect-bounded poll read) +
+    slack — and provably under the read timeout (the 60 s class of hang
+    this PR removes), every ejection readmitted after the heal (no
+    permanent capacity loss from a transient fault, no flap ping-pong),
+    and the TTL-lease membership round removing a silently-vanished leased
+    backend within TTL + one poll sweep while traffic keeps answering."""
+    cfg = pt["config"]
+    assert cfg["poll_interval_s"] > 0 and cfg["eject_failures"] >= 1
+    assert 0 < cfg["connect_timeout_s"] < cfg["read_timeout_s"]
+    assert pt["detect_bound_s"] > 0
+    assert set(pt["rounds"]) == {"blackhole", "reset", "half_open", "flap"}
+    for name, r in pt["rounds"].items():
+        assert r["unresolved"] == 0, f"{name}: a client hung"
+        assert r["failed"] == 0, f"{name}: client-visible failures under partition"
+        assert r["submitted"] == r["completed"] + r["rejected"], (name, r)
+        assert r["qps"] > 0 and r["p99_ms"] >= r["p50_ms"] > 0, (name, r)
+        # no permanent capacity loss from a transient fault: every ejection
+        # the round caused was readmitted by round end
+        assert r["routable_after"] == pt["replicas"], (name, r)
+        assert r["ejections"] == r["readmissions"], (name, r)
+    for shape in ("blackhole", "reset", "half_open"):
+        r = pt["rounds"][shape]
+        assert r["detection_s"] is not None and 0 < r["detection_s"] <= pt["detect_bound_s"], (
+            shape, r["detection_s"], pt["detect_bound_s"])
+        assert r["partition_ejections"] >= 1, f"{shape}: never attributed as a partition"
+        assert r["recovery_s"] is not None and r["recovery_s"] < 30, (shape, r)
+    # the headline claim: a blackholed replica ejects on the POLL budget,
+    # not the read timeout (pre-split, detection == the read budget burn)
+    assert pt["rounds"]["blackhole"]["detection_s"] < cfg["read_timeout_s"]
+    # read-timeout-shaped legs (half-open) really re-routed instead of
+    # 504ing: in-flight legs stall across the whole fault window, so at
+    # least one retry is structural. (Reset legs can legitimately see zero
+    # retries when poll-side detection ejects the victim before any pick
+    # lands on it — its zero-failure book is the claim there.)
+    assert pt["rounds"]["half_open"]["route_retries"] >= 1
+    # flap must not permanently evict: bounded churn, full convergence
+    # (routable_after + ejections == readmissions pinned above)
+    m = pt["membership"]
+    assert m["joined"], "the leased replica never joined via /register"
+    assert m["unresolved"] == 0 and m["failed"] == 0, m
+    assert m["registrations"] >= 1 and m["lease_renewals"] >= 1
+    assert m["lease_expirations"] == 1, "the vanished lease never expired"
+    assert m["removed_s"] is not None and 0 < m["removed_s"] <= m["removal_bound_s"], m
+    assert m["total_after"] == pt["replicas"]
+    if rehearsal:
+        assert pt["replicas"] >= 3 and pt["requests_per_round"] >= 100
+    assert "cpu_rehearsal" in pt["cpu_rehearsal_note"]  # the caveat is recorded
+
+
 def _assert_quant_ab(q):
     """The --quant contract (shared by the tiny fast run and the checked-in
     r07 rehearsal artifact): the three precision modes present with their
@@ -455,6 +510,60 @@ def test_serve_bench_overload_emits_parsed_artifact(tmp_path):
     _assert_overload(out["overload"])
     assert out["value"] == out["overload"]["storm"]["interactive_availability_on"] > 0
     assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_partition_emits_parsed_artifact(tmp_path):
+    """scripts/serve_bench.py --partition: seeded socket-level partition
+    rounds (netchaos proxies between an in-process router and echo
+    replicas — jax-free by design, the measurement is the TRANSPORT) plus
+    the TTL-lease membership round — one JSON line in the bench artifact
+    shape, the r09 contract."""
+    out_path = tmp_path / "BENCH_SERVE_partition_test.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serve_bench.py"),
+         "--partition", "--partition-replicas", "2", "--partition-requests", "40",
+         "--partition-qps", "20", "--out", str(out_path)],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line, got {lines}"
+    out = json.loads(lines[0])
+    assert out["metric"] == "partition_blackhole_detect_seconds"
+    assert "error" not in out, out.get("error")
+    assert out["unit"] == "seconds" and out["vs_baseline"] is None
+    # jax-free: provenance via importlib.metadata, cpu_rehearsal pinned by
+    # the caller (no backend was ever touched)
+    prov = out["provenance"]
+    assert prov["jax_version"] and prov["cpu_rehearsal"] is True
+    assert "platform" not in prov and out["platform"] == "cpu"
+    _assert_partition(out["partition"])
+    assert out["value"] == out["partition"]["rounds"]["blackhole"]["detection_s"] > 0
+    assert json.loads(out_path.read_text()) == out
+
+
+def test_serve_bench_r09_partition_rehearsal_artifact():
+    """The r09 cpu_rehearsal artifact pins the partition-containment
+    acceptance (ISSUE 15): under a seeded blackhole through the netchaos
+    proxy the router ejects the partitioned replica within the poll-budget
+    bound (NOT the read timeout), with zero client-visible failures in
+    every fault round (transport retry onto healthy replicas), full
+    readmission after every heal, and lease expiry removing a silently-
+    vanished backend within TTL + one poll sweep. Absolute rates are the
+    deferred real-multi-host measurement; the caveat is recorded in the
+    artifact — r02..r08 discipline."""
+    with open(os.path.join(REPO, "BENCH_SERVE_r09_cpu_rehearsal.json")) as f:
+        out = json.load(f)
+    assert out["platform"] == "cpu" and "error" not in out
+    assert out["value"] is not None and out["value"] > 0
+    prov = out["provenance"]
+    assert prov["cpu_rehearsal"] is True and prov["jax_version"]
+    _assert_partition(out["partition"], rehearsal=True)
+    # the rehearsal artifact additionally pins the margin: blackhole
+    # detection at least 2x under the read timeout the split removes from
+    # the failure path
+    pt = out["partition"]
+    assert pt["rounds"]["blackhole"]["detection_s"] <= 0.5 * pt["config"]["read_timeout_s"]
 
 
 def test_serve_bench_r08_overload_rehearsal_artifact():

@@ -508,6 +508,320 @@ def test_poll_schedule_jitter_on_fake_clock():
 
 
 # ---------------------------------------------------------------------------
+# TTL-leased membership (fake clients, fake clock — deterministic)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_register_renew_expire_lifecycle():
+    """A self-registered backend joins with a TTL, renews by heartbeat, and
+    is REMOVED (not just ejected) when the lease lapses — the silently-
+    vanished-host path no crash signal can cover across machines."""
+    get_registry().reset()
+    router, fakes = _fake_router(1)
+    try:
+        t0 = time.monotonic()
+        doc = router.register("127.0.0.1", 9100, ttl_s=10.0, replica_id="remote-1")
+        assert doc["ok"] and doc["new"] and doc["source"] == "lease"
+        assert _snap("fleet.registrations") == 1
+        assert len(router.replicas_state()) == 2
+        assert router.state()["membership"] == {"static": 1, "leased": 1,
+                                                "lease_ttl_s": 5.0}
+        # renewal pushes the lease out; counted separately from admission
+        doc = router.register("127.0.0.1", 9100, ttl_s=10.0)
+        assert doc["ok"] and not doc["new"]
+        assert _snap("fleet.registrations") == 1
+        assert _snap("fleet.lease_renewals") == 1
+        # a mid-lease sweep keeps it; one past the TTL removes it
+        router.poll_once(now=t0 + 5.0)
+        assert len(router.replicas_state()) == 2
+        router.poll_once(now=t0 + 30.0)
+        assert len(router.replicas_state()) == 1
+        assert _snap("fleet.lease_expirations") == 1
+        # the expired member's client was closed, not leaked
+        assert fakes["127.0.0.1:9100"].closed
+    finally:
+        router.stop()
+
+
+def test_lease_deregister_and_static_precedence():
+    get_registry().reset()
+    router, fakes = _fake_router(1)
+    try:
+        router.register("127.0.0.1", 9200, ttl_s=60.0)
+        # deregister = the clean-drain fast path (no TTL wait)
+        assert router.deregister("127.0.0.1", 9200)["ok"]
+        assert len(router.replicas_state()) == 1
+        assert _snap("fleet.deregistrations") == 1
+        # static members are supervisor-owned: deregister refuses
+        out = router.deregister("127.0.0.1", 9000)
+        assert not out["ok"] and out["reason"] == "static"
+        assert router.deregister("127.0.0.1", 9999)["reason"] == "unknown"
+        # a supervisor membership push must NOT evict a live leased member
+        router.register("127.0.0.1", 9300, ttl_s=60.0)
+        router.set_backends([("127.0.0.1", 9000)])
+        keys = {r["key"]: r["source"] for r in router.replicas_state()}
+        assert keys == {"127.0.0.1:9000": "static", "127.0.0.1:9300": "lease"}
+        # ...and adopting a leased address promotes it to static (no lease)
+        router.set_backends([("127.0.0.1", 9000), ("127.0.0.1", 9300)])
+        keys = {r["key"]: r["source"] for r in router.replicas_state()}
+        assert keys["127.0.0.1:9300"] == "static"
+        router.poll_once(now=time.monotonic() + 3600.0)  # no lease to expire
+        assert len(router.replicas_state()) == 2
+        with pytest.raises(ValueError, match="ttl_s"):
+            router.register("127.0.0.1", 9400, ttl_s=-1.0)
+    finally:
+        router.stop()
+
+
+def test_ejection_probation_damps_flap_ping_pong():
+    """A flapping link (fail, recover, fail, ...) must produce ONE bounded
+    eject/readmit cycle per eject_cooldown_s, not one per flap: a healthy
+    poll inside the probation may NOT readmit."""
+    get_registry().reset()
+    router, fakes = _fake_router(2, eject_failures=2, eject_cooldown_s=10.0)
+    try:
+        flappy = fakes["127.0.0.1:9000"]
+        healthy = (200, {"breaker_state": 0, "queued_total": 0, "draining": False,
+                         "replica": {"replica_id": flappy.key, "start_unix": 1.0}})
+        t0 = time.monotonic()
+        flappy.health = ClientConnectError("link down")
+        router.poll_once(now=t0)
+        # the due-filter spaces polls by the jittered interval: step past it
+        router.poll_once(now=t0 + 0.4)  # 2nd strike: ejected, probation starts
+        assert router.n_routable() == 1
+        assert _snap("fleet.ejections") == 1
+        assert _snap("fleet.partition_ejections") == 1
+        # the link flaps UP: healthy polls INSIDE the probation do not readmit
+        flappy.health = healthy
+        for dt in (1.0, 3.0, 9.0):
+            router.poll_once(now=t0 + dt)
+            assert router.n_routable() == 1, f"readmitted {dt}s into a 10s probation"
+        assert _snap("fleet.readmissions") == 0
+        # past the probation, the next healthy poll readmits — once
+        router.poll_once(now=t0 + 10.5)
+        assert router.n_routable() == 2
+        assert _snap("fleet.readmissions") == 1
+        assert _snap("fleet.ejections") == 1  # the flap cost ONE cycle
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# the connect/read timeout split (client-side unit tests)
+# ---------------------------------------------------------------------------
+
+
+def test_client_connect_timeout_is_typed_counted_and_conclusive(monkeypatch):
+    """A handshake that hangs past connect_timeout_s surfaces as a
+    ClientConnectError (retry-another-replica, the request never left) —
+    not a 60 s read-timeout burn — and counts serve.client.connect_timeouts."""
+    import socket as socket_mod
+
+    from yet_another_mobilenet_series_tpu.serve import client as client_mod
+
+    get_registry().reset()
+    seen = []
+
+    def hang(addr, timeout=None, *a, **kw):
+        seen.append(timeout)
+        raise socket_mod.timeout("timed out")
+
+    monkeypatch.setattr(client_mod.socket, "create_connection", hang)
+    c = ReplicaClient("10.255.0.1", 9, timeout_s=60.0, connect_timeout_s=0.25)
+    t0 = time.monotonic()
+    with pytest.raises(ClientConnectError, match="connect"):
+        c.predict(np.zeros((4, 4, 3), np.float32))
+    elapsed = time.monotonic() - t0
+    # conclusive: no second fresh-connect attempt, no read-budget burn
+    assert seen == [0.25], seen
+    assert elapsed < 5.0
+    assert _snap("serve.client.connect_timeouts") == 1
+    c.close()
+
+
+def test_client_conn_table_prunes_on_reconnect():
+    """The per-thread connection table must stay bounded against a flapping
+    replica: every reconnect REPLACES this thread's entry instead of
+    appending (the long-lived-router leak)."""
+    dead = ReplicaClient("127.0.0.1", 1, timeout_s=0.5, connect_timeout_s=0.5)
+    for _ in range(6):
+        with pytest.raises(ClientConnectError):
+            dead.predict(np.zeros((2, 2, 3), np.float32))
+        assert len(dead._conns) <= 1, "reconnects must not grow the conn table"
+    dead.close()
+    assert len(dead._conns) == 0
+
+
+# ---------------------------------------------------------------------------
+# router partition suite: real sockets through the netchaos proxy
+# ---------------------------------------------------------------------------
+
+
+def _echo_replica(tag):
+    """A real Frontend over a trivial echo engine: the replica stand-in for
+    socket-level partition drills (no jax, milliseconds to start)."""
+    from yet_another_mobilenet_series_tpu.serve.admission import AdmissionController
+    from yet_another_mobilenet_series_tpu.serve.frontend import Frontend
+    from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+
+    class _EchoEngine:
+        def predict_async(self, images):
+            class _H:
+                def result(_self):
+                    return images[:, 0, 0, :1].astype(np.float32)
+
+            return _H()
+
+        def predict(self, images):
+            return self.predict_async(images).result()
+
+    b = PipelinedBatcher(_EchoEngine(), max_batch=8, max_wait_ms=1.0,
+                         queue_depth=64, drain_timeout_s=2.0).start()
+    fe = Frontend(AdmissionController(b), port=0, replica_id=tag).start()
+    return b, fe
+
+
+def _partition_fixture(n=2, **router_kw):
+    """n echo replicas, each behind its own netchaos proxy, one router over
+    the PROXY addresses — the bench's partition topology, in-process."""
+    from yet_another_mobilenet_series_tpu.serve.netchaos import NetChaosProxy
+
+    stacks = [_echo_replica(f"pr-{i}") for i in range(n)]
+    proxies = [NetChaosProxy("127.0.0.1", fe.port, seed=i).start()
+               for i, (_, fe) in enumerate(stacks)]
+    kw = dict(poll_interval_s=0.1, eject_failures=2, route_attempts=3,
+              client_timeout_s=3.0, connect_timeout_s=0.4,
+              eject_cooldown_s=0.3, seed=0)
+    kw.update(router_kw)
+    router = Router([p.addr for p in proxies], **kw).start()
+
+    def teardown():
+        router.stop()
+        for p in proxies:
+            p.stop()
+        for b, fe in stacks:
+            fe.stop()
+            b.stop()
+
+    return router, proxies, teardown
+
+
+def _watch_counter(key, baseline, t0, holder, timeout_s=20.0):
+    """Background watcher stamping the instant a counter moves past its
+    baseline (detection time must not be measured from a submit loop that
+    itself blocks on the faulted leg)."""
+    def watch():
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if _snap(key) > baseline:
+                holder["t"] = time.monotonic() - t0
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    return t
+
+
+def test_router_blackhole_ejects_within_poll_budget_not_read_timeout():
+    """The acceptance bound: a blackholed replica (connect succeeds, nothing
+    answers) ejects within ~eject_failures x (poll interval + connect
+    budget) — poll reads are bounded by the connect budget — with ZERO
+    client-visible failures (transport retry), never the read timeout."""
+    get_registry().reset()
+    router, proxies, teardown = _partition_fixture(2)
+    try:
+        img = np.full((4, 4, 3), 5.0, np.float32)
+        assert router.submit(img).result(timeout=10) is not None
+        eject0 = _snap("fleet.ejections")
+        detected = {}
+        t0 = time.monotonic()
+        proxies[0].set_fault("blackhole")
+        watcher = _watch_counter("fleet.ejections", eject0, t0, detected)
+        errors = []
+        for _ in range(12):
+            try:
+                router.submit(img).result(timeout=20)
+            except Exception as e:  # noqa: BLE001 — the contract is ZERO of these
+                errors.append(e)
+            time.sleep(0.05)
+        watcher.join(timeout=20)
+        assert errors == [], f"client-visible failures under blackhole: {errors}"
+        assert "t" in detected, "the blackholed replica was never ejected"
+        # poll reads are bounded by the connect budget: detection is a few
+        # poll cycles, not the 3 s read timeout and never a 60 s default.
+        # Bound: eject_failures x (interval + poll read bound) + slack for
+        # a loaded 1-core box
+        poll_read = max(0.4, 2 * 0.1)
+        bound = 2 * (0.1 + poll_read) + 1.5
+        assert detected["t"] < bound, (detected, bound)
+        assert _snap("fleet.partition_ejections") >= 1
+        # heal -> probation -> readmission
+        proxies[0].clear()
+        deadline = time.monotonic() + 15
+        while router.n_routable() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.n_routable() == 2, "the healed replica never readmitted"
+    finally:
+        teardown()
+
+
+def test_router_reset_and_half_open_retry_onto_healthy_replica():
+    """RST legs (connect-shaped) and half-open legs (read-timeout-shaped)
+    both re-route: the client sees success, the faulted replica scores
+    toward a partition ejection."""
+    get_registry().reset()
+    router, proxies, teardown = _partition_fixture(2, client_timeout_s=0.8)
+    try:
+        img = np.full((4, 4, 3), 5.0, np.float32)
+        assert router.submit(img).result(timeout=10) is not None
+        for fault in ("reset", "half_open"):
+            retries0 = _snap("fleet.route_retries")
+            proxies[0].set_fault(fault)
+            outs = [router.submit(img).result(timeout=20) for _ in range(6)]
+            assert all(o is not None for o in outs), fault
+            assert _snap("fleet.route_retries") > retries0, (
+                f"{fault}: no leg was ever re-routed")
+            proxies[0].clear()
+            deadline = time.monotonic() + 15
+            while router.n_routable() < 2 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert router.n_routable() == 2, f"{fault}: never readmitted after heal"
+        assert _snap("fleet.partition_ejections") >= 1
+    finally:
+        teardown()
+
+
+def test_router_survives_flapping_link_with_zero_client_errors():
+    """A flapping link (timed down windows) through the proxy: every request
+    still answers (retry absorbs the down windows), and after the flapping
+    stops the fleet converges back to fully routable. The deterministic
+    anti-ping-pong mechanics are pinned by
+    test_ejection_probation_damps_flap_ping_pong."""
+    get_registry().reset()
+    router, proxies, teardown = _partition_fixture(2, eject_cooldown_s=0.8)
+    try:
+        img = np.full((4, 4, 3), 5.0, np.float32)
+        assert router.submit(img).result(timeout=10) is not None
+        proxies[0].set_fault(None, flap_period_s=0.8, flap_down_s=0.4)
+        errors = []
+        for _ in range(20):
+            try:
+                router.submit(img).result(timeout=20)
+            except Exception as e:  # noqa: BLE001 — the contract is ZERO of these
+                errors.append(e)
+            time.sleep(0.05)
+        assert errors == [], f"client-visible failures under flap: {errors}"
+        proxies[0].clear()
+        deadline = time.monotonic() + 15
+        while router.n_routable() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.n_routable() == 2, "never converged after the flapping stopped"
+    finally:
+        teardown()
+
+
+# ---------------------------------------------------------------------------
 # autoscaler decisions (fakes; no threads)
 # ---------------------------------------------------------------------------
 
@@ -868,6 +1182,190 @@ def _get(url, timeout=30):
             return r.status, json.loads(r.read())
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read())
+
+
+def _free_port():
+    import socket as socket_mod
+
+    s = socket_mod.socket()
+    s.settimeout(1.0)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_fleet_attach_e2e_lease_join_expiry_and_u8_wire(tmp_path):
+    """The multi-host rung, rehearsed on loopback (ISSUE 15 acceptance):
+    `cli/fleet.py --attach` runs the router tier over EXTERNALLY-started
+    replica subprocesses (no local spawn), one replica joins LATE purely
+    via the /register TTL lease, one is SIGKILLed mid-traffic and removed
+    by lease expiry (nobody supervises it — only the lease notices), and
+    the uint8 wire rides router->replica end-to-end with the exact 4x-
+    fewer per-request serve.h2d_bytes visible on the replicas' /varz."""
+    import jax
+
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.serve.export import export_bundle
+
+    net = get_model(
+        ModelConfig(arch="mobilenet_v2", num_classes=4, dropout=0.0,
+                    block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2}]),
+        image_size=24,
+    )
+    params, state = net.init(jax.random.PRNGKey(0))
+    bundle_dir = str(tmp_path / "bundle")
+    export_bundle(net, params, state, bundle_dir)
+
+    router_port = _free_port()
+    common = [f"serve.bundle={bundle_dir}", "serve.buckets=[1,4]",
+              "data.image_size=24", "serve.quant.wire=uint8",
+              "serve.listen.enable=true", "serve.listen.port=0",
+              "serve.drain_timeout_s=10"]
+
+    def spawn_replica(tag, extra=()):
+        log_dir = str(tmp_path / tag)
+        return subprocess.Popen(
+            [sys.executable, "-m", "yet_another_mobilenet_series_tpu.cli.serve",
+             *common, f"serve.listen.replica_id={tag}",
+             f"train.log_dir={log_dir}", *extra],
+            env=dict(os.environ, PYTHONPATH=REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO,
+        ), log_dir
+
+    # externally-managed replicas: ra/rb join via --attach, rc joins LATE
+    # purely through the lease (its heartbeat retries until the router is
+    # up — spawned now so the jax imports overlap)
+    procs = {}
+    log_dirs = {}
+    procs["ra"], log_dirs["ra"] = spawn_replica("ra")
+    procs["rb"], log_dirs["rb"] = spawn_replica("rb")
+    procs["rc"], log_dirs["rc"] = spawn_replica(
+        "rc", [f"serve.listen.register_to=127.0.0.1:{router_port}",
+               "serve.listen.register_ttl_s=2.0"])
+    fleet_proc = None
+    try:
+        addrs = {}
+        deadline = time.time() + 180
+        for tag in ("ra", "rb", "rc"):
+            path = os.path.join(log_dirs[tag], "listen_addr.json")
+            while not os.path.exists(path):
+                assert procs[tag].poll() is None, (
+                    f"replica {tag} died early:\n{procs[tag].stdout.read()[-3000:]}")
+                assert time.time() < deadline, f"replica {tag} never bound"
+                time.sleep(0.2)
+            addrs[tag] = json.loads(open(path).read())
+
+        attach = ",".join(f"127.0.0.1:{addrs[t]['port']}" for t in ("ra", "rb"))
+        router_log = str(tmp_path / "router")
+        fleet_proc = subprocess.Popen(
+            [sys.executable, "-m", "yet_another_mobilenet_series_tpu.cli.fleet",
+             "--attach", attach,
+             f"serve.listen.port={router_port}",
+             "serve.fleet.poll_interval_s=0.1", "serve.fleet.connect_timeout_s=1.0",
+             "serve.fleet.eject_cooldown_s=0.5", "serve.fleet.lease_ttl_s=2.0",
+             "serve.fleet.hedge.enable=false", f"train.log_dir={router_log}"],
+            env=dict(os.environ, PYTHONPATH=REPO),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO,
+        )
+        addr_path = os.path.join(router_log, "listen_addr.json")
+        deadline = time.time() + 60  # attach mode never imports jax: fast
+        while not os.path.exists(addr_path):
+            assert fleet_proc.poll() is None, (
+                f"fleet died early:\n{fleet_proc.stdout.read()[-3000:]}")
+            assert time.time() < deadline, "router never bound"
+            time.sleep(0.1)
+        addr = json.loads(open(addr_path).read())
+        assert addr["role"] == "router" and addr["replicas"] == 2
+        assert addr["attach"] == attach.split(",")
+        base = f"http://{addr['host']}:{addr['port']}"
+
+        # rc self-registers via the lease: the fleet grows to 3 with the
+        # router having spawned NOTHING
+        deadline = time.time() + 60
+        health = {}
+        while time.time() < deadline:
+            status, health = _get(base + "/healthz")
+            if status == 200 and health["fleet"]["routable"] == 3:
+                break
+            time.sleep(0.2)
+        assert health["fleet"]["routable"] == 3, health
+        assert health["membership"] == {"static": 2, "leased": 1, "lease_ttl_s": 2.0}
+        idents = {r["identity"].get("replica_id") for r in health["fleet"]["replicas"]}
+        assert idents == {"ra", "rb", "rc"}
+
+        # uint8 wire through the fleet: raw u8 pixels, X-Dtype: u8
+        img = np.full((24, 24, 3), 128, np.uint8)
+
+        def post():
+            req = urllib.request.Request(
+                base + "/predict", data=img.tobytes(),
+                headers={"Content-Type": "application/octet-stream",
+                         "X-Shape": "24,24,3", "X-Dtype": "u8"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                e.read()
+                return e.code
+
+        def replica_h2d(tag):
+            _, varz = _get(f"http://127.0.0.1:{addrs[tag]['port']}/varz")
+            assert varz["build_info"]["quant_mode"].startswith("wire=uint8")
+            return varz["metrics"].get("serve.h2d_bytes", 0)
+
+        h2d_before = {t: replica_h2d(t) for t in ("ra", "rb", "rc")}
+        n_posts = 6
+        for _ in range(n_posts):
+            assert post() == 200
+        # sequential single-image requests pad to bucket 1: EXACTLY
+        # S*S*3 u8 bytes per request crossed H2D — the f32 wire would have
+        # moved 4x that. Measured on the replicas the router routed to.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            h2d_delta = sum(replica_h2d(t) - h2d_before[t] for t in ("ra", "rb", "rc"))
+            if h2d_delta >= n_posts * 24 * 24 * 3:
+                break
+            time.sleep(0.2)
+        assert h2d_delta == n_posts * 24 * 24 * 3, (
+            f"u8 wire h2d: {h2d_delta} != {n_posts} * {24 * 24 * 3}")
+
+        # SIGKILL the leased replica mid-traffic: no supervisor owns it, so
+        # only the LEASE can remove it — traffic keeps answering 200
+        # through ejection + retry while the TTL runs out
+        os.kill(addrs["rc"]["pid"], signal.SIGKILL)
+        statuses = [post() for _ in range(20)]
+        assert all(s == 200 for s in statuses), f"client-visible failures: {statuses}"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            status, health = _get(base + "/healthz")
+            if health["fleet"]["total"] == 2:
+                break
+            time.sleep(0.2)
+        assert health["fleet"]["total"] == 2, health
+        status, varz = _get(base + "/varz")
+        assert varz["metrics"]["fleet.lease_expirations"] >= 1
+        assert varz["metrics"]["fleet.registrations"] >= 1
+        assert varz["metrics"].get("fleet.spawns", 0) == 0  # attach spawns nothing
+        assert post() == 200
+
+        # clean drain: the router exits 0; the external replicas are OURS
+        # to stop (that is what externally-managed means)
+        fleet_proc.send_signal(signal.SIGTERM)
+        rc_code = fleet_proc.wait(timeout=60)
+        assert rc_code == 0
+        assert "fleet drained" in fleet_proc.stdout.read()
+        for tag in ("ra", "rb"):
+            procs[tag].send_signal(signal.SIGTERM)
+        for tag in ("ra", "rb"):
+            assert procs[tag].wait(timeout=60) == 0
+    finally:
+        for p in [fleet_proc, *procs.values()]:
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
 
 
 def test_fleet_e2e_kill_minus_9_zero_5xx_and_drain(tmp_path):

@@ -206,6 +206,116 @@ def test_predict_raw_tensor_body():
         b.stop()
 
 
+def test_predict_u8_wire_via_x_dtype_header():
+    """X-Dtype: u8 carries RAW uint8 pixels end-to-end — the quantized
+    wire's 4x byte drop crossing the HTTP edge intact (a u8 body is a
+    quarter the bytes of the same image as f4) — and the typed client
+    sends it automatically for uint8 arrays. Unknown codes are a 400."""
+    from yet_another_mobilenet_series_tpu.serve.client import ReplicaClient
+
+    b, ac, fe = _stack()
+    try:
+        img_u8 = np.full((4, 4, 3), 200, np.uint8)
+        body = img_u8.tobytes()
+        assert len(body) == 4 * 4 * 3  # a quarter of the f4 wire's 192
+        status, doc, _ = _request(
+            fe.url + "/predict", data=body,
+            headers={"Content-Type": "application/octet-stream",
+                     "X-Shape": "4,4,3", "X-Dtype": "u8"},
+        )
+        assert status == 200 and doc["logits"] == [200.0]
+        # the shared client picks the code from the array dtype
+        client = ReplicaClient("127.0.0.1", fe.port, timeout_s=10.0)
+        assert client.predict(img_u8).tolist() == [200.0]
+        client.close()
+        # absent header = the f4 contract (pre-header clients keep working)
+        f4 = np.full((4, 4, 3), 7.0, np.float32)
+        status, doc, _ = _request(
+            fe.url + "/predict", data=f4.tobytes(),
+            headers={"Content-Type": "application/octet-stream", "X-Shape": "4,4,3"},
+        )
+        assert status == 200 and doc["logits"] == [7.0]
+        # unknown dtype codes and a u8-sized body declared f4 are 400s
+        status, doc, _ = _request(
+            fe.url + "/predict", data=body,
+            headers={"Content-Type": "application/octet-stream",
+                     "X-Shape": "4,4,3", "X-Dtype": "f2"},
+        )
+        assert status == 400 and "X-Dtype" in doc["message"]
+        status, doc, _ = _request(
+            fe.url + "/predict", data=body,
+            headers={"Content-Type": "application/octet-stream", "X-Shape": "4,4,3"},
+        )
+        assert status == 400 and doc["error"] == "bad_request"
+    finally:
+        fe.stop()
+        b.stop()
+
+
+def test_membership_endpoints_register_deregister():
+    """POST /register|/deregister serve the TTL-lease protocol when the
+    admission object speaks it (the fleet Router); a plain replica answers
+    404 so a misconfigured heartbeat is loud."""
+    from yet_another_mobilenet_series_tpu.serve.client import ClientHTTPError, ReplicaClient
+
+    # a plain replica: 404
+    b, ac, fe = _stack()
+    try:
+        client = ReplicaClient("127.0.0.1", fe.port, timeout_s=10.0)
+        with pytest.raises(ClientHTTPError) as ei:
+            client.register("127.0.0.1", 9999, ttl_s=5.0)
+        assert ei.value.status == 404
+        client.close()
+    finally:
+        fe.stop()
+        b.stop()
+
+    # a router-shaped admission: the lease round-trips over the wire
+    class _FakeRouterAdmission:
+        def __init__(self):
+            self.calls = []
+
+        def submit(self, image, **kw):
+            raise AssertionError("not exercised here")
+
+        def state(self):
+            return {"breaker_state": 0, "queued_total": 0}
+
+        def register(self, host, port, *, ttl_s=None, replica_id=""):
+            if ttl_s is not None and ttl_s <= 0:
+                raise ValueError("lease ttl_s must be > 0")
+            self.calls.append(("register", host, port, ttl_s, replica_id))
+            return {"ok": True, "key": f"{host}:{port}", "ttl_s": ttl_s or 5.0,
+                    "new": True, "source": "lease", "replica_id": replica_id}
+
+        def deregister(self, host, port):
+            self.calls.append(("deregister", host, port))
+            return {"ok": True, "key": f"{host}:{port}"}
+
+    fake = _FakeRouterAdmission()
+    fe2 = Frontend(fake, port=0, replica_id="router").start()
+    try:
+        client = ReplicaClient("127.0.0.1", fe2.port, timeout_s=10.0)
+        doc = client.register("127.0.0.1", 9001, ttl_s=2.5, replica_id="r-x")
+        assert doc["ok"] and doc["ttl_s"] == 2.5
+        doc = client.deregister("127.0.0.1", 9001)
+        assert doc["ok"]
+        assert fake.calls == [("register", "127.0.0.1", 9001, 2.5, "r-x"),
+                              ("deregister", "127.0.0.1", 9001)]
+        # malformed bodies and rejected leases map to 400
+        status, doc, _ = _request(fe2.url + "/register", data=b"not json",
+                                  headers={"Content-Type": "application/json"})
+        assert status == 400 and doc["error"] == "bad_request"
+        status, doc, _ = _request(
+            fe2.url + "/register",
+            data=json.dumps({"host": "127.0.0.1", "port": 9001, "ttl_s": -1}).encode(),
+            headers={"Content-Type": "application/json"})
+        assert status == 400 and "ttl_s" in doc["message"]
+        client.close()
+    finally:
+        fe2.stop()
+
+
 def test_malformed_requests_get_400_and_404():
     b, ac, fe = _stack()
     try:

@@ -252,6 +252,7 @@ def load_rules() -> list[Rule]:
         rules_prng_flow,
         rules_profiler,
         rules_recompile,
+        rules_sockets,
         rules_spmd,
         rules_subprocess,
         rules_swallow,

@@ -54,6 +54,7 @@ from ..serve.autoscale import Autoscaler
 from ..serve.brownout import BrownoutController
 from ..serve.frontend import Frontend, write_listen_addr
 from ..serve.hedge import ROUTER_LATENCY, Hedger
+from ..serve.netchaos import NetChaosTier
 from ..serve.router import Router
 from ..serve.signals import SignalReader
 from ..utils.logging import Logger, emit
@@ -535,7 +536,7 @@ class FleetSupervisor:
 class FleetChaos:
     """Seeded chaos schedule against the live fleet (serve.fleet.chaos).
 
-    Two modes:
+    Three modes:
 
     - ``kill`` — the PR-12 crash drill: SIGKILL/SIGTERM a seeded live
       replica after ``kill_after_s`` (repeating every ``kill_period_s``);
@@ -549,15 +550,33 @@ class FleetChaos:
       act on. Counted ``fleet.chaos_degrades``; pulses are bounded and the
       stop path always delivers the releasing SIGCONT so a cancelled drill
       cannot leave a replica frozen.
+    - ``partition`` — the NETWORK drill (PR 15): the seeded victim's
+      netchaos proxy (serve/netchaos.py, requires the
+      ``serve.fleet.netchaos`` tier) is switched to the configured fault
+      shape — blackhole, reset, half-open, response loss — for
+      ``degrade_duration_s``, then healed. The replica process never
+      notices; only the LINK misbehaves, which is exactly the failure the
+      connect/read timeout split and lease expiry exist to contain.
+      Counted ``fleet.chaos_partitions``; the stop path always heals the
+      link so a cancelled drill cannot leave a permanent partition.
     """
 
-    def __init__(self, fleet: FleetSupervisor, *, seed: int = 0, kill_after_s: float = 2.0,
+    def __init__(self, fleet: FleetSupervisor | None, *, seed: int = 0,
+                 kill_after_s: float = 2.0,
                  kill_period_s: float = 0.0, sig: int = signal.SIGKILL,
                  mode: str = "kill", degrade_stop_ms: float = 150.0,
-                 degrade_period_ms: float = 500.0, degrade_duration_s: float = 10.0):
-        if mode not in ("kill", "degrade"):
-            raise ValueError(f"chaos mode must be kill|degrade, got {mode!r}")
+                 degrade_period_ms: float = 500.0, degrade_duration_s: float = 10.0,
+                 netchaos_tier: NetChaosTier | None = None,
+                 partition_fault: str = "blackhole"):
+        if mode not in ("kill", "degrade", "partition"):
+            raise ValueError(f"chaos mode must be kill|degrade|partition, got {mode!r}")
+        if mode == "partition" and netchaos_tier is None:
+            raise ValueError("partition chaos needs the serve.fleet.netchaos proxy tier")
+        if mode in ("kill", "degrade") and fleet is None:
+            raise ValueError(f"{mode} chaos needs a local supervisor (not --attach)")
         self._fleet = fleet
+        self._tier = netchaos_tier
+        self._partition_fault = partition_fault
         self._rng = random.Random(seed)
         self._kill_after_s = kill_after_s
         self._kill_period_s = kill_period_s
@@ -580,6 +599,9 @@ class FleetChaos:
                 return
             if self._mode == "degrade":
                 self._degrade_once()
+                return
+            if self._mode == "partition":
+                self._partition_once()
                 return
             self._fleet.kill_replica(rng=self._rng, sig=self._sig)
             while self._kill_period_s > 0 and not self._stop.wait(self._kill_period_s):
@@ -608,6 +630,22 @@ class FleetChaos:
                 self._stop.wait(self._degrade_period_s - self._degrade_stop_s)
         finally:
             self._fleet.signal_replica(slot, signal.SIGCONT)
+
+    def _partition_once(self) -> None:
+        proxy = self._tier.pick(rng=self._rng)
+        if proxy is None:
+            return
+        obs_registry.get_registry().counter("fleet.chaos_partitions").inc()
+        emit(f"[fleet] CHAOS: partitioning link to {proxy.upstream_host}:"
+             f"{proxy.upstream_port} ({self._partition_fault} for "
+             f"{self._degrade_duration_s:.0f}s)")
+        proxy.set_fault(self._partition_fault)
+        try:
+            self._stop.wait(self._degrade_duration_s)
+        finally:
+            # the stop path always heals: a cancelled drill must not leave
+            # a permanent partition behind
+            proxy.set_fault(None)
 
     def stop(self) -> None:
         self._stop.set()
@@ -654,6 +692,9 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         eject_failures=fc.eject_failures,
         route_attempts=fc.route_attempts,
         client_timeout_s=fc.client_timeout_s,
+        connect_timeout_s=fc.connect_timeout_s or None,
+        eject_cooldown_s=fc.eject_cooldown_s,
+        lease_ttl_s=fc.lease_ttl_s,
         hedger=hedger,
         poll_jitter=fc.poll_jitter,
         slow_eject=fc.slow_eject.enable,
@@ -663,21 +704,48 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         slow_min_ms=fc.slow_eject.min_ms,
         lat_alpha=fc.slow_eject.lat_alpha,
     ).start()
-    fleet = FleetSupervisor(
-        replica_argv=replica_argv,
-        log_dir=cfg.train.log_dir,
-        replicas=fc.replicas,
-        restart_backoff_ms=fc.restart_backoff_ms,
-        restart_backoff_max_s=fc.restart_backoff_max_s,
-        spawn_timeout_s=fc.spawn_timeout_s,
-        drain_timeout_s=cfg.serve.drain_timeout_s + 10.0,
-        on_change=router.set_backends,
-        logger=log,
+    # netchaos proxy tier (serve/netchaos.py): the router only ever speaks
+    # to supervised replicas THROUGH their per-link fault proxies, so the
+    # partition chaos mode (and the serve_bench partition rounds) can
+    # blackhole/reset/flap one link without touching any process
+    tier = None
+    if fc.netchaos.enable:
+        nc = fc.netchaos
+        tier = NetChaosTier(
+            seed=nc.seed, fault_rate=nc.fault_rate, latency_ms=nc.latency_ms,
+            jitter_ms=nc.jitter_ms, bandwidth_kbps=nc.bandwidth_kbps,
+            flap_period_s=nc.flap_period_s, flap_down_s=nc.flap_down_s,
+        )
+    route_backends = (
+        (lambda addrs: router.set_backends(tier.route(addrs)))
+        if tier is not None else router.set_backends
     )
+    # --attach (serve.fleet.attach): the router tier over EXTERNALLY-managed
+    # replicas — no local spawn, no supervisor. This IS the multi-host
+    # deployment shape, rehearsed on loopback: replicas live wherever they
+    # live (other hosts, other supervisors), the attach list seeds the
+    # static backend set, and late arrivals join via the /register lease.
+    attach = [a.strip() for a in fc.attach.split(",") if a.strip()]
+    fleet = None
+    if attach:
+        route_backends([tuple(a.rsplit(":", 1)) for a in attach])
+    else:
+        fleet = FleetSupervisor(
+            replica_argv=replica_argv,
+            log_dir=cfg.train.log_dir,
+            replicas=fc.replicas,
+            restart_backoff_ms=fc.restart_backoff_ms,
+            restart_backoff_max_s=fc.restart_backoff_max_s,
+            spawn_timeout_s=fc.spawn_timeout_s,
+            drain_timeout_s=cfg.serve.drain_timeout_s + 10.0,
+            on_change=route_backends,
+            logger=log,
+        )
     result: dict = {}
     frontend = autoscaler = chaos = brownout = None
     try:
-        fleet.start()
+        if fleet is not None:
+            fleet.start()
         frontend = Frontend(
             router,
             host=cfg.serve.listen.host,
@@ -685,14 +753,18 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
             request_timeout_s=cfg.serve.listen.request_timeout_s,
             replica_id=cfg.serve.listen.replica_id or "router",
         ).start()
+        n_replicas = fleet.n_replicas if fleet is not None else len(attach)
         addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid(),
                 "replica_id": frontend.replica_id, "role": "router",
-                "replicas": fleet.n_replicas}
+                "replicas": n_replicas, "attach": attach}
         if cfg.train.log_dir:
             write_listen_addr(cfg.train.log_dir, addr)
-        log.log(f"fleet of {fleet.n_replicas} behind {frontend.url} "
-                f"(hedge={'on' if hedger else 'off'})")
-        if fc.autoscale.enable:
+        log.log(f"fleet of {n_replicas} {'attached' if attach else 'spawned'} "
+                f"replicas behind {frontend.url} (hedge={'on' if hedger else 'off'}, "
+                f"lease ttl {fc.lease_ttl_s:.0f}s)")
+        if fc.autoscale.enable and fleet is None:
+            log.log("autoscaler disabled: --attach mode has no supervisor to scale")
+        if fc.autoscale.enable and fleet is not None:
             a = fc.autoscale
             autoscaler = Autoscaler(
                 fleet, router,
@@ -728,12 +800,17 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
                 degrade_stop_ms=fc.chaos.degrade_stop_ms,
                 degrade_period_ms=fc.chaos.degrade_period_ms,
                 degrade_duration_s=fc.chaos.degrade_duration_s,
+                netchaos_tier=tier,
+                partition_fault=fc.netchaos.fault,
             ).start()
             log.log(f"CHAOS: replica {fc.chaos.mode} on (seed={fc.chaos.seed}, "
                     f"after={fc.chaos.kill_after_s}s, period={fc.chaos.kill_period_s}s)")
         while not stop_event.wait(0.2):
             if rolling_event.is_set():
                 rolling_event.clear()
+                if fleet is None:
+                    log.log("SIGHUP ignored: --attach replicas are externally managed")
+                    continue
                 log.log("SIGHUP: rolling restart")
                 n = fleet.rolling_restart()
                 log.log(f"rolling restart complete: {n} replicas recycled")
@@ -751,7 +828,10 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         if frontend is not None:
             frontend.stop()
         router.stop()
-        fleet.stop()
+        if tier is not None:
+            tier.stop()
+        if fleet is not None:
+            fleet.stop()
         result["drain_s"] = round(time.perf_counter() - t0, 3)
         log.log(f"fleet drained in {result['drain_s']:.2f}s")
         if cfg.train.log_dir:
@@ -768,12 +848,32 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # replicas re-parse the SAME operator argv (app: + overrides) plus their
     # per-slot overrides, so fleet config and replica config cannot drift;
-    # --listen sugar is meaningless here (the fleet always listens)
+    # --listen sugar is meaningless here (the fleet always listens).
+    # `--attach host:port,...` is sugar for serve.fleet.attach=... — the
+    # router tier over externally-started replicas, no local spawn.
     argv = [a for a in argv if a != "--listen"]
-    cfg = parse_cli(argv)
-    if not (cfg.serve.bundle or cfg.serve.export_from):
-        raise ValueError("fleet: needs serve.bundle (replicas load it at spawn)")
-    return run(cfg, argv)
+    cleaned: list[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--attach":
+            if i + 1 >= len(argv):
+                raise ValueError("--attach needs a host:port[,host:port...] value")
+            cleaned.append(f"serve.fleet.attach={argv[i + 1]}")
+            i += 2
+            continue
+        if a.startswith("--attach="):
+            cleaned.append(f"serve.fleet.attach={a.split('=', 1)[1]}")
+            i += 1
+            continue
+        cleaned.append(a)
+        i += 1
+    cfg = parse_cli(cleaned)
+    if not cfg.serve.fleet.attach and not (cfg.serve.bundle or cfg.serve.export_from):
+        # attach mode spawns nothing: the remote replicas own their bundles
+        raise ValueError("fleet: needs serve.bundle (replicas load it at spawn) "
+                         "or --attach host:port,...")
+    return run(cfg, cleaned)
 
 
 if __name__ == "__main__":

@@ -290,10 +290,49 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     if cfg.train.log_dir:
         write_listen_addr(cfg.train.log_dir, addr)
     log.log(f"listening on {frontend.url} (POST /predict, GET /healthz|/metrics|/varz)")
+    # TTL-lease self-registration (serve.listen.register_to): the replica
+    # heartbeats its OWN address into a fleet router that never spawned it
+    # — the multi-host membership path. The lease outliving the heartbeat
+    # is the router's signal this process (or the route to it) vanished.
+    reg_client = None
+    if cfg.serve.listen.register_to:
+        from ..serve.client import ReplicaClient
+        r_host, r_port = cfg.serve.listen.register_to.rsplit(":", 1)
+        ttl_s = cfg.serve.listen.register_ttl_s
+        reg_client = ReplicaClient(r_host, int(r_port), timeout_s=5.0,
+                                   connect_timeout_s=2.0)
+
+        def _heartbeat():
+            try:  # YAMT011: a dead heartbeat thread = silent lease expiry
+                period = max(ttl_s / 3.0, 0.1)
+                while not stop_event.is_set():
+                    try:
+                        reg_client.register(addr["host"], addr["port"], ttl_s=ttl_s,
+                                            replica_id=frontend.replica_id)
+                        reg.counter("serve.register_heartbeats").inc()
+                    except Exception:  # noqa: BLE001 — the router may be down;
+                        # keep beating: the next renewal re-admits us
+                        reg.counter("serve.register_failures").inc()
+                    stop_event.wait(period)
+            except Exception as e:  # noqa: BLE001 — contain, count, report
+                reg.counter("serve.thread_crashes").inc()
+                log.log(f"[serve] register heartbeat crashed: {type(e).__name__}: {e}")
+
+        threading.Thread(target=_heartbeat, name="serve-register", daemon=True).start()
+        log.log(f"registering with {cfg.serve.listen.register_to} "
+                f"(ttl={ttl_s:.1f}s, heartbeat every {max(ttl_s / 3.0, 0.1):.1f}s)")
     try:
         stop_event.wait()
     finally:
         t0 = time.perf_counter()
+        if reg_client is not None:
+            try:
+                # clean drain: leave the fleet NOW instead of via TTL lapse
+                reg_client.deregister(addr["host"], addr["port"])
+            except Exception:  # noqa: BLE001 — the router may already be gone;
+                # the lease lapses on its own, so count it and move on
+                reg.counter("serve.deregister_failures").inc()
+            reg_client.close()
         frontend.stop()
         if brownout is not None:
             brownout.stop()

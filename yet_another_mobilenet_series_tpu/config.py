@@ -428,6 +428,15 @@ class ListenConfig:
     # and detect a restarted process behind the same address; "" = pid-<pid>.
     # A fleet supervisor (cli/fleet.py) assigns r<i> per slot.
     replica_id: str = ""
+    # router address ("host:port") this replica REGISTERS itself with: a
+    # heartbeat thread POSTs /register every register_ttl_s/3 so the lease
+    # never lapses while the process lives, and /deregister on drain. ""
+    # = no self-registration (supervisor-spawned replicas are pushed into
+    # the router by membership notifications instead). This is how a
+    # replica on ANOTHER HOST joins a fleet that never spawned it.
+    register_to: str = ""
+    # TTL requested per /register heartbeat; expiry removes the backend
+    register_ttl_s: float = 3.0
 
 
 @dataclass(frozen=True)
@@ -556,7 +565,11 @@ class FleetChaosConfig:
     # "kill" = crash chaos (the signal below); "degrade" = gray-failure
     # chaos: the seeded victim is SIGSTOP/SIGCONT-pulsed so it stays alive
     # but slow (a GC-pause/noisy-neighbor stand-in) — the router must
-    # soft-eject it on measured latency, never on a crash signal
+    # soft-eject it on measured latency, never on a crash signal;
+    # "partition" = NETWORK chaos: the seeded victim's netchaos proxy
+    # (serve.fleet.netchaos must be enabled) is switched to the configured
+    # fault shape for degrade_duration_s, then healed — the process never
+    # even notices, only the link misbehaves
     mode: str = "kill"
     # first kill/degradation this long after the fleet is up
     kill_after_s: float = 2.0
@@ -572,10 +585,49 @@ class FleetChaosConfig:
     degrade_duration_s: float = 10.0
 
     def __post_init__(self):
-        if self.mode not in ("kill", "degrade"):
-            raise ValueError(f"fleet.chaos.mode must be kill|degrade, got {self.mode!r}")
+        if self.mode not in ("kill", "degrade", "partition"):
+            raise ValueError(
+                f"fleet.chaos.mode must be kill|degrade|partition, got {self.mode!r}")
         if not 0.0 < self.degrade_stop_ms < self.degrade_period_ms:
             raise ValueError("fleet.chaos needs 0 < degrade_stop_ms < degrade_period_ms")
+
+
+@dataclass(frozen=True)
+class NetChaosConfig:
+    """Socket-level network chaos (serve/netchaos.py): a seeded TCP fault-
+    injection proxy interposed between the router and EACH replica, so
+    every partition shape — blackhole, reset, half-open, latency/jitter,
+    throttle, asymmetric response loss, timed flaps — is reproducible on
+    one box without root/iptables. ``enable`` inserts the proxy tier
+    (pass-through until a fault is armed); FleetChaos ``mode="partition"``
+    flips the configured ``fault`` on a seeded victim on its schedule."""
+
+    enable: bool = False
+    seed: int = 0
+    # the shape mode="partition" injects on the victim link
+    fault: str = "blackhole"  # blackhole | reset | half_open | drop_response
+    # fraction of connections the fault applies to (seeded per-connection
+    # draw); 1.0 = a link-level fault that spares nothing
+    fault_rate: float = 1.0
+    # response-path shaping, applied whenever the link is up
+    latency_ms: float = 0.0
+    jitter_ms: float = 0.0
+    bandwidth_kbps: float = 0.0
+    # timed link flaps: down (blackhole) flap_down_s out of every
+    # flap_period_s; 0 = no flapping
+    flap_period_s: float = 0.0
+    flap_down_s: float = 0.0
+
+    def __post_init__(self):
+        if self.fault not in ("blackhole", "reset", "half_open", "drop_response"):
+            raise ValueError(
+                "fleet.netchaos.fault must be blackhole|reset|half_open|drop_response, "
+                f"got {self.fault!r}")
+        if not 0.0 <= self.fault_rate <= 1.0:
+            raise ValueError(
+                f"fleet.netchaos.fault_rate must be in [0, 1], got {self.fault_rate}")
+        if self.flap_period_s > 0 and not 0.0 < self.flap_down_s < self.flap_period_s:
+            raise ValueError("fleet.netchaos needs 0 < flap_down_s < flap_period_s")
 
 
 @dataclass(frozen=True)
@@ -625,8 +677,28 @@ class FleetConfig:
     # replicas one request may try before failing typed (transport-level
     # failures and replica-side 503s re-route; per-request verdicts do not)
     route_attempts: int = 3
-    # per-dispatch client timeout (router -> replica)
+    # per-dispatch client timeout (router -> replica): the READ bound
     client_timeout_s: float = 60.0
+    # TCP-handshake bound, split from the read bound: a PARTITIONED host
+    # drops SYNs instead of refusing, and with one shared timeout every
+    # probe into a blackhole burns the full read budget. Also bounds the
+    # health poll's read (healthz answers in microseconds), so a
+    # blackholed replica ejects in ~eject_failures x (poll_interval +
+    # connect_timeout), not 60 s. 0 = legacy single-timeout behavior.
+    connect_timeout_s: float = 1.0
+    # post-ejection probation: a healthy poll may not readmit an ejected
+    # replica before this — a flapping link produces one bounded
+    # eject/readmit cycle per cooldown instead of ping-ponging every flap
+    eject_cooldown_s: float = 1.0
+    # default TTL granted to /register heartbeats that name none; lease
+    # expiry REMOVES the backend (fleet.lease_expirations)
+    lease_ttl_s: float = 5.0
+    # comma list of externally-managed replica addresses ("host:port,...")
+    # to run the router tier over WITHOUT spawning anything locally (the
+    # cli/fleet.py --attach sugar sets this) — the multi-host deployment
+    # story: replicas live wherever they live, the router attaches to them,
+    # and late arrivals join via the /register lease path
+    attach: str = ""
     # restart-on-exit backoff: base doubles per consecutive crash of the
     # same slot, capped — a crash-looping replica must not spin the host
     restart_backoff_ms: float = 200.0
@@ -643,6 +715,9 @@ class FleetConfig:
     chaos: FleetChaosConfig = field(default_factory=FleetChaosConfig)
     # gray-failure (latency-based) soft ejection of slow-but-alive replicas
     slow_eject: SlowEjectConfig = field(default_factory=SlowEjectConfig)
+    # socket-level network chaos: the TCP fault proxy tier between router
+    # and replicas (serve/netchaos.py; chaos mode="partition" drives it)
+    netchaos: NetChaosConfig = field(default_factory=NetChaosConfig)
 
 
 @dataclass(frozen=True)
@@ -919,6 +994,7 @@ _SECTION_TYPES = {
     "HedgeConfig": HedgeConfig,
     "AutoscaleConfig": AutoscaleConfig,
     "FleetChaosConfig": FleetChaosConfig,
+    "NetChaosConfig": NetChaosConfig,
     "SlowEjectConfig": SlowEjectConfig,
     "FleetConfig": FleetConfig,
     "BrownoutConfig": BrownoutConfig,

@@ -58,6 +58,11 @@ training graph re-run with train=False):
   consume: per-class tail latency off registry bucket-count deltas (the
   p99 of THIS tick's completions, not history), queue depth, breaker
   state.
+- :mod:`.netchaos` — socket-level network chaos: a seeded stdlib-socket TCP
+  fault-injection proxy between router and replica (blackhole, reset,
+  half-open, latency/jitter, throttle, asymmetric response loss, timed
+  flaps), so every PARTITION shape is reproducible on one box without
+  root/iptables — the wire-level twin of :mod:`.faults`.
 - :mod:`.brownout` — the graceful-degradation ladder under sustained
   overload: L0 (healthy) → L5 (interactive-only survival), stepping off
   the measured signals with asymmetric hysteresis — hedging off first,

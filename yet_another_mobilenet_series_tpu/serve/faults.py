@@ -3,7 +3,10 @@
 Every recovery path in the resilience edge — bounded retry, circuit breaker,
 completion-time shedding, drain timeout, watchdog hang reports — is dead
 code until something actually fails, and "unplug the TPU" is not a unit
-test. :class:`FaultyEngine` wraps anything speaking the engine protocol
+test. (The WIRE-level twin of this module is serve/netchaos.py: where
+FaultyEngine injects at the engine edge inside one process, the netchaos
+proxy injects between processes — blackholes, resets, half-open sockets —
+the failure class only a multi-host fleet ever sees.) :class:`FaultyEngine` wraps anything speaking the engine protocol
 (``predict_async(images) -> handle``, ``handle.result()``, ``predict``) and
 injects failures on a SEEDED schedule, so every chaos scenario in
 tests/test_fault_injection.py and the serve_bench chaos A/B is exactly

@@ -11,9 +11,12 @@ Endpoints:
 
 ``POST /predict``
     One image per request. Body is either JSON ``{"image": [[[...]]]}``
-    (H, W, 3 nested lists) or raw little-endian float32 bytes
+    (H, W, 3 nested lists) or raw bytes
     (``Content-Type: application/octet-stream``) with an ``X-Shape: H,W,C``
-    header. Per-request QoS rides in headers — ``X-Priority:
+    header and an optional ``X-Dtype`` header — ``f4`` (little-endian
+    float32, the default: pre-header clients keep working) or ``u8`` (raw
+    uint8 pixels, the quantized wire: 4x fewer bytes per request, riding
+    router->replica across the fleet when ``serve.quant.wire="uint8"``). Per-request QoS rides in headers — ``X-Priority:
     interactive|batch|best_effort`` and ``X-Deadline-Ms: <float>`` — and is
     propagated into the admission controller and batcher verbatim.
     Responses: ``200`` ``{"logits": [...], "priority": cls}``;
@@ -29,6 +32,15 @@ Endpoints:
     quota, EWMA/predicted wait, in-flight window occupancy. Status ``200``
     while the breaker is closed or half-open, ``503`` while open — a load
     balancer can drain a sick replica from rotation without parsing JSON.
+
+``POST /register`` / ``POST /deregister``
+    TTL-leased membership, served only when the admission object speaks it
+    (the fleet Router does; a plain replica answers 404). A replica POSTs
+    ``{"host", "port", "ttl_s", "replica_id"}`` to join the fleet and
+    heartbeats the same body to renew; a lease that expires unrenewed
+    removes the backend (serve/router.py). This is the multi-host
+    registration path: remote replicas join a router that never spawned
+    them.
 
 ``POST /profile/start`` / ``POST /profile/stop``
     HTTP-triggered ``jax.profiler`` capture of LIVE serving traffic
@@ -62,7 +74,7 @@ from ..obs.registry import get_registry
 from ..utils.logging import emit
 from .admission import BreakerOpen, BrownoutShed, DeadlineUnmeetable, BREAKER_OPEN
 from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
-from .client import ClientHTTPError, ClientTimeout
+from .client import WIRE_DTYPES, ClientHTTPError, ClientTimeout
 from .context import RequestContext
 from .router import NoHealthyReplicas
 
@@ -247,9 +259,17 @@ class _Handler(BaseHTTPRequestHandler):
                 shape = tuple(int(s) for s in shape_hdr.split(","))
             except ValueError:
                 raise ValueError(f"X-Shape must be 'H,W,C' integers, got {shape_hdr!r}") from None
-            image = np.frombuffer(body, dtype="<f4")
+            # X-Dtype picks the wire encoding; absent = the historical
+            # little-endian float32 contract. "u8" carries RAW pixels — the
+            # quantized wire's 4x byte drop crossing the fleet intact
+            dtype_code = (self.headers.get("X-Dtype") or "f4").strip().lower()
+            if dtype_code not in WIRE_DTYPES:
+                raise ValueError(
+                    f"X-Dtype must be one of {sorted(WIRE_DTYPES)}, got {dtype_code!r}")
+            image = np.frombuffer(body, dtype=WIRE_DTYPES[dtype_code])
             if len(shape) != 3 or int(np.prod(shape)) != image.size:
-                raise ValueError(f"X-Shape {shape} does not match {image.size} float32 values")
+                raise ValueError(
+                    f"X-Shape {shape} does not match {image.size} {dtype_code} values")
             image = image.reshape(shape)
         else:
             try:
@@ -279,9 +299,44 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(200, {"ok": True, **out})
 
+    def _post_membership(self) -> None:
+        """POST /register|/deregister: the TTL-lease membership endpoints,
+        live only when the admission object speaks them (the fleet Router).
+        A replica heartbeats /register to stay in the fleet; /deregister is
+        the clean-drain fast path."""
+        fe = self.frontend
+        target = getattr(fe.admission, "register", None)
+        if target is None:
+            self._send_error_json(404, "not_found",
+                                  "membership endpoints need a fleet router")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(length)) if length > 0 else {}
+            host, port = doc["host"], int(doc["port"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            self._send_error_json(400, "bad_request",
+                                  f"body must be JSON with host/port: {e}")
+            return
+        try:
+            if self.path == "/register":
+                out = fe.admission.register(
+                    host, port, ttl_s=doc.get("ttl_s"),
+                    replica_id=str(doc.get("replica_id", "")),
+                )
+            else:
+                out = fe.admission.deregister(host, port)
+        except ValueError as e:
+            self._send_error_json(400, "bad_request", str(e))
+            return
+        self._send_json(200, out)
+
     def do_POST(self):  # noqa: N802 — stdlib method name
         if self.path in ("/profile/start", "/profile/stop"):
             self._post_profile()
+            return
+        if self.path in ("/register", "/deregister"):
+            self._post_membership()
             return
         if self.path != "/predict":
             self._send_error_json(404, "not_found", f"no route {self.path}")

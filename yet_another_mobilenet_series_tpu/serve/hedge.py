@@ -42,7 +42,12 @@ would have armed while disabled counts ``serve.hedges_suppressed``
 
 The router (serve/router.py) owns the threading: it arms a
 ``threading.Timer`` per eligible request and cancels it when the primary
-resolves first.
+resolves first. Because the timer is armed at LEG start and fires on its
+own thread, it also covers the partition case: a primary leg wedged on a
+blackholed or half-open socket (serve/netchaos.py) cannot delay the hedge
+— the duplicate goes out at the measured quantile while the stuck leg
+waits out its read timeout, so a partitioned replica costs the fleet a
+timer tick, not a client-visible stall.
 """
 
 from __future__ import annotations

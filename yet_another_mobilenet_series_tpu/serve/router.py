@@ -44,11 +44,33 @@ Routing policy, all driven by what the replicas THEMSELVES report:
 - **poll desynchronization**: each replica's next health poll is scheduled
   with per-replica seeded jitter around ``poll_interval_s``, so N routers
   x M replicas cannot phase-lock into a thundering poll herd.
-- **transport retry**: a dead socket (:class:`~.client.ClientConnectError`)
-  or a replica-side 503 (draining / its own breaker) re-routes the request
-  to the next replica (``fleet.route_retries``), because inference is pure;
-  typed per-request verdicts (429 quota, 504 deadline, 500 engine error)
-  pass through unchanged — the replica already ran ITS retry policy.
+- **transport retry**: a dead socket (:class:`~.client.ClientConnectError`),
+  a transport-level read timeout (:class:`~.client.ClientTimeout` — a
+  half-open socket or a response-eating link; inference is pure, so the
+  duplicate risk is only wasted work), or a replica-side 503 (draining /
+  its own breaker) re-routes the request to the next replica
+  (``fleet.route_retries``); typed per-request verdicts (429 quota, 504
+  deadline, 500 engine error) pass through unchanged — the replica already
+  ran ITS retry policy.
+- **partition awareness**: the client splits its CONNECT timeout from its
+  read timeout (``connect_timeout_s``), and the health poll's read bound
+  derives from the connect budget — a /healthz answers in microseconds, so
+  a poll that cannot finish inside the connect budget is a partition, not
+  a slow reply. A blackholed replica therefore ejects within
+  ``eject_failures`` poll sweeps x (interval + connect timeout), never the
+  60 s read budget. Ejections whose terminal failure was transport-shaped
+  (connect failure / timeout) count ``fleet.partition_ejections``, and an
+  ejected replica serves an ``eject_cooldown_s`` probation before a
+  healthy poll may readmit it — a flapping link produces ONE bounded
+  eject/readmit cycle per cooldown instead of ping-ponging every flap.
+- **TTL-leased membership** (the multi-host rung): besides the
+  statically-configured backend set (:meth:`set_backends` — the local
+  supervisor's view), replicas REGISTER themselves (:meth:`register`, via
+  POST /register on the router's frontend) with a TTL lease renewed by
+  heartbeat (``fleet.registrations`` / ``fleet.lease_renewals``). A lease
+  that expires unrenewed REMOVES the backend (``fleet.lease_expirations``)
+  — a silently-vanished host leaves the fleet without anyone having to
+  notice it, which no crash signal can do across machines.
 - **hedging** (serve/hedge.py): when a :class:`~.hedge.Hedger` is attached
   and >= 2 replicas are routable, a timer fires at the class's p99-derived
   bound and sends a duplicate to a second replica (primary's replica
@@ -74,7 +96,13 @@ from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
 from .admission import CLASSES, BrownoutShed
-from .client import ClientConnectError, ClientError, ClientHTTPError, ReplicaClient
+from .client import (
+    ClientConnectError,
+    ClientError,
+    ClientHTTPError,
+    ClientTimeout,
+    ReplicaClient,
+)
 from .hedge import ROUTER_LATENCY, HedgedCall, Hedger
 
 
@@ -88,9 +116,10 @@ class _Replica:
 
     __slots__ = ("key", "host", "port", "client", "routable", "consecutive_failures",
                  "queue_depth", "breaker_state", "draining", "identity",
-                 "lat_ewma_s", "slow_strikes", "slow_until", "weight_scale", "next_poll_t")
+                 "lat_ewma_s", "slow_strikes", "slow_until", "weight_scale", "next_poll_t",
+                 "source", "lease_until", "eject_until")
 
-    def __init__(self, host: str, port: int, client):
+    def __init__(self, host: str, port: int, client, source: str = "static"):
         self.key = f"{host}:{port}"
         self.host = host
         self.port = port
@@ -111,6 +140,13 @@ class _Replica:
         self.weight_scale = 1.0
         # per-replica jittered poll schedule (monotonic deadline)
         self.next_poll_t = 0.0
+        # membership: "static" (set_backends — the supervisor's view, no
+        # lease) or "lease" (self-registered with a TTL, expires unrenewed)
+        self.source = source
+        self.lease_until: float | None = None
+        # post-ejection probation (monotonic): a healthy poll may not
+        # readmit before this — the flap-ping-pong damper
+        self.eject_until = 0.0
 
     def weight(self) -> float:
         return self.weight_scale / (1.0 + max(self.queue_depth, 0.0))
@@ -118,6 +154,7 @@ class _Replica:
     def as_dict(self) -> dict:
         return {
             "key": self.key,
+            "source": self.source,
             "routable": self.routable,
             "queue_depth": self.queue_depth,
             "breaker_state": self.breaker_state,
@@ -153,6 +190,9 @@ class Router:
         slow_cooldown_s: float = 5.0,
         slow_min_ms: float = 1.0,
         lat_alpha: float = 0.3,
+        connect_timeout_s: float | None = None,
+        eject_cooldown_s: float = 0.0,
+        lease_ttl_s: float = 5.0,
     ):
         if default_class not in CLASSES:
             raise ValueError(f"default_class {default_class!r} not in {CLASSES}")
@@ -177,12 +217,22 @@ class Router:
         self._slow_cooldown_s = float(slow_cooldown_s)
         self._slow_min_s = slow_min_ms / 1e3
         self._lat_alpha = float(lat_alpha)
+        # None = the pre-split single-timeout client (r06 semantics); set,
+        # it bounds the TCP handshake AND the health poll's read budget — a
+        # /healthz that cannot answer inside the connect budget is a
+        # partition, not a slow reply
+        self._connect_timeout_s = connect_timeout_s
+        self._eject_cooldown_s = float(eject_cooldown_s)
+        self._lease_ttl_s = float(lease_ttl_s)
         self._rng = random.Random(seed)
         # the poll scheduler's own stream: pick draws must not perturb the
         # deterministic per-replica jitter (and vice versa)
         self._poll_rng = random.Random(seed + 0x9E37)
         self._client_factory = client_factory or (
-            lambda host, port: ReplicaClient(host, port, timeout_s=client_timeout_s)
+            lambda host, port: ReplicaClient(
+                host, port, timeout_s=client_timeout_s,
+                connect_timeout_s=connect_timeout_s,
+            )
         )
         self._lock = threading.Lock()
         self._replicas: dict[str, _Replica] = {}
@@ -195,20 +245,92 @@ class Router:
     # -- backend set (the supervisor / autoscaler mutate this) ---------------
 
     def set_backends(self, backends) -> None:
-        """Reconcile the replica set against ``backends`` (iterable of
-        ``(host, port)`` or ``"host:port"``). New backends start routable;
-        removed backends have their clients closed."""
+        """Reconcile the STATIC replica set against ``backends`` (iterable
+        of ``(host, port)`` or ``"host:port"``). New backends start
+        routable; removed backends have their clients closed. Leased
+        (self-registered) members are NOT touched — a local supervisor's
+        membership notifications must never evict a remote host that is
+        faithfully renewing its lease."""
         want: dict[str, tuple[str, int]] = {}
         for b in backends:
             host, port = b.rsplit(":", 1) if isinstance(b, str) else b
             want[f"{host}:{int(port)}"] = (host, int(port))
         with self._lock:
-            for key in [k for k in self._replicas if k not in want]:
+            for key in [k for k in self._replicas
+                        if k not in want and self._replicas[k].source == "static"]:
                 rep = self._replicas.pop(key)
                 rep.client.close()
             for key, (host, port) in want.items():
                 if key not in self._replicas:
                     self._replicas[key] = _Replica(host, port, self._client_factory(host, port))
+                elif self._replicas[key].source == "lease":
+                    # the supervisor now owns an address that self-registered
+                    # earlier: promote it — static membership outranks leases
+                    self._replicas[key].source = "static"
+                    self._replicas[key].lease_until = None
+            self._update_routable_gauge_locked()
+
+    # -- TTL-leased membership (the multi-host registration path) ------------
+
+    def register(self, host: str, port: int, *, ttl_s: float | None = None,
+                 replica_id: str = "") -> dict:
+        """Admit (or heartbeat-renew) a self-registered backend with a TTL
+        lease. First sight counts ``fleet.registrations``; renewals count
+        ``fleet.lease_renewals``; a lease that expires unrenewed is swept
+        out of membership by the poll loop (``fleet.lease_expirations``).
+        Registering an address the static set already owns is a harmless
+        renewal no-op (static membership has no lease to expire)."""
+        ttl = float(ttl_s) if ttl_s else self._lease_ttl_s
+        if ttl <= 0:
+            raise ValueError(f"lease ttl_s must be > 0, got {ttl}")
+        key = f"{host}:{int(port)}"
+        now = time.monotonic()
+        with self._lock:
+            rep = self._replicas.get(key)
+            if rep is None:
+                rep = _Replica(host, int(port), self._client_factory(host, int(port)),
+                               source="lease")
+                rep.lease_until = now + ttl
+                self._replicas[key] = rep
+                self._reg.counter("fleet.registrations").inc()
+                self._update_routable_gauge_locked()
+                new = True
+            else:
+                if rep.source == "lease":
+                    rep.lease_until = now + ttl
+                self._reg.counter("fleet.lease_renewals").inc()
+                new = False
+        return {"ok": True, "key": key, "ttl_s": ttl, "new": new,
+                "source": rep.source, "replica_id": replica_id}
+
+    def deregister(self, host: str, port: int) -> dict:
+        """Drop a leased membership immediately (the clean-drain path —
+        faster than waiting out the TTL). Static members are supervisor-
+        owned and stay; unknown keys are a no-op."""
+        key = f"{host}:{int(port)}"
+        with self._lock:
+            rep = self._replicas.get(key)
+            if rep is None or rep.source != "lease":
+                return {"ok": False, "key": key,
+                        "reason": "unknown" if rep is None else "static"}
+            self._replicas.pop(key)
+            rep.client.close()
+            self._update_routable_gauge_locked()
+        self._reg.counter("fleet.deregistrations").inc()
+        return {"ok": True, "key": key}
+
+    def _sweep_leases_locked(self, now: float) -> None:
+        """Remove leased members whose TTL ran out unrenewed: the replica
+        (or its host, or the path to it) is gone — membership must not keep
+        routing weight parked on a ghost."""
+        expired = [k for k, r in self._replicas.items()
+                   if r.source == "lease" and r.lease_until is not None
+                   and now >= r.lease_until]
+        for key in expired:
+            rep = self._replicas.pop(key)
+            rep.client.close()
+            self._reg.counter("fleet.lease_expirations").inc()
+        if expired:
             self._update_routable_gauge_locked()
 
     def _update_routable_gauge_locked(self) -> None:
@@ -267,14 +389,29 @@ class Router:
         force = now is None
         now = time.monotonic() if now is None else now
         with self._lock:
+            self._sweep_leases_locked(now)
             reps = [r for r in self._replicas.values() if force or now >= r.next_poll_t]
-        poll_timeout = max(2.0, 4 * self._poll_interval_s)
+        # the poll's read budget: /healthz answers in microseconds, so a
+        # poll is bounded by the CONNECT budget when one is configured — a
+        # blackholed replica then ejects in ~eject_failures x (interval +
+        # connect timeout), never the 60 s read timeout
+        if self._connect_timeout_s is not None:
+            poll_timeout = max(self._connect_timeout_s, 2 * self._poll_interval_s)
+        else:
+            poll_timeout = max(2.0, 4 * self._poll_interval_s)
         for rep in reps:
             rep.next_poll_t = self._next_poll_t(now)
             try:
                 status, doc = rep.client.healthz(timeout_s=poll_timeout)
-            except ClientError:
-                self._record_failure(rep)
+            except ClientError as e:
+                # a poll that TIMES OUT is partition-shaped (blackhole /
+                # half-open); a refused/reset one is crash-shaped — both
+                # score the same counter, but the ejection they cause is
+                # attributed differently (fleet.partition_ejections)
+                self._record_failure(
+                    rep, kind="timeout" if isinstance(e, ClientTimeout) else "connect",
+                    now=now,
+                )
                 continue
             identity = doc.get("replica") or {}
             with self._lock:
@@ -288,10 +425,12 @@ class Router:
                     self._reg.counter("fleet.replica_restarts").inc()
                 if identity:
                     rep.identity = identity
-                # a slow-ejected replica serves out its probation before a
-                # healthy poll may readmit it (otherwise the very next sweep
-                # would readmit and the ladder would flap)
-                healthy = status == 200 and not rep.draining and now >= rep.slow_until
+                # a slow- or crash-ejected replica serves out its probation
+                # before a healthy poll may readmit it (otherwise the very
+                # next sweep would readmit and a flapping link would
+                # ping-pong eject/readmit every cycle)
+                healthy = (status == 200 and not rep.draining
+                           and now >= rep.slow_until and now >= rep.eject_until)
                 self._set_routable_locked(rep, healthy)
         if reps:
             self._slow_sweep(now)
@@ -342,11 +481,23 @@ class Router:
             self._reg.counter("fleet.ejections").inc()
         self._update_routable_gauge_locked()
 
-    def _record_failure(self, rep: _Replica) -> None:
+    def _record_failure(self, rep: _Replica, kind: str = "connect",
+                        now: float | None = None) -> None:
+        """Score one transport-shaped failure against a replica. ``kind`` is
+        "connect" (refused/reset/dead socket), "timeout" (blackhole /
+        half-open — the partition shapes), or "http" (a 503 with no
+        comeback hint). The ejection it triggers starts the
+        ``eject_cooldown_s`` probation, and transport-shaped kinds count
+        ``fleet.partition_ejections`` so a fleet operator can tell a
+        network event from a crash loop in one counter."""
+        now = time.monotonic() if now is None else now
         with self._lock:
             rep.consecutive_failures += 1
             if rep.consecutive_failures >= self._eject_failures:
+                if rep.routable and kind in ("connect", "timeout"):
+                    self._reg.counter("fleet.partition_ejections").inc()
                 self._set_routable_locked(rep, False)
+                rep.eject_until = now + self._eject_cooldown_s
 
     # -- picking -------------------------------------------------------------
 
@@ -409,7 +560,12 @@ class Router:
             )
         fut: Future = Future()
         call = HedgedCall(fut)
-        image = np.asarray(image, np.float32)
+        # preserve a uint8 wire body (X-Dtype: u8) end-to-end: forcing f32
+        # here would silently 4x the router->replica bytes the quantized
+        # wire exists to save; anything else stays on the f32 contract
+        image = np.asarray(image)
+        if image.dtype != np.uint8:
+            image = np.asarray(image, np.float32)
         # latency is measured from HERE (submit), not from leg start: router
         # queueing is part of what a client experiences, so the histogram
         # the autoscaler and hedge timer read must include it
@@ -491,7 +647,19 @@ class Router:
             except ClientConnectError as e:
                 # the socket is dead — likely a killed replica: score it,
                 # move the request to the next one (inference is pure)
-                self._record_failure(rep)
+                self._record_failure(rep, kind="connect")
+                self._reg.counter("fleet.route_retries").inc()
+                tried.add(rep.key)
+                last_exc = e
+                continue
+            except ClientTimeout as e:
+                # the READ timed out: a half-open socket, a response-eating
+                # link, or a mid-flight blackhole. The request may have run
+                # server-side — inference is pure, so the only duplicate
+                # cost is wasted work — and surfacing a 504 for a fault the
+                # fleet can absorb would break the partition-containment
+                # contract: score the replica, re-route
+                self._record_failure(rep, kind="timeout")
                 self._reg.counter("fleet.route_retries").inc()
                 tried.add(rep.key)
                 last_exc = e
@@ -507,7 +675,7 @@ class Router:
                     else:
                         # unavailability with no comeback hint (draining,
                         # nothing routable behind it): score toward ejection
-                        self._record_failure(rep)
+                        self._record_failure(rep, kind="http")
                     self._reg.counter("fleet.route_retries").inc()
                     tried.add(rep.key)
                     last_exc = e
@@ -553,6 +721,11 @@ class Router:
                 "level": self._brownout_level,
                 "shed_classes": sorted(self._shed_classes),
                 "hedging": self._hedging_enabled,
+            },
+            "membership": {
+                "static": sum(1 for r in reps if r["source"] == "static"),
+                "leased": sum(1 for r in reps if r["source"] == "lease"),
+                "lease_ttl_s": self._lease_ttl_s,
             },
             "fleet": {"total": len(reps), "routable": routable, "replicas": reps},
         }
