@@ -5,34 +5,81 @@
 # The same checks the tier-1 gate runs (tests/test_lint_clean.py), packaged
 # for CI / pre-commit: machine-readable output on stdout, findings count on
 # stderr. Usage:
-#   scripts/lint.sh [--format json|text|github] [extra paths...]
+#   scripts/lint.sh [--format json|text|github] [--changed]
+#                   [--check-suppressions] [extra paths...]
 # --format github emits ::error workflow annotations so a GitHub Actions run
 # marks the offending lines in the PR diff (analysis/reporters.py).
+# --changed lints only .py files differing from the merge-base with
+# ${LINT_BASE:-main} (plus uncommitted and untracked files) — same exit and
+# format semantics, for fast pre-commit runs. Interprocedural rules see only
+# the changed files in this mode; the tier-1 gate still sweeps everything.
+# --check-suppressions audits suppression comments instead of linting:
+# a suppression whose rule no longer fires at its site exits nonzero
+# (YAMT900) so stale ones cannot accumulate.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
 FORMAT=json
-if [ "${1:-}" = "--format" ]; then
-    FORMAT="$2"
-    shift 2
-fi
+CHANGED=0
+MODEFLAGS=()
+EXTRA=()
+while [ $# -gt 0 ]; do
+    case "$1" in
+        --format) FORMAT="$2"; shift 2 ;;
+        --changed) CHANGED=1; shift ;;
+        --check-suppressions) MODEFLAGS+=(--check-suppressions); shift ;;
+        *) EXTRA+=("$1"); shift ;;
+    esac
+done
 
 # the curated scripts/ subset mirrors tests/test_lint_clean.py SCRIPT_RULES:
 # PRNG discipline + version-fragile imports apply to standalone scripts,
 # package-convention rules do not
 SCRIPT_RULES="YAMT002,YAMT006"
 
+PKG_PATHS=(yet_another_mobilenet_series_tpu/)
+SCRIPT_PATHS=(scripts/)
+if [ "$CHANGED" -eq 1 ]; then
+    base=$(git merge-base HEAD "${LINT_BASE:-main}" 2>/dev/null || echo HEAD)
+    mapfile -t files < <(
+        { git diff --name-only "$base" -- '*.py'
+          git ls-files --others --exclude-standard -- '*.py'; } | sort -u
+    )
+    PKG_PATHS=()
+    SCRIPT_PATHS=()
+    for f in "${files[@]}"; do
+        [ -f "$f" ] || continue  # deleted files have nothing to lint
+        case "$f" in
+            yet_another_mobilenet_series_tpu/*) PKG_PATHS+=("$f") ;;
+            scripts/*) SCRIPT_PATHS+=("$f") ;;
+        esac
+    done
+    if [ "${#PKG_PATHS[@]}" -eq 0 ] && [ "${#SCRIPT_PATHS[@]}" -eq 0 ] \
+        && [ "${#EXTRA[@]}" -eq 0 ]; then
+        echo "yamt-lint: no changed .py files" >&2
+        exit 0
+    fi
+fi
+
 # the analyzer is pure AST — it never executes package code, so no
 # accelerator/platform setup is needed
 rc=0
-out=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
-    yet_another_mobilenet_series_tpu/ "$@") || rc=$?
-echo "$out"
+out=""
+if [ "${#PKG_PATHS[@]}" -gt 0 ] || [ "${#EXTRA[@]}" -gt 0 ]; then
+    out=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
+        ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} \
+        ${PKG_PATHS[@]+"${PKG_PATHS[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}) || rc=$?
+    echo "$out"
+fi
 rc2=0
-out2=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
-    --select "$SCRIPT_RULES" scripts/) || rc2=$?
-echo "$out2"
+out2=""
+if [ "${#SCRIPT_PATHS[@]}" -gt 0 ]; then
+    out2=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
+        ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} \
+        --select "$SCRIPT_RULES" ${SCRIPT_PATHS[@]+"${SCRIPT_PATHS[@]}"}) || rc2=$?
+    echo "$out2"
+fi
 if [ "$rc" -ne 0 ] || [ "$rc2" -ne 0 ]; then
     if [ "$FORMAT" = json ]; then
         count=$(printf '%s\n%s\n' "$out" "$out2" \

@@ -1,8 +1,11 @@
 """Call shapes the graph must pin: direct, aliased, instance-method,
-self-attr, factory-result, and an unresolvable dynamic call."""
+self-attr, factory-result, tuple-unpacked, container-indexed, and an
+unresolvable dynamic call."""
 
 from . import core as eng
 from .core import helper as h2
+
+STAGES = {"warm": eng.helper}
 
 
 def direct(x):
@@ -26,6 +29,20 @@ def via_self_attr(x):
 def via_factory(x):
     step = eng.make_step(2)
     return step(x)
+
+
+def via_tuple(x):
+    fwd, make = h2, eng.make_step
+    return fwd(x)
+
+
+def via_container(x):
+    steps = (eng.make_step, h2)
+    return steps[1](x)
+
+
+def via_dict(x):
+    return STAGES["warm"](x)
 
 
 def dynamic(x, name):

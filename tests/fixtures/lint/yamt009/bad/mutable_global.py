@@ -1,0 +1,30 @@
+"""YAMT009 must flag: jitted functions reading module-level MUTABLE globals
+that the module also mutates — the trace bakes the first-call contents in
+and every later mutation is silently ignored."""
+
+import collections
+
+import jax
+
+SCALES = {"base": 1.0}
+HISTORY = collections.deque()
+
+
+@jax.jit
+def apply(x):
+    return x * SCALES["base"]  # trace freezes the dict contents
+
+
+def nested_reader():
+    @jax.jit
+    def inner(x):
+        return x + len(HISTORY)  # scope chain exhausts: HISTORY is the global
+    return inner
+
+
+def retune(v):
+    SCALES["base"] = v  # the mutation apply() never sees
+
+
+def record(x):
+    HISTORY.append(x)

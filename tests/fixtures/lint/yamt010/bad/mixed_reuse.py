@@ -1,0 +1,22 @@
+"""YAMT010 must flag the MIXED pair: one direct draw plus one whole-key
+pass to a consuming callee. YAMT002 sees a single draw (silent) and the
+pure-callee beat sees a single pass — this pair used to slip between the
+two rules (the docs/LINT.md gap carried since PR 4)."""
+
+import jax
+
+
+def init_params(rng):
+    return jax.random.normal(rng, (4,))
+
+
+def build(rng):
+    noise = jax.random.uniform(rng, (2,))  # direct draw consumes the key...
+    params = init_params(rng)  # ...then the same key goes whole to a callee
+    return params, noise
+
+
+def build_flipped(rng):
+    params = init_params(rng)  # callee consumes first...
+    noise = jax.random.uniform(rng, (2,))  # ...then a direct draw repeats it
+    return params, noise

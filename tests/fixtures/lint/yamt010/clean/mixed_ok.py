@@ -1,0 +1,19 @@
+"""Clean: the direct draw and the consuming callee each get their own
+subkey — and a single direct draw with no callee pass is nobody's finding."""
+
+import jax
+
+
+def init_params(rng):
+    return jax.random.normal(rng, (4,))
+
+
+def build(rng):
+    k_noise, k_init = jax.random.split(rng)
+    noise = jax.random.uniform(k_noise, (2,))
+    params = init_params(k_init)
+    return params, noise
+
+
+def single_draw(rng):
+    return jax.random.uniform(rng, (2,))

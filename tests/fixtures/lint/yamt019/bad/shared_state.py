@@ -1,0 +1,25 @@
+"""BAD: ``_seen`` is appended to by the worker thread and read by
+``drain()`` from the caller's thread with no common lock — the cross-thread
+shared-state race YAMT019 exists for."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._seen = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                self._seen.append(1)
+        except Exception:
+            self._crashed = True
+
+    def drain(self):
+        return list(self._seen)

@@ -1,0 +1,28 @@
+"""Clean: both sides of ``_seen`` hold ``self._lock``, and the stop flag is
+a ``threading.Event`` — synchronization objects are sanctioned cross-thread
+state, not races."""
+
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seen = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    self._seen.append(1)
+        except Exception:
+            self._crashed = True
+
+    def drain(self):
+        with self._lock:
+            return list(self._seen)

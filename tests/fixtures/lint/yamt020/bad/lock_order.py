@@ -1,0 +1,25 @@
+"""BAD: ``move_ab`` nests ``_alock`` -> ``_block`` while ``move_ba`` nests
+``_block`` -> ``_alock`` — two callers deadlock holding one lock each,
+waiting for the other (the cycle YAMT020 flags)."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self._a = 0
+        self._b = 0
+
+    def move_ab(self, n):
+        with self._alock:
+            with self._block:
+                self._a -= n
+                self._b += n
+
+    def move_ba(self, n):
+        with self._block:
+            with self._alock:
+                self._b -= n
+                self._a += n

@@ -1,0 +1,24 @@
+"""Clean: every path acquires ``_alock`` before ``_block`` — one global
+acquisition order, no cycle."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self._a = 0
+        self._b = 0
+
+    def move_ab(self, n):
+        with self._alock:
+            with self._block:
+                self._a -= n
+                self._b += n
+
+    def move_ba(self, n):
+        with self._alock:
+            with self._block:
+                self._b -= n
+                self._a += n

@@ -1,0 +1,36 @@
+"""BAD: the cold path builds an executable (``.lower().compile()``) while
+holding ``_dispatch_lock`` — the lock every warm dispatch (from the warm
+loop thread AND direct callers) also takes, so one cold key stalls the whole
+dispatch path. The exact PR 8 serving bug, pinned as a must-flag fixture."""
+
+import threading
+
+import jax
+
+
+class Engine:
+    def __init__(self, fn):
+        self._fn = fn
+        self._dispatch_lock = threading.Lock()
+        self._cache = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._warm_loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        self.predict(0)
+
+    def _warm_loop(self):
+        try:
+            while not self._stop.is_set():
+                self.predict(1)
+        except Exception:
+            self._crashed = True
+
+    def predict(self, key):
+        with self._dispatch_lock:
+            exe = self._cache.get(key)
+            if exe is None:
+                exe = jax.jit(self._fn).lower(key).compile()
+                self._cache[key] = exe
+            return exe(key)

@@ -1,0 +1,37 @@
+"""Clean: the PR 8 fix shape — compile OUTSIDE the dispatch lock, then take
+the lock only to publish (``setdefault`` keeps the first winner when two
+cold callers race the same key)."""
+
+import threading
+
+import jax
+
+
+class Engine:
+    def __init__(self, fn):
+        self._fn = fn
+        self._dispatch_lock = threading.Lock()
+        self._cache = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._warm_loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        self.predict(0)
+
+    def _warm_loop(self):
+        try:
+            while not self._stop.is_set():
+                self.predict(1)
+        except Exception:
+            self._crashed = True
+
+    def predict(self, key):
+        with self._dispatch_lock:
+            exe = self._cache.get(key)
+        if exe is None:
+            exe = jax.jit(self._fn).lower(key).compile()
+            with self._dispatch_lock:
+                exe = self._cache.setdefault(key, exe)
+        with self._dispatch_lock:
+            return exe(key)

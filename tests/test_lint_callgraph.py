@@ -56,6 +56,9 @@ def _call_in(project, src, fn_name):
         ("via_instance", "pkg.core.Trainer.train_step", True),  # local Trainer()
         ("via_self_attr", "pkg.core.helper", False),  # self._fn = helper
         ("via_factory", "pkg.core.make_step.step", False),  # returned local def
+        ("via_tuple", "pkg.core.helper", False),  # fwd, make = h2, eng.make_step
+        ("via_container", "pkg.core.helper", False),  # steps = (...); steps[1](x)
+        ("via_dict", "pkg.core.helper", False),  # constant-keyed dict literal
     ],
 )
 def test_resolves(project, fn_name, expect_qualname, expect_bound):

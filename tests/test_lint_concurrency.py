@@ -1,0 +1,193 @@
+"""Model tests for the concurrency layer (analysis/concurrency.py) plus the
+rule-level regressions that motivated it.
+
+The fixture-pair tests in test_lint_rules.py prove YAMT019/020/021 flag and
+stay silent end to end; this file pins the MODEL facts those rules consume —
+thread-root discovery (method and lambda targets), lock-domain summaries
+(with-statement and acquire/release held-sets), callee absorption through
+the fixpoint, and honest degradation to silence when the thread target is
+opaque — so a resolution regression fails here with a named fact, not as a
+mysteriously silent rule. The PR 8 compile-under-dispatch-lock bug is pinned
+as a must-flag regression."""
+
+import pathlib
+
+from yet_another_mobilenet_series_tpu import analysis
+from yet_another_mobilenet_series_tpu.analysis.core import Project, SourceFile, collect_paths
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
+
+
+def _project(paths):
+    py, yml = collect_paths([str(p) for p in paths])
+    files = []
+    for p in py:
+        with open(p, encoding="utf-8") as f:
+            files.append(SourceFile(p, f.read()))
+    return Project(files, yml)
+
+
+def _summary(model, tail):
+    return next(v for q, v in model.summaries.items() if q.endswith(tail))
+
+
+# -- lock-domain summaries ---------------------------------------------------
+
+
+def test_with_lock_heldsets(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._v = 0\n"
+        "\n"
+        "    def set(self, v):\n"
+        "        with self._lock:\n"
+        "            self._v = v\n"
+        "\n"
+        "    def peek(self):\n"
+        "        return self._v\n"
+    )
+    model = _project([tmp_path]).concurrency
+    tok = next(t for t in model.lock_types if t.endswith("Box._lock"))
+    assert model.lock_types[tok] == "Lock"
+
+    set_acc = _summary(model, "Box.set").accesses
+    ((key, heldsets),) = set_acc.items()
+    assert key[1] == "_v" and key[2] == "w"
+    assert heldsets == {frozenset({tok})}
+
+    peek_acc = _summary(model, "Box.peek").accesses
+    ((key, heldsets),) = peek_acc.items()
+    assert key[2] == "r" and heldsets == {frozenset()}
+
+
+def test_acquire_release_tracked_linearly(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._v = 0\n"
+        "\n"
+        "    def manual(self):\n"
+        "        self._lock.acquire()\n"
+        "        self._v = 1\n"
+        "        self._lock.release()\n"
+        "        self._v = 2\n"
+    )
+    model = _project([tmp_path]).concurrency
+    tok = next(t for t in model.lock_types if t.endswith("Box._lock"))
+    acc = _summary(model, "Box.manual").accesses
+    by_line = {key[4]: heldsets for key, heldsets in acc.items()}
+    assert by_line[10] == {frozenset({tok})}  # between acquire and release
+    assert by_line[12] == {frozenset()}  # after release
+
+
+def test_callee_events_absorb_caller_locks(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._v = 0\n"
+        "\n"
+        "    def _helper(self):\n"
+        "        self._v = 3\n"
+        "\n"
+        "    def locked_call(self):\n"
+        "        with self._lock:\n"
+        "            self._helper()\n"
+    )
+    model = _project([tmp_path]).concurrency
+    tok = next(t for t in model.lock_types if t.endswith("Box._lock"))
+    # the helper's own summary stays lock-free...
+    ((_, helper_held),) = _summary(model, "Box._helper").accesses.items()
+    assert helper_held == {frozenset()}
+    # ...but absorbed into the caller it carries the caller's held lock
+    caller = _summary(model, "Box.locked_call").accesses
+    ((key, caller_held),) = ((k, v) for k, v in caller.items() if k[1] == "_v")
+    assert key[2] == "w" and caller_held == {frozenset({tok})}
+
+
+# -- thread roots ------------------------------------------------------------
+
+
+def test_thread_root_method_target(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._loop, daemon=True)\n"
+        "\n"
+        "    def _loop(self):\n"
+        "        pass\n"
+    )
+    model = _project([tmp_path]).concurrency
+    assert [r.target.name for r in model.roots] == ["_loop"]
+    (root,) = model.roots
+    assert root.line == 5 and root.spawner_cls.endswith("Worker")
+    assert root.spawn_span is not None  # __init__'s span: setup/teardown gate
+
+
+def test_thread_root_lambda_target(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "\n"
+        "class Worker:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=lambda: self._tick())\n"
+        "\n"
+        "    def _tick(self):\n"
+        "        pass\n"
+    )
+    model = _project([tmp_path]).concurrency
+    assert [r.target.name for r in model.roots] == ["_tick"]
+
+
+def test_opaque_thread_target_degrades_to_silence(tmp_path):
+    # an unresolvable target must produce NO root (and so no findings),
+    # never a guess
+    (tmp_path / "m.py").write_text(
+        "import threading\n"
+        "\n"
+        "class Worker:\n"
+        "    def __init__(self, name):\n"
+        "        self._t = threading.Thread(target=getattr(self, name))\n"
+        "\n"
+        "    def _loop(self):\n"
+        "        self._count = 1\n"
+    )
+    project = _project([tmp_path])
+    assert project.concurrency.roots == []
+
+
+# -- rule-level regressions --------------------------------------------------
+
+
+def test_pr8_compile_under_dispatch_lock_flags():
+    # THE motivating bug: .lower().compile() inside the dispatch lock that
+    # the warm loop thread and main-thread callers contend for (fixed in the
+    # serving engine by compiling outside and publishing under the lock)
+    findings = analysis.run_lint([FIXTURES / "yamt021" / "bad"])
+    assert [f.rule for f in findings] == ["YAMT021"]
+    assert "compile" in findings[0].message and "dispatch_lock" in findings[0].message
+
+
+def test_lock_order_cycle_message_names_both_edges():
+    findings = analysis.run_lint([FIXTURES / "yamt020" / "bad"])
+    assert [f.rule for f in findings] == ["YAMT020"]
+    msg = findings[0].message
+    assert "_alock" in msg and "_block" in msg and "closing edge" in msg
+
+
+def test_cross_thread_race_names_both_regions():
+    findings = analysis.run_lint([FIXTURES / "yamt019" / "bad"])
+    assert [f.rule for f in findings] == ["YAMT019"]
+    msg = findings[0].message
+    assert "thread" in msg and "no common lock" in msg

@@ -16,7 +16,7 @@ from yet_another_mobilenet_series_tpu import analysis
 from yet_another_mobilenet_series_tpu.analysis import cli
 
 FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
-RULE_IDS = [f"YAMT{i:03d}" for i in range(1, 19)]
+RULE_IDS = [f"YAMT{i:03d}" for i in range(1, 22)]
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
@@ -63,6 +63,61 @@ def test_file_suppression(tmp_path):
 def test_disable_all(tmp_path):
     (tmp_path / "m.py").write_text("from jax import shard_map  # yamt-lint: disable=all\n")
     assert analysis.run_lint([tmp_path]) == []
+
+
+def test_suppression_in_docstring_is_not_a_suppression(tmp_path):
+    # suppression syntax QUOTED in a docstring (e.g. core.py's own usage
+    # examples) must not register: only real COMMENT tokens count
+    (tmp_path / "m.py").write_text(
+        '"""Example:  # yamt-lint: disable-file=YAMT006\n'
+        'and inline:  # yamt-lint: disable=YAMT006\n'
+        '"""\n'
+        "from jax import shard_map\n"
+    )
+    assert [f.rule for f in analysis.run_lint([tmp_path])] == ["YAMT006"]
+
+
+# -- stale-suppression audit ------------------------------------------------
+
+
+def test_stale_suppression_flagged(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import jax  # yamt-lint: disable=YAMT006 — stale: plain jax import is fine\n"
+    )
+    findings = analysis.check_suppressions([tmp_path])
+    assert [(f.rule, f.line) for f in findings] == [("YAMT900", 1)]
+
+
+def test_live_suppression_not_flagged(tmp_path):
+    (tmp_path / "m.py").write_text("from jax import shard_map  # yamt-lint: disable=YAMT006\n")
+    assert analysis.check_suppressions([tmp_path]) == []
+    assert analysis.run_lint([tmp_path]) == []
+
+
+def test_stale_file_suppression_flagged(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "# yamt-lint: disable-file=YAMT006\n"
+        "import jax\n"
+    )
+    findings = analysis.check_suppressions([tmp_path])
+    assert [(f.rule, f.line) for f in findings] == [("YAMT900", 1)]
+    assert "file-wide" in findings[0].message
+
+
+def test_suppression_audit_respects_select(tmp_path):
+    # rules outside the selection are not re-run, so their suppressions are
+    # left alone rather than declared stale
+    (tmp_path / "m.py").write_text(
+        "import jax  # yamt-lint: disable=YAMT006\n"
+    )
+    assert analysis.check_suppressions([tmp_path], select={"YAMT002"}) == []
+    assert analysis.check_suppressions([tmp_path], select={"YAMT006"}) != []
+
+
+def test_cli_check_suppressions(capsys):
+    rc = cli.main([str(FIXTURES / "yamt006" / "clean"), "--check-suppressions"])
+    capsys.readouterr()
+    assert rc == 0
 
 
 # -- framework --------------------------------------------------------------

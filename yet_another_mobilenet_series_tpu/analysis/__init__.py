@@ -5,17 +5,27 @@ The invariants that make train/steps.py compile to ONE XLA program over the
 collectives over real mesh axes, checkpoint-layout/dataclass agreement,
 yml/config schema agreement, version-resilient jax imports — are all
 detectable from source without importing it. This package detects them:
-rules YAMT001-YAMT010 (see docs/LINT.md) over an interprocedural layer
+rules YAMT001-YAMT021 (see docs/LINT.md) over an interprocedural layer
 (symbols.py project symbol table, callgraph.py call resolution, summaries.py
-per-function dataflow summaries — all pure AST), a suppression syntax,
-text/JSON/GitHub reporters, and a CLI
+per-function dataflow summaries, concurrency.py thread-root/lock-domain
+model — all pure AST), a suppression syntax plus a stale-suppression audit
+(``--check-suppressions``), text/JSON/GitHub reporters, and a CLI
 (``python -m yet_another_mobilenet_series_tpu.analysis``).
 
 The tier-1 gate runs the analyzer over this package (tests/test_lint_clean.py),
 so every invariant here is enforced on every PR.
 """
 
-from .core import Finding, Project, Rule, SourceFile, load_rules, register, run_lint
+from .core import (
+    Finding,
+    Project,
+    Rule,
+    SourceFile,
+    check_suppressions,
+    load_rules,
+    register,
+    run_lint,
+)
 from .reporters import render_github, render_json, render_text
 
 __all__ = [
@@ -23,6 +33,7 @@ __all__ = [
     "Project",
     "Rule",
     "SourceFile",
+    "check_suppressions",
     "load_rules",
     "register",
     "render_github",

@@ -13,11 +13,17 @@ Resolves, purely from the AST, what a call expression refers to:
   ``static_argnames`` and the wrapped callable;
 - call-result bindings through function summaries (``step =
   make_train_step(...)`` resolves to the inner ``step_fn`` that
-  ``make_train_step`` returns — summaries.py computes ``returns``).
+  ``make_train_step`` returns — summaries.py computes ``returns``);
+- values threaded through LITERAL containers and same-length tuple
+  unpacking (``fwd, bwd = make_fwd, make_bwd`` then ``fwd(...)``;
+  ``steps = (init, apply); steps[1](...)``; constant-keyed dict literals) —
+  the container must be a literal visible in the scope chain, and the
+  index/key a constant.
 
-Anything else — ``getattr`` chains, values threaded through containers,
-tuple unpacking — degrades to *opaque* (``None``), never a crash or a guess:
-every interprocedural rule must stay sound when resolution gives up.
+Anything else — ``getattr`` chains, containers built by calls or mutated
+after construction, computed indices — degrades to *opaque* (``None``),
+never a crash or a guess: every interprocedural rule must stay sound when
+resolution gives up.
 """
 
 from __future__ import annotations
@@ -82,6 +88,7 @@ class CallGraph:
         self.symbols = project.symbols
         self._envs: dict = {}
         self._scope_maps: dict[str, dict[int, ast.AST | None]] = {}
+        self._cache: dict[tuple, Optional[Target]] = {}
 
     # -- scope bookkeeping --------------------------------------------------
 
@@ -147,6 +154,22 @@ class CallGraph:
                 self._bind(env, st.name, ("opaque", None))  # local classes: rare, skip
             elif isinstance(st, ast.Assign) and len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
                 self._bind(env, st.targets[0].id, ("expr", st.value))
+            elif (
+                isinstance(st, ast.Assign)
+                and len(st.targets) == 1
+                and isinstance(st.targets[0], (ast.Tuple, ast.List))
+                and isinstance(st.value, (ast.Tuple, ast.List))
+                and len(st.targets[0].elts) == len(st.value.elts)
+                and not any(isinstance(e, ast.Starred) for e in st.value.elts)
+            ):
+                # same-length literal tuple unpack: elementwise bindings
+                for tgt, val in zip(st.targets[0].elts, st.value.elts):
+                    if isinstance(tgt, ast.Name):
+                        self._bind(env, tgt.id, ("expr", val))
+                    else:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                self._bind(env, n.id, ("opaque", None))
             else:
                 for t in self._assigned_names(st):
                     self._bind(env, t, ("opaque", None))
@@ -201,7 +224,18 @@ class CallGraph:
     def resolve_expr(self, src, expr: ast.expr, scope_node=None, _guard=None) -> Optional[Target]:
         """Resolve an expression to a :class:`Target`, or None (opaque)."""
         if _guard is None:
-            _guard = set()
+            # memoize top-level resolutions, but only once the summaries
+            # fixpoint has converged: mid-fixpoint results sharpen as
+            # ``returns`` entries land, and caching them would freeze the
+            # weaker answer (AST node ids are stable: the Project owns every
+            # tree for its whole lifetime)
+            key = (id(expr), id(scope_node) if scope_node is not None else None)
+            if key in self._cache:
+                return self._cache[key]
+            result = self.resolve_expr(src, expr, scope_node, set())
+            if getattr(self.project, "_summaries_done", False):
+                self._cache[key] = result
+            return result
         if id(expr) in _guard:
             return None
         _guard.add(id(expr))
@@ -215,6 +249,60 @@ class CallGraph:
             return self._member(base, expr.attr, _guard)
         if isinstance(expr, ast.Call):
             return self._resolve_call_result(src, expr, scope_node, _guard)
+        if isinstance(expr, ast.Subscript):
+            return self._resolve_subscript(src, expr, scope_node, _guard)
+        return None
+
+    def _resolve_subscript(self, src, sub: ast.Subscript, scope_node, _guard):
+        """``container[const]`` where the container chases (through Name
+        bindings) to a literal Tuple/List/Dict: resolve the selected element.
+        Mutated-after-construction containers never get here — any second
+        binding of the name went opaque in ``_bind``."""
+        if not isinstance(sub.slice, ast.Constant):
+            return None
+        got = self._literal_container(src, sub.value, scope_node)
+        if got is None:
+            return None
+        cont, csrc, cscope = got
+        idx = sub.slice.value
+        if isinstance(cont, (ast.Tuple, ast.List)):
+            if (
+                isinstance(idx, int)
+                and not isinstance(idx, bool)
+                and -len(cont.elts) <= idx < len(cont.elts)
+                and not any(isinstance(e, ast.Starred) for e in cont.elts)
+            ):
+                return self.resolve_expr(csrc, cont.elts[idx], cscope, _guard)
+            return None
+        if isinstance(cont, ast.Dict):
+            for k, v in zip(cont.keys, cont.values):
+                if k is None:  # **spread: key set unknowable
+                    return None
+                if isinstance(k, ast.Constant) and k.value == idx:
+                    return self.resolve_expr(csrc, v, cscope, _guard)
+        return None
+
+    def _literal_container(self, src, expr, scope_node, _depth=0):
+        """Chase ``expr`` through Name bindings to a literal container node;
+        returns (container, src, scope_node-for-its-free-names) or None."""
+        if _depth > 8:
+            return None
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Dict)):
+            return expr, src, scope_node
+        if not isinstance(expr, ast.Name):
+            return None
+        for node in self._scope_chain(src, scope_node):
+            env = self._env(src, node)
+            if expr.id in env:
+                tag, val = env[expr.id]
+                if tag != "expr":
+                    return None
+                return self._literal_container(src, val, node, _depth + 1)
+        mi = self.symbols.by_path.get(src.path)
+        got = self.symbols.resolve_member(mi, expr.id) if mi is not None else None
+        if got is not None and got[0] == "assign":
+            _, val, mi2 = got
+            return self._literal_container(mi2.src, val, None, _depth + 1)
         return None
 
     def _resolve_name(self, src, name, scope_node, _guard):
@@ -338,7 +426,7 @@ class CallGraph:
         """Every Call in ``src`` with its enclosing scope and resolution:
         list of (call_node, scope_node, Target-or-None)."""
         out = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, ast.Call):
                 scope = self.enclosing_scope(src, node)
                 out.append((node, scope, self.resolve_call(src, node, scope)))

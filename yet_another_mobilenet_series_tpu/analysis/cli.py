@@ -15,7 +15,7 @@ import argparse
 import os
 import sys
 
-from .core import load_rules, run_lint
+from .core import check_suppressions, load_rules, run_lint
 from .reporters import render_github, render_json, render_text
 
 
@@ -35,6 +35,11 @@ def main(argv=None) -> int:
     )
     p.add_argument("--select", default="", metavar="IDS", help="comma-separated rule ids to run (default: all)")
     p.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
+    p.add_argument(
+        "--check-suppressions", action="store_true",
+        help="audit suppression comments instead of linting: a suppression whose "
+        "rule no longer fires at its site is reported as YAMT900",
+    )
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -43,8 +48,9 @@ def main(argv=None) -> int:
         return 0
 
     select = {s.strip().upper() for s in args.select.split(",") if s.strip()} or None
+    runner = check_suppressions if args.check_suppressions else run_lint
     try:
-        findings = run_lint(args.paths or [_default_path()], select=select)
+        findings = runner(args.paths or [_default_path()], select=select)
     except (OSError, ValueError) as e:
         print(f"yamt-lint: {e}", file=sys.stderr)
         return 2

@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import os
 import re
+import tokenize
 from typing import Iterable, Sequence
 
 
@@ -104,17 +106,44 @@ class SourceFile:
         except SyntaxError as e:
             self.parse_error = e
         self.aliases = _import_aliases(self.tree) if self.tree is not None else {}
+        self._nodes: list[ast.AST] | None = None
         self.line_suppressions: dict[int, set[str]] = {}
         self.file_suppressions: set[str] = set()
-        for lineno, line in enumerate(self.lines, start=1):
-            m = _SUPPRESS_RE.search(line)
+        self.file_suppression_lines: dict[str, int] = {}
+        for lineno, comment in self._comments():
+            m = _SUPPRESS_RE.search(comment)
             if m is None:
                 continue
             rules = {r.strip().upper() for r in m.group("rules").split(",")}
             if m.group("scope"):
                 self.file_suppressions |= rules
+                for r in rules:
+                    self.file_suppression_lines.setdefault(r, lineno)
             else:
                 self.line_suppressions.setdefault(lineno, set()).update(rules)
+
+    def _comments(self):
+        """(lineno, text) of every real COMMENT token. Tokenizing (rather
+        than regex-scanning raw lines) keeps suppression syntax QUOTED in a
+        docstring or string literal from registering as a live suppression.
+        Falls back to whole-line scanning only if tokenization fails."""
+        try:
+            return [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(io.StringIO(self.text).readline)
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return list(enumerate(self.lines, start=1))
+
+    @property
+    def nodes(self) -> list[ast.AST]:
+        """Every AST node of the file in ``ast.walk`` (BFS) order, computed
+        once and shared: ~20 rules re-walking every tree was the single
+        biggest lint-time cost."""
+        if self._nodes is None:
+            self._nodes = [] if self.tree is None else list(ast.walk(self.tree))
+        return self._nodes
 
     def suppressed(self, finding: Finding) -> bool:
         for scope in (self.file_suppressions, self.line_suppressions.get(finding.line, ())):
@@ -133,6 +162,7 @@ class Project:
         self._symbols = None
         self._callgraph = None
         self._summaries = None
+        self._concurrency = None
 
     @property
     def symbols(self):
@@ -162,7 +192,17 @@ class Project:
 
             self._summaries = {}
             summaries_mod.compute(self, self._summaries)
+            self._summaries_done = True  # callgraph memoization gate
         return self._summaries
+
+    @property
+    def concurrency(self):
+        """Thread-root + lock-domain model (concurrency.py), built once."""
+        if self._concurrency is None:
+            from .concurrency import ConcurrencyModel
+
+            self._concurrency = ConcurrencyModel(self)
+        return self._concurrency
 
     @property
     def axis_constants(self) -> dict[str, str]:
@@ -192,7 +232,7 @@ class Project:
                         and isinstance(node.value.value, str)
                     ):
                         consts[node.targets[0].id] = node.value.value
-                for node in ast.walk(src.tree):
+                for node in src.nodes:
                     if not isinstance(node, ast.Call):
                         continue
                     q = qualified_name(node.func, src.aliases) or ""
@@ -244,6 +284,7 @@ def load_rules() -> list[Rule]:
     registry sorted by id."""
     from . import (  # noqa: F401
         rules_async_staging,
+        rules_concurrency,
         rules_config,
         rules_donation,
         rules_dtype,
@@ -288,6 +329,37 @@ def collect_paths(paths: Iterable[str]) -> tuple[list[str], list[str]]:
     return py, yml
 
 
+def _load_project(paths: Iterable[str]) -> tuple[list[Finding], list[SourceFile], Project]:
+    """Read and parse every linted path once: (syntax-error findings,
+    parsed files, Project). Shared by :func:`run_lint` and
+    :func:`check_suppressions` so the two stay byte-for-byte consistent."""
+    py_paths, yml_paths = collect_paths(paths)
+    syntax: list[Finding] = []
+    files: list[SourceFile] = []
+    for path in py_paths:
+        with open(path, encoding="utf-8") as f:
+            src = SourceFile(path, f.read())
+        if src.parse_error is not None:
+            e = src.parse_error
+            syntax.append(
+                Finding(path, e.lineno or 1, max((e.offset or 1) - 1, 0), "YAMT000", f"syntax error: {e.msg}")
+            )
+            continue
+        files.append(src)
+    return syntax, files, Project(files, yml_paths)
+
+
+def _raw_findings(rules: Sequence[Rule], files: Sequence[SourceFile], project: Project) -> list[Finding]:
+    """Every finding BEFORE suppression filtering (deduped)."""
+    findings: list[Finding] = []
+    for rule in rules:
+        for src in files:
+            findings.extend(rule.check_file(src, project))
+        findings.extend(rule.check_project(project))
+    # two roots reaching the same traced helper must not report it twice
+    return sorted(set(findings))
+
+
 def run_lint(paths: Iterable[str], select: set[str] | None = None) -> list[Finding]:
     """Lint ``paths`` (files or directories) and return sorted findings.
 
@@ -297,20 +369,7 @@ def run_lint(paths: Iterable[str], select: set[str] | None = None) -> list[Findi
     rules = load_rules()
     if select is not None:
         rules = [r for r in rules if r.id in select]
-    py_paths, yml_paths = collect_paths(paths)
-    findings: list[Finding] = []
-    files: list[SourceFile] = []
-    for path in py_paths:
-        with open(path, encoding="utf-8") as f:
-            src = SourceFile(path, f.read())
-        if src.parse_error is not None:
-            e = src.parse_error
-            findings.append(
-                Finding(path, e.lineno or 1, max((e.offset or 1) - 1, 0), "YAMT000", f"syntax error: {e.msg}")
-            )
-            continue
-        files.append(src)
-    project = Project(files, yml_paths)
+    findings, files, project = _load_project(paths)
     by_path = {src.path: src for src in files}
 
     def live(f: Finding) -> bool:
@@ -320,9 +379,67 @@ def run_lint(paths: Iterable[str], select: set[str] | None = None) -> list[Findi
         owner = by_path.get(f.path)
         return owner is None or not owner.suppressed(f)
 
-    for rule in rules:
-        for src in files:
-            findings.extend(f for f in rule.check_file(src, project) if live(f))
-        findings.extend(f for f in rule.check_project(project) if live(f))
-    # two roots reaching the same traced helper must not report it twice
+    findings.extend(f for f in _raw_findings(rules, files, project) if live(f))
     return sorted(set(findings))
+
+
+def check_suppressions(paths: Iterable[str], select: set[str] | None = None) -> list[Finding]:
+    """Audit every suppression comment under ``paths``: a suppression whose
+    rule no longer fires at its site is STALE — dead weight that silently
+    swallows the rule if the hazard ever comes back at that line. Stale ones
+    are reported as rule ``YAMT900`` findings (never themselves
+    suppressible: the raw, pre-suppression findings are compared against).
+
+    ``select`` limits which rules are re-run and judged; suppressions for
+    rules outside the selection are left alone, not declared stale.
+    """
+    rules = load_rules()
+    if select is not None:
+        rules = [r for r in rules if r.id in select]
+    judged = {r.id for r in rules}
+    _, files, project = _load_project(paths)
+    raw = _raw_findings(rules, files, project)
+    at_line: dict[tuple[str, int], set[str]] = {}
+    in_file: dict[str, set[str]] = {}
+    for f in raw:
+        at_line.setdefault((f.path, f.line), set()).add(f.rule)
+        in_file.setdefault(f.path, set()).add(f.rule)
+
+    out: list[Finding] = []
+    for src in files:
+        for lineno in sorted(src.line_suppressions):
+            here = at_line.get((src.path, lineno), set())
+            for r in sorted(src.line_suppressions[lineno]):
+                if r == "ALL":
+                    stale = not here
+                elif r in judged:
+                    stale = r not in here
+                else:
+                    continue
+                if stale:
+                    what = "no rule fires" if r == "ALL" else f"{r} no longer fires"
+                    out.append(
+                        Finding(
+                            src.path, lineno, 0, "YAMT900",
+                            f"stale suppression: {what} at this line; delete the "
+                            "comment (it would silently swallow the rule if the "
+                            "hazard returns)",
+                        )
+                    )
+        for r in sorted(src.file_suppressions):
+            if r == "ALL":
+                stale = not in_file.get(src.path)
+            elif r in judged:
+                stale = r not in in_file.get(src.path, set())
+            else:
+                continue
+            if stale:
+                what = "no rule fires" if r == "ALL" else f"{r} never fires"
+                out.append(
+                    Finding(
+                        src.path, src.file_suppression_lines.get(r, 1), 0, "YAMT900",
+                        f"stale file-wide suppression: {what} anywhere in this "
+                        "file; delete the disable-file comment",
+                    )
+                )
+    return sorted(set(out))

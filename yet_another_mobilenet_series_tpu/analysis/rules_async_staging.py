@@ -199,7 +199,7 @@ class AsyncStagingMutation(Rule):
         findings: list[Finding] = []
         scopes: list[ast.AST] = [src.tree]
         scopes += [
-            n for n in ast.walk(src.tree)
+            n for n in src.nodes
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:

@@ -29,7 +29,7 @@ def _config_schema(project: Project):
             continue
         dataclasses = {
             node.name: node
-            for node in ast.walk(src.tree)
+            for node in src.nodes
             if isinstance(node, ast.ClassDef) and _is_dataclass(node, src.aliases)
         }
         root = dataclasses.get("Config")

@@ -77,7 +77,7 @@ class DonatedBufferReuse(Rule):
         self._project = project
         self._cg = project.callgraph
         donors: dict[str, tuple[int, ...]] = {}
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not (
                 isinstance(node, ast.Assign)
                 and len(node.targets) == 1
@@ -105,7 +105,7 @@ class DonatedBufferReuse(Rule):
         out: dict[tuple, Finding] = {}
         scopes: list[ast.AST] = [src.tree]
         scopes += [
-            n for n in ast.walk(src.tree) if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            n for n in src.nodes if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:
             self._scope = scope if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) else None

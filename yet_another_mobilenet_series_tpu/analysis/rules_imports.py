@@ -52,7 +52,7 @@ class FragileJaxImport(Rule):
         # imports anywhere inside a try/except that catches ImportError are
         # the sanctioned version-guard idiom (utils/compat.py) — exempt
         guarded: set[int] = set()
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Try):
                 continue
             catches = set()
@@ -77,7 +77,7 @@ class FragileJaxImport(Rule):
                 )
             )
 
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, ast.Import) and id(node) not in guarded:
                 for a in node.names:
                     why = _module_matches(a.name)

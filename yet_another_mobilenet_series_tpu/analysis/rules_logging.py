@@ -63,13 +63,13 @@ class BarePrintInPackage(Rule):
             return []
 
         guarded: set[int] = set()
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, ast.If) and _is_main_guard(node):
                 for sub in ast.walk(node):
                     guarded.add(id(sub))
 
         findings: list[Finding] = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Name)

@@ -18,16 +18,23 @@ Deliberately NOT flagged:
   callee is expected to derive its own per-call stream;
 - passes to opaque callees (unresolvable targets never count — soundness
   over recall);
-- one direct draw plus one callee pass (the direct half is YAMT002's beat;
-  recorded as a known gap in docs/LINT.md).
+- two direct draws with no callee involved — that pair is exactly YAMT002's
+  beat, and double-flagging one hazard under two ids helps nobody.
+
+The MIXED pair — one direct draw plus one whole-key callee pass — lands
+here: YAMT002 sees only one draw (count 1, silent) and the pure-callee rule
+saw only one pass, so the pair slipped between the two rules (the gap
+docs/LINT.md carried since PR 4). Direct draws now increment the same
+per-name counter as callee passes, and the finding fires whenever the
+second consumption involves at least one callee.
 """
 
 from __future__ import annotations
 
 import ast
 
-from .core import Finding, Project, Rule, SourceFile, register
-from .rules_tracing import PRNGKeyReuse
+from .core import Finding, Project, Rule, SourceFile, qualified_name, register
+from .rules_tracing import _KEY_SAFE, PRNGKeyReuse
 from .summaries import summary_for_target
 
 
@@ -44,12 +51,26 @@ class CrossCallKeyReuse(PRNGKeyReuse, Rule):
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
         self._project = project
         self._first_sites: dict[str, str] = {}
+        self._kinds: dict[str, list[str]] = {}
         return super().check_file(src, project)
 
-    # consumption = a whole-key pass to a resolved key-consuming callee;
-    # overrides YAMT002's direct-draw counting (and drops its loop-depth
-    # rule: same-callee-per-iteration is the sanctioned step idiom)
+    # consumption = a whole-key pass to a resolved key-consuming callee OR a
+    # direct jax.random draw; overrides YAMT002's counting (and drops its
+    # loop-depth rule: same-callee-per-iteration is the sanctioned step
+    # idiom). A pair only flags when at least one half is a callee pass —
+    # two direct draws stay YAMT002's finding.
     def _check_draw(self, call, state, depth, src, out):
+        q = qualified_name(call.func, src.aliases)
+        if q and q.startswith("jax.random."):
+            fn = q.rsplit(".", 1)[-1]
+            if fn in _KEY_SAFE or not call.args or not isinstance(call.args[0], ast.Name):
+                return
+            self._count(
+                call.args[0].id, "direct",
+                f"a direct jax.random.{fn} draw (line {call.lineno})",
+                call, state, depth, src, out,
+            )
+            return
         cg = self._project.callgraph
         target = cg.resolve_call(src, call, self._scope)
         summary = summary_for_target(self._project, target)
@@ -67,23 +88,41 @@ class CrossCallKeyReuse(PRNGKeyReuse, Rule):
             if kw.arg in summary.key_params and isinstance(kw.value, ast.Name):
                 consumed.append(kw.value.id)
         for name in consumed:
-            ent = state.vars.get(name)
-            if ent is None:
-                state.vars[name] = [1, depth]
-                self._first_sites.setdefault(name, f"'{label}' (line {call.lineno})")
-                continue
-            if ent[0] == 0:
-                self._first_sites[name] = f"'{label}' (line {call.lineno})"
-            ent[0] += 1
-            if ent[0] == 2:
-                first = self._first_sites.get(name, "an earlier callee")
-                f = Finding(
-                    src.path, call.lineno, call.col_offset, self.id,
-                    f"PRNG key '{name}' passed whole to '{label}' after already being "
-                    f"consumed whole by {first}: both callees derive the same random "
-                    "streams — split the key (or fold_in a tag) per callee",
+            self._count(
+                name, "callee", f"'{label}' (line {call.lineno})",
+                call, state, depth, src, out,
+            )
+
+    def _count(self, name, kind, site, call, state, depth, src, out):
+        kinds = self._kinds.setdefault(name, [])
+        ent = state.vars.get(name)
+        if ent is None:
+            state.vars[name] = [1, depth]
+            kinds.append(kind)
+            self._first_sites.setdefault(name, site)
+            return
+        if ent[0] == 0:
+            # fresh rebind: the old consumption stream is closed
+            self._first_sites[name] = site
+            kinds.clear()
+        kinds.append(kind)
+        ent[0] += 1
+        if ent[0] == 2 and "callee" in kinds:
+            first = self._first_sites.get(name, "an earlier consumer")
+            if kind == "callee":
+                msg = (
+                    f"PRNG key '{name}' passed whole to {site} after already being "
+                    f"consumed by {first}: the callee re-derives the same random "
+                    "streams — split the key (or fold_in a tag) per consumer"
                 )
-                out.setdefault((f.line, name, self.id), f)
+            else:
+                msg = (
+                    f"PRNG key '{name}' consumed by {site} after already being "
+                    f"passed whole to {first}: the draw repeats the callee's "
+                    "stream — split the key (or fold_in a tag) per consumer"
+                )
+            f = Finding(src.path, call.lineno, call.col_offset, self.id, msg)
+            out.setdefault((f.line, name, self.id), f)
 
 
 def _call_label(func: ast.expr) -> str:

@@ -62,11 +62,11 @@ class ProfilerStopGuard(Rule):
 
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
         parents: dict[int, ast.AST] = {}
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             for child in ast.iter_child_nodes(node):
                 parents[id(child)] = node
         findings: list[Finding] = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Call):
                 continue
             q = qualified_name(node.func, src.aliases) or ""

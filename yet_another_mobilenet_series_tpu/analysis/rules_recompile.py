@@ -26,6 +26,15 @@ the re-jit is intentional and paid at epoch cadence, not per step):
    re-wrapping in the loop recompiles every iteration. (Rebinding BEFORE
    the jit exists — the ``forward = jax.checkpoint(forward)`` factory
    idiom in train/steps.py — is build-time setup and stays clean.)
+
+3. **Module-level mutable globals read by jitted functions.** One scope up
+   from (2): a jitted def (at any nesting) that reads a module-level global
+   bound to a MUTABLE container (dict/list/set literal or constructor,
+   ``defaultdict``/``deque``/…) which the module also mutates somewhere
+   (subscript store/delete, a mutating method call, or ``global`` +
+   rebind). The trace bakes the first-call contents into the program;
+   every later mutation is silently ignored. Immutable globals and
+   build-once-read-only tables stay clean — mutation evidence is required.
 """
 
 from __future__ import annotations
@@ -50,6 +59,14 @@ _FRESH_QUALIFIED = {
 }
 
 _UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp, ast.DictComp)
+_MUTABLE_BUILDERS = {"dict", "list", "set", "bytearray"}
+_MUTABLE_QUALIFIED = {"defaultdict", "OrderedDict", "deque", "Counter"}
+_MUTATING_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault",
+    "pop", "popitem", "remove", "discard", "clear", "appendleft",
+}
 
 
 @register
@@ -136,7 +153,7 @@ class RecompilationHazard(Rule):
             registrations[id(node)] = (node, line if prev is None else min(prev[1], line))
 
         defs_by_name: dict[str, list] = {}
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs_by_name.setdefault(node.name, []).append(node)
                 for dec in node.decorator_list:
@@ -146,7 +163,7 @@ class RecompilationHazard(Rule):
                     elif isinstance(dec, ast.Call) and q in _PARTIAL_Q and dec.args:
                         if qualified_name(dec.args[0], src.aliases) in _JIT_Q:
                             note(node, dec.lineno)
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if (
                 isinstance(node, ast.Call)
                 and qualified_name(node.func, src.aliases) in _JIT_Q
@@ -158,10 +175,13 @@ class RecompilationHazard(Rule):
 
         for fn_id, (root, reg_line) in registrations.items():
             fi = symbols.by_node.get(fn_id)
-            if fi is None or fi.parent is None:
-                continue  # module-level jit: globals are out of static reach
+            if fi is None:
+                continue
             for name in sorted(self._free_reads(root)):
-                self._check_free_name(src, root, fi, name, reg_line, out)
+                if fi.parent is None:
+                    self._check_module_global(src, root, name, out)
+                else:
+                    self._check_free_name(src, root, fi, name, reg_line, out)
 
     @staticmethod
     def _free_reads(root) -> set[str]:
@@ -216,6 +236,82 @@ class RecompilationHazard(Rule):
             if name in params or self._binds(scope, root, name):
                 return  # bound here, and none of the hazard shapes: clean
             scope_fi = scope_fi.parent
+        # the scope chain never bound it: it's a module global
+        self._check_module_global(src, root, name, out)
+
+    # -- 3: module-level mutable globals ------------------------------------
+
+    def _check_module_global(self, src, root, name, out):
+        """A jitted function reading a module-level global bound to a mutable
+        container that the module also mutates: the trace freezes the
+        first-call contents. Mutation evidence is required — build-once
+        lookup tables are the sanctioned module-constant idiom."""
+        defn = None
+        for st in src.tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                if st.name == name:
+                    return
+            elif isinstance(st, (ast.Import, ast.ImportFrom)):
+                if any((a.asname or a.name.split(".")[0]) == name for a in st.names):
+                    return
+            elif isinstance(st, ast.Assign):
+                if any(name in self._target_names(t) for t in st.targets):
+                    defn = st
+            elif isinstance(st, ast.AnnAssign):
+                if isinstance(st.target, ast.Name) and st.target.id == name and st.value is not None:
+                    defn = st
+        if defn is None or not self._mutable_rhs(defn.value, src):
+            return
+        mut = self._mutation_line(src, name)
+        if mut is None:
+            return
+        f = Finding(
+            src.path, root.lineno, root.col_offset, self.id,
+            f"jitted function '{getattr(root, 'name', '<lambda>')}' reads module-level "
+            f"mutable global '{name}' (defined at line {defn.lineno}, mutated at line "
+            f"{mut}): jit bakes the trace-time contents into the compiled program and "
+            "silently ignores every later mutation; pass it as an argument or freeze "
+            "it (tuple/frozenset) at module load",
+        )
+        out.setdefault((f.path, f.line, name), f)
+
+    @staticmethod
+    def _mutable_rhs(rhs, src) -> bool:
+        if isinstance(rhs, _MUTABLE_LITERALS):
+            return True
+        if isinstance(rhs, ast.Call):
+            q = qualified_name(rhs.func, src.aliases) or ""
+            if q.rsplit(".", 1)[-1] in _MUTABLE_QUALIFIED:
+                return True
+            if isinstance(rhs.func, ast.Name) and rhs.func.id in _MUTABLE_BUILDERS:
+                return True
+        return False
+
+    @staticmethod
+    def _mutation_line(src, name) -> int | None:
+        """Earliest line where the module mutates ``name`` in place: a
+        subscript store/delete, a mutating method call, or a ``global``
+        declaration (rebinding intent from inside a function)."""
+        hits: list[int] = []
+        for n in src.nodes:
+            if (
+                isinstance(n, ast.Subscript)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == name
+                and isinstance(n.ctx, (ast.Store, ast.Del))
+            ):
+                hits.append(n.lineno)
+            elif (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and isinstance(n.func.value, ast.Name)
+                and n.func.value.id == name
+                and n.func.attr in _MUTATING_METHODS
+            ):
+                hits.append(n.lineno)
+            elif isinstance(n, ast.Global) and name in n.names:
+                hits.append(n.lineno)
+        return min(hits) if hits else None
 
     @staticmethod
     def _loop_target_containing(scope, root, name) -> int | None:

@@ -163,7 +163,7 @@ class SocketWithoutTimeout(Rule):
             return []
         findings: list[Finding] = []
         scopes: list[ast.AST] = [src.tree]
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 scopes.append(node)
         for scope in scopes:

@@ -52,7 +52,7 @@ class CollectiveAxisName(Rule):
             return []  # no ground truth in this project: nothing to validate
         known = ", ".join(sorted(set(axes.values())))
         findings: list[Finding] = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Call):
                 continue
             q = qualified_name(node.func, src.aliases)
@@ -124,7 +124,7 @@ class FieldTupleDrift(Rule):
         classes: dict[str, list[str]] = {}
         for src in project.files:
             local = by_file.setdefault(src.path, {})
-            for node in ast.walk(src.tree):
+            for node in src.nodes:
                 if isinstance(node, ast.ClassDef) and _is_dataclass(node, src.aliases):
                     local.setdefault(node.name, _class_fields(node))
                     classes.setdefault(node.name, _class_fields(node))

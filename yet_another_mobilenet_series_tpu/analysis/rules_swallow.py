@@ -87,7 +87,7 @@ class SilentExceptionSwallow(Rule):
             return []
         exempt = None
         findings: list[Finding] = []
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not (_is_broad(node.type) and _is_silent(node)):

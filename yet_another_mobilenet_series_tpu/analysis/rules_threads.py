@@ -134,7 +134,7 @@ class UnguardedThreadTarget(Rule):
         index = None
         findings: list[Finding] = []
         flagged: set[int] = set()  # one finding per target def, however many Thread()s
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if not isinstance(node, ast.Call):
                 continue
             q = qualified_name(node.func, src.aliases)

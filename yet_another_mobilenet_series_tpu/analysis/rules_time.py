@@ -191,7 +191,7 @@ class WallClockDuration(Rule):
             return []
         findings: list[Finding] = []
         scopes: list[ast.AST] = [src.tree]
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
                 scopes.append(node)
         for scope in scopes:

@@ -124,7 +124,7 @@ class HostEffectsUnderTrace(Rule):
         cg = project.callgraph
         tree, aliases = src.tree, src.aliases
         defs_by_name: dict[str, list[ast.AST]] = {}
-        for node in ast.walk(tree):
+        for node in src.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 defs_by_name.setdefault(node.name, []).append(node)
 
@@ -132,7 +132,7 @@ class HostEffectsUnderTrace(Rule):
         # traced callable into ANOTHER module (jax.jit(trainer.step),
         # jax.jit(make_prune_event(...)))
         roots: list[tuple[ast.AST, SourceFile]] = []
-        for node in ast.walk(tree):
+        for node in src.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 # a body with a mesh collective DIRECTLY in it (not via a
                 # nested def — a factory's build-time code is host code) is a
@@ -274,7 +274,7 @@ class PRNGKeyReuse(Rule):
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
         out: dict[tuple, Finding] = {}
         scopes: list[tuple[ast.AST, set[str]]] = [(src.tree, set())]
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 seeds = {n for n in _arg_names(node) if _KEY_PARAM_RE.search(n)}
                 scopes.append((node, seeds))

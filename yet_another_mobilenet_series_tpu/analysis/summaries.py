@@ -164,7 +164,9 @@ def _scan_function(project, cg, fi: FunctionInfo, s: FunctionSummary) -> bool:
 
 def _returned_callable(cg, fi: FunctionInfo) -> Optional[Target]:
     """First return value (own body only, not nested defs) that resolves to
-    a jit wrapper or a project function."""
+    a jit wrapper, a project function, or a project-class instance (so
+    method calls on a factory's result — ``predict_async(x).result()`` —
+    resolve through the returned class)."""
     src = fi.module.src
     stack = list(fi.node.body)
     while stack:
@@ -173,7 +175,7 @@ def _returned_callable(cg, fi: FunctionInfo) -> Optional[Target]:
             continue
         if isinstance(st, ast.Return) and st.value is not None:
             t = cg.resolve_expr(src, st.value, fi.node)
-            if t is not None and t.kind in ("jit", "function"):
+            if t is not None and t.kind in ("jit", "function", "instance"):
                 return t
             continue
         for block in ("body", "orelse", "finalbody"):

@@ -139,7 +139,7 @@ class SymbolTable:
 
     def _index_module(self, src) -> ModuleInfo:
         mi = ModuleInfo(module_name_for(src.path), src)
-        for node in ast.walk(src.tree):
+        for node in src.nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     if a.asname:

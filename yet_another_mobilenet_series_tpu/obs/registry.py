@@ -296,7 +296,7 @@ class MetricsRegistry:
         any serving histogram exists)."""
         if not bounds:
             return
-        self._default_buckets = tuple(sorted(float(b) for b in bounds))
+        self._default_buckets = tuple(sorted(float(b) for b in bounds))  # yamt-lint: disable=YAMT019 — startup-ordered: applied at CLI boot before any serving histogram (or thread) exists
 
     def snapshot(self) -> dict[str, float]:
         """Flat {name: float} view of every metric; histograms expand to

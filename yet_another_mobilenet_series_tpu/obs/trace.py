@@ -180,7 +180,8 @@ class SpanTracer:
         default is the Python thread's own name (``serve-collect``, ...)."""
         if not self.enabled:
             return
-        self._thread_names[threading.get_ident()] = (
+        self._thread_names[threading.get_ident()] = (  # yamt-lint: disable=YAMT019 — per-thread dict: every thread writes only its OWN ident key
+
             name or threading.current_thread().name
         )
 

@@ -16,6 +16,12 @@ and re-dumping every poll interval would only shred the first, most accurate
 stack capture.
 """
 
+# yamt-lint: disable-file=YAMT019 — lock-free by design: arm() publishes the
+# heartbeat fields (_beat_ns/_step/_phase) as single GIL-atomic stores from
+# one writer (the train loop), and the poll thread tolerates a torn trio or a
+# stale read for exactly one poll interval; _fired/_info follow the same
+# single-writer publish discipline (docs/LINT.md "Concurrency rules").
+
 from __future__ import annotations
 
 import json

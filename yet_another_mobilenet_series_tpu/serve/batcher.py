@@ -229,7 +229,9 @@ class MicroBatcher:
     def _fail_live(self, exc: Exception) -> None:
         """Fail every request still unresolved anywhere in the batcher —
         queued, in a worker's hands, or dispatched-but-unsynced."""
-        for req in list(self._live):
+        with self._live_lock:
+            live = list(self._live)
+        for req in live:  # _finish_err re-takes the lock per request
             self._finish_err(req, exc)
 
     # -- future resolution (idempotent, the only two mutation paths) --------
@@ -305,7 +307,7 @@ class MicroBatcher:
         """Brownout actuator (L2+): disable the coalescing linger — batches
         fill only from what is already queued, then dispatch. Idempotent and
         safe to flip live from the controller thread."""
-        self._fill_or_flush = bool(enabled)
+        self._fill_or_flush = bool(enabled)  # yamt-lint: disable=YAMT019 — single-writer bool flip from the brownout controller; the worker reads a stale value for at most one linger tick
 
     def apply_brownout(self, policy) -> None:
         """The batcher's slice of a :class:`~.brownout.BrownoutPolicy`."""

@@ -171,7 +171,7 @@ class BrownoutController:
         direction = "up" if new_level > self.level else "down"
         with obs_trace.get_tracer().span("serve/brownout", "serve",
                                          frm=self.level, to=new_level):
-            self.level = new_level
+            self.level = new_level  # yamt-lint: disable=YAMT019 — single-writer int publish from the controller loop; readers tolerate one stale tick
             self._apply(self._ladder[new_level])
         self._reg.gauge("serve.brownout_level").set(new_level)
         self._reg.counter("serve.brownout_transitions").inc()

@@ -469,5 +469,5 @@ class Frontend:
         self._server.shutdown()
         self._server.server_close()
         self._thread.join(timeout=5.0)
-        self._server = None
+        self._server = None  # yamt-lint: disable=YAMT019 — teardown: shutdown() has returned serve_forever and the accept thread was joined above
         self._thread = None

@@ -177,7 +177,8 @@ class NetChaosProxy:
         if self._listener is not None:
             raise RuntimeError("proxy already started")
         self._stop.clear()
-        self._t0 = time.monotonic()
+        with self._lock:  # set_fault/_link_down access _t0 under the same lock
+            self._t0 = time.monotonic()
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.settimeout(_TICK_S * 4)  # bounded accept waits: stop() never hangs
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -197,7 +198,7 @@ class NetChaosProxy:
             self._accept_thread = None
         if self._listener is not None:
             self._listener.close()
-            self._listener = None
+            self._listener = None  # yamt-lint: disable=YAMT019 — teardown handshake: the accept loop maps the resulting OSError to "stop() is running" and exits
         with self._lock:
             socks, self._open_socks = set(self._open_socks), set()
         for c in socks:
@@ -437,6 +438,24 @@ class NetChaosProxy:
                     self._stop.wait(_TICK_S)
                     continue
                 break
+            # the fault may have switched while this thread was parked in
+            # select: re-derive before any DELIVERY decision, and hold the
+            # in-flight chunk through blackhole windows — a partition spares
+            # no socket, and heal releases the stalled chunk, not drops it
+            while not self._stop.is_set():
+                plan = self.plan_for(plan.idx)
+                shape = self._shape_now(plan)
+                if shape == "blackhole" or (shape == "half_open" and direction == "u2c"):
+                    self._stop.wait(_TICK_S)
+                    continue
+                break
+            if self._stop.is_set():
+                break
+            if shape == "reset":
+                self._reg.counter("serve.netchaos.resets").inc()
+                self._rst_close(dst)
+                self._rst_close(src)
+                return
             if shape == "half_open" and direction == "c2u":
                 continue  # consumed, never delivered
             if shape == "drop_response" and direction == "u2c":

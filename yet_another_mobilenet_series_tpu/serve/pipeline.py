@@ -197,7 +197,7 @@ class PipelinedBatcher(MicroBatcher):
     # -- lifecycle (two threads) --------------------------------------------
 
     def _start_threads(self) -> None:
-        self._thread = threading.Thread(target=self._collect_loop, name="serve-collect", daemon=True)
+        self._thread = threading.Thread(target=self._collect_loop, name="serve-collect", daemon=True)  # yamt-lint: disable=YAMT019 — lifecycle: threads start before any client can submit; submit's None-check is the not-started guard
         self._completion = threading.Thread(target=self._complete_loop, name="serve-complete", daemon=True)
         self._thread.start()
         self._completion.start()

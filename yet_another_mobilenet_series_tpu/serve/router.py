@@ -521,7 +521,7 @@ class Router:
         """Flip gray-failure soft ejection live (the --overload bench warms
         the fleet with it off, then arms it at the round start so
         time-to-eject is measured from a known instant)."""
-        self._slow_eject = bool(enabled)
+        self._slow_eject = bool(enabled)  # yamt-lint: disable=YAMT019 — bench actuator: single-writer bool flip; the poll loop reads it lock-free by design
 
     def apply_brownout(self, policy) -> None:
         """The router's slice of a :class:`~.brownout.BrownoutPolicy`:

@@ -74,7 +74,7 @@ class WindowedQuantile:
     def read(self) -> float | None:
         counts = self._hist.bucket_counts()
         delta = [a - b for a, b in zip(counts, self._counts_prev)]
-        self._counts_prev = counts
+        self._counts_prev = counts  # yamt-lint: disable=YAMT019 — each reader is single-owner by contract (SignalReader docstring): no concurrent read()
         if sum(delta) == 0:
             return None
         (q,) = quantiles_from_counts(self._hist.bounds, delta, (self.quantile,))
