@@ -10,12 +10,20 @@ from the async ``b``/``e`` events serve/context.py emits, one row per
 request id) and a per-phase quantile table (p50/p95/p99 straight from the
 bucketed registry histograms — the same numbers ``GET /metrics`` exposes).
 
-Usage: python scripts/obs_report.py [--requests] [--max-requests N] <log_dir>
+``--fleet`` renders the FLEET view from a cli/fleet.py run's log_dir: the
+replica-slot layout, the merged cross-process trace if trace_merge.py built
+one (with each lane's clock-alignment offset), and every
+``incident_<reason>.json`` the flight recorder dumped (obs/fleet.py) —
+trigger reason, brownout level, the event-ring census, the federated
+window p99 / SLO burn rates at dump time, and the last ring events.
+
+Usage: python scripts/obs_report.py [--requests] [--fleet] [--max-requests N] <log_dir>
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -90,7 +98,74 @@ def _request_waterfalls(trace_path: str, max_requests: int) -> list[str]:
     return lines
 
 
-def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> str:
+def _fleet_section(log_dir: str) -> list[str]:
+    """The fleet view: replica layout, merged trace, incident artifacts."""
+    lines = ["\n## fleet"]
+    replica_dirs = sorted(
+        d for d in glob.glob(os.path.join(log_dir, "r*")) if os.path.isdir(d))
+    traced = [d for d in replica_dirs
+              if os.path.exists(os.path.join(d, "obs_trace.json"))]
+    lines.append(f"  replica slots: {len(replica_dirs)} "
+                 f"({len(traced)} with traces)")
+    merged = os.path.join(log_dir, "merged_trace.json")
+    if os.path.exists(merged):
+        with open(merged) as f:
+            doc = json.load(f)
+        procs = doc.get("processes", [])
+        lines.append(f"  merged trace: {merged} "
+                     f"({len(doc.get('traceEvents', []))} events, "
+                     f"{len(procs)} process lanes) — open in ui.perfetto.dev")
+        for p in procs:
+            lines.append(f"    {p.get('process_name', '?'):<24} "
+                         f"offset {p.get('offset_us', 0.0) / 1e3:+.3f} ms  "
+                         f"{p.get('file', '')}")
+    elif traced or os.path.exists(os.path.join(log_dir, "obs_trace.json")):
+        lines.append("  merged trace: not built — "
+                     f"python scripts/trace_merge.py {log_dir}")
+    incidents = sorted(glob.glob(os.path.join(log_dir, "incident_*.json")))
+    if not incidents:
+        lines.append("  incidents: none recorded "
+                     "(no ejection / brownout / fast-burn trigger fired)")
+    for path in incidents:
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("events", [])
+        lines.append(f"  !! incident: {os.path.basename(path)} — "
+                     f"reason = {doc.get('reason')}, "
+                     f"brownout L{doc.get('brownout_level', 0)}, "
+                     f"{len(events)} ring events")
+        kinds: dict[str, int] = {}
+        for e in events:
+            kinds[str(e.get("kind", "?"))] = kinds.get(str(e.get("kind", "?")), 0) + 1
+        if kinds:
+            lines.append("    events: " + ", ".join(
+                f"{k} x{v}" for k, v in sorted(kinds.items())))
+        fleet = doc.get("fleet") or {}
+        for cls, v in sorted((fleet.get("window_p99_s") or {}).items()):
+            if v:
+                lines.append(f"    window p99 [{cls}] = {v * 1e3:.2f} ms")
+        slo = fleet.get("slo") or {}
+        if slo:
+            lines.append(
+                f"    slo: burn short {slo.get('burn_short', 0):.2f} / "
+                f"long {slo.get('burn_long', 0):.2f}"
+                f"{' — FAST BURN' if slo.get('fast_burn') else ''} "
+                f"(target p99 {slo.get('target_p99_ms', 0):.0f} ms, "
+                f"budget {slo.get('error_budget', 0):.3g})")
+        reps = fleet.get("replicas") or {}
+        if reps:
+            lines.append(f"    federated replicas at dump: {len(reps)} "
+                         f"({', '.join(sorted(reps))})")
+        for e in events[-5:]:
+            extras = " ".join(f"{k}={v}" for k, v in e.items()
+                              if k not in ("t_unix", "kind"))
+            lines.append(f"    last: {e.get('kind')}"
+                         + (f" {extras}" if extras else ""))
+    return lines
+
+
+def summarize(log_dir: str, requests: bool = False, max_requests: int = 20,
+              fleet: bool = False) -> str:
     lines = [f"# obs report: {log_dir}"]
 
     metrics_path = os.path.join(log_dir, "metrics.jsonl")
@@ -394,6 +469,9 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20) -> s
         else:
             lines.append("  obs_trace.json missing (run with obs.trace=true)")
 
+    if fleet:
+        lines.extend(_fleet_section(log_dir))
+
     return "\n".join(lines)
 
 
@@ -402,13 +480,16 @@ def main(argv=None) -> int:
     ap.add_argument("log_dir", help="a run's train.log_dir")
     ap.add_argument("--requests", action="store_true",
                     help="render per-request waterfalls + per-phase quantile tables")
+    ap.add_argument("--fleet", action="store_true",
+                    help="render the fleet view (merged trace, incident artifacts)")
     ap.add_argument("--max-requests", type=int, default=20,
                     help="waterfall rows to print (oldest ids first)")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.log_dir):
         print(f"obs_report: not a directory: {args.log_dir}", file=sys.stderr)
         return 2
-    print(summarize(args.log_dir, requests=args.requests, max_requests=args.max_requests))
+    print(summarize(args.log_dir, requests=args.requests,
+                    max_requests=args.max_requests, fleet=args.fleet))
     return 0
 
 

@@ -1283,16 +1283,20 @@ def test_fleet_attach_e2e_lease_join_expiry_and_u8_wire(tmp_path):
 
         # rc self-registers via the lease: the fleet grows to 3 with the
         # router having spawned NOTHING
+        # wait for identities too: a leased member is routable at
+        # registration, one poll cycle BEFORE its identity block arrives
         deadline = time.time() + 60
-        health = {}
+        health, idents = {}, set()
         while time.time() < deadline:
             status, health = _get(base + "/healthz")
             if status == 200 and health["fleet"]["routable"] == 3:
-                break
+                idents = {r["identity"].get("replica_id")
+                          for r in health["fleet"]["replicas"]}
+                if idents == {"ra", "rb", "rc"}:
+                    break
             time.sleep(0.2)
         assert health["fleet"]["routable"] == 3, health
         assert health["membership"] == {"static": 2, "leased": 1, "lease_ttl_s": 2.0}
-        idents = {r["identity"].get("replica_id") for r in health["fleet"]["replicas"]}
         assert idents == {"ra", "rb", "rc"}
 
         # uint8 wire through the fleet: raw u8 pixels, X-Dtype: u8
@@ -1373,7 +1377,18 @@ def test_fleet_e2e_kill_minus_9_zero_5xx_and_drain(tmp_path):
     behind the router frontend, serve through it, SIGKILL one replica
     mid-traffic, and assert the availability contract — every request
     answers 200 (the router's transport retry + ejection masks the death),
-    the supervisor restarts the corpse, SIGTERM drains rc=0."""
+    the supervisor restarts the corpse, SIGTERM drains rc=0.
+
+    Extended for fleet observability (ISSUE 17): the run traces every
+    process, a seeded hedged round duplicates requests onto the second
+    replica (p50-derived timer with a 1 ms floor), the router frontend
+    exposes the federated /varz fleet section + replica-labeled fleet_
+    /metrics families, and after the drain scripts/trace_merge.py must
+    join the 3 per-process traces into ONE file where each POST has
+    exactly one router envelope, every replica envelope carries the
+    router-issued request id in args.trace, and at least one hedged
+    request shows BOTH legs flow-linked into two different replica
+    lanes."""
     import jax
 
     from yet_another_mobilenet_series_tpu.config import ModelConfig
@@ -1394,7 +1409,11 @@ def test_fleet_e2e_kill_minus_9_zero_5xx_and_drain(tmp_path):
         [sys.executable, "-m", "yet_another_mobilenet_series_tpu.cli.fleet",
          f"serve.bundle={bundle_dir}", "serve.buckets=[1,4]", "data.image_size=24",
          "serve.fleet.replicas=2", "serve.fleet.poll_interval_s=0.1",
-         "serve.fleet.hedge.min_samples=5", "serve.fleet.hedge.min_timer_ms=50",
+         # an aggressive hedge timer (p50 with a 1 ms floor) so the seeded
+         # round below reliably duplicates legs onto the second replica
+         "serve.fleet.hedge.min_samples=5", "serve.fleet.hedge.quantile=0.5",
+         "serve.fleet.hedge.min_timer_ms=1",
+         "obs.trace=true",  # every process dumps obs_trace.json at drain
          "serve.drain_timeout_s=10", f"train.log_dir={log_dir}"],
         env=dict(os.environ, PYTHONPATH=REPO),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, cwd=REPO,
@@ -1426,8 +1445,10 @@ def test_fleet_e2e_kill_minus_9_zero_5xx_and_drain(tmp_path):
         assert idents == {"r0", "r1"}
 
         img = np.full((24, 24, 3), 1.0, np.float32)
+        n_posts = [0]  # every POST mints one router rid: the trace oracle
 
         def post():
+            n_posts[0] += 1
             req = urllib.request.Request(
                 base + "/predict", data=img.tobytes(),
                 headers={"Content-Type": "application/octet-stream", "X-Shape": "24,24,3"},
@@ -1474,6 +1495,41 @@ def test_fleet_e2e_kill_minus_9_zero_5xx_and_drain(tmp_path):
         assert r0b["pid"] != r0["pid"] and r0b["replica_id"] == "r0"
         assert post() == 200
 
+        # --- seeded hedged round (ISSUE 17): both replicas healthy again,
+        # the p50 timer duplicates ~half the legs — keep posting until the
+        # router's hedge counter moves
+        _, varz = _get(base + "/varz")
+        hedges0 = varz["metrics"].get("serve.hedges", 0)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            assert post() == 200
+            _, varz = _get(base + "/varz")
+            if varz["metrics"].get("serve.hedges", 0) > hedges0:
+                break
+            time.sleep(0.02)
+        assert varz["metrics"].get("serve.hedges", 0) > hedges0, varz["metrics"]
+
+        # federated observability on the router frontend: /varz grows the
+        # fleet section (scrape-loop output over both replicas) + the raw
+        # histogram state, /metrics the replica-labeled fleet_ families
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            _, varz = _get(base + "/varz")
+            if (varz.get("fleet", {}).get("scrapes", 0) >= 1
+                    and len(varz["fleet"].get("replicas", {})) == 2):
+                break
+            time.sleep(0.2)
+        assert varz["fleet"]["scrapes"] >= 1, varz.get("fleet")
+        assert len(varz["fleet"]["replicas"]) == 2, varz["fleet"]
+        assert "histograms" in varz
+        assert "slo" in varz["fleet"]  # the SLO tracker rides the scrape loop
+        with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+            metrics_text = r.read().decode()
+        assert "# TYPE fleet_build_info gauge" in metrics_text
+        assert 'fleet_build_info{replica="r0"' in metrics_text
+        assert 'fleet_build_info{replica="r1"' in metrics_text
+        assert 'fleet_serve_latency_seconds_bucket{replica=' in metrics_text
+
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=90)
         assert rc == 0
@@ -1482,6 +1538,68 @@ def test_fleet_e2e_kill_minus_9_zero_5xx_and_drain(tmp_path):
         snap = json.loads(open(os.path.join(log_dir, "obs_registry.json")).read())
         assert snap["fleet.spawns"] >= 3  # 2 initial + >= 1 restart
         assert snap["fleet.routed"] >= len(statuses)
+
+        # --- merged cross-process trace (scripts/trace_merge.py): router +
+        # both replicas joined into ONE Perfetto doc on a shared timeline
+        import importlib.util
+
+        from yet_another_mobilenet_series_tpu.serve.context import (
+            TRACE_SEQ_HEDGE_BASE, trace_flow_id)
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_merge", os.path.join(REPO, "scripts", "trace_merge.py"))
+        tm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tm)
+        paths = tm.discover(log_dir)
+        assert len(paths) == 3, paths  # router + r0 + r1
+        merged = tm.merge_files(paths)
+        assert "warnings" not in merged, merged.get("warnings")
+        lanes = {p["process_name"]: p["pid"] for p in merged["processes"]}
+        assert set(lanes) == {"router", "r0", "r1"}, lanes
+        ev = merged["traceEvents"]
+
+        # exactly one router serve/request envelope per POST, the merged
+        # (process-scoped) ids recovering the frontend-minted rids 1..N
+        router_envs = {e["id"] for e in ev
+                       if e.get("ph") == "b" and e.get("name") == "serve/request"
+                       and e["pid"] == lanes["router"]}
+        assert len(router_envs) == n_posts[0], (len(router_envs), n_posts[0])
+        router_rids = {i % tm.ID_STRIDE for i in router_envs}
+        assert router_rids == set(range(1, n_posts[0] + 1))
+
+        # every replica-side request envelope carries the ROUTER-issued
+        # request id in args.trace (the cross-process correlation key)
+        rep_pids = {lanes["r0"], lanes["r1"]}
+        rep_envs = [e for e in ev
+                    if e.get("ph") == "b" and e.get("name") == "serve/request"
+                    and e["pid"] in rep_pids]
+        assert rep_envs
+        bad = [e for e in rep_envs
+               if (e.get("args") or {}).get("trace") not in router_rids]
+        assert not bad, [e.get("args") for e in bad[:5]]
+
+        # at least one hedged request reads as one waterfall with BOTH legs:
+        # primary (seq 0) and hedge (seq TRACE_SEQ_HEDGE_BASE) flow-starts
+        # on the router lane whose UNREMAPPED fleet/leg ids land as
+        # flow-ends on two DIFFERENT replica lanes
+        leg_seqs: dict = {}
+        for e in ev:
+            if e.get("name") == "fleet/leg" and e.get("ph") == "s":
+                tid, seq = divmod(e["id"], 2 * TRACE_SEQ_HEDGE_BASE)
+                leg_seqs.setdefault(tid, set()).add(seq)
+        ends = {e["id"]: e["pid"] for e in ev
+                if e.get("name") == "fleet/leg" and e.get("ph") == "f"}
+        hedged = [tid for tid, seqs in leg_seqs.items()
+                  if 0 in seqs and TRACE_SEQ_HEDGE_BASE in seqs]
+        assert hedged, leg_seqs
+        linked = [
+            tid for tid in hedged
+            if trace_flow_id(tid, 0) in ends
+            and trace_flow_id(tid, TRACE_SEQ_HEDGE_BASE) in ends
+            and ends[trace_flow_id(tid, 0)]
+            != ends[trace_flow_id(tid, TRACE_SEQ_HEDGE_BASE)]
+        ]
+        assert linked, {"hedged": hedged, "ends": len(ends)}
     finally:
         if proc.poll() is None:
             proc.kill()

@@ -713,3 +713,435 @@ def test_obs_report_requests_waterfalls_and_quantiles(tmp_path, capsys):
     assert "[completed]" in out
     assert "queue wait" in out and "latency [interactive]" in out
     assert "p50_ms" in out and "p99_ms" in out
+
+
+# ---------------------------------------------------------------------------
+# fleet federation + flight recorder (obs/fleet.py) and trace merge
+# (scripts/trace_merge.py) — ISSUE 17
+# ---------------------------------------------------------------------------
+
+
+class _StubVarz:
+    """A backend whose /varz is a callable — the scrape loop's only client
+    surface (ReplicaClient.varz -> (status, doc))."""
+
+    def __init__(self, doc_fn, status=200):
+        self._doc_fn = doc_fn
+        self._status = status
+        self.calls = 0
+
+    def varz(self, timeout_s=2.0):
+        self.calls += 1
+        if isinstance(self._status, Exception):
+            raise self._status
+        return self._status, self._doc_fn()
+
+
+def _replica_varz(reg, rid, build=None):
+    """A /varz document shaped like serve/frontend.py's, from a registry."""
+    return {
+        "replica": {"replica_id": rid},
+        "build_info": build or {},
+        "metrics": reg.snapshot(),
+        "histograms": reg.histograms_state(),
+        "admission": {"queued_total": 0},
+        "draining": False,
+    }
+
+
+def test_registry_histograms_state_is_raw_and_mergeable():
+    """Histogram.state() ships RAW per-bucket counts (not cumulative) plus
+    bounds/count/sum/min/max — the exact payload quantiles_from_counts
+    consumes, so a scraper recomputes quantiles losslessly."""
+    from yet_another_mobilenet_series_tpu.obs.registry import quantiles_from_counts
+
+    reg = MetricsRegistry()
+    h = reg.histogram("serve.latency_seconds.interactive", bounds=[0.01, 0.1])
+    h.observe(0.005)
+    h.observe(0.5)
+    reg.counter("serve.completed.interactive").inc()  # not a histogram: excluded
+    state = reg.histograms_state()
+    assert set(state) == {"serve.latency_seconds.interactive"}
+    st = state["serve.latency_seconds.interactive"]
+    assert st["bounds"] == [0.01, 0.1]
+    assert st["counts"] == [1, 0, 1]  # raw slots incl. overflow, NOT cumulative
+    assert st["count"] == 2 and st["sum"] == 0.505
+    assert st["min"] == 0.005 and st["max"] == 0.5
+    (p50,) = quantiles_from_counts(st["bounds"], st["counts"], (0.5,),
+                                   vmin=st["min"], vmax=st["max"])
+    assert 0.005 <= p50 <= 0.5
+    assert json.loads(json.dumps(st)) == st  # JSON-safe for /varz
+
+
+def test_fleet_federation_p99_matches_pooled_reference():
+    """The federation-correctness property (ISSUE 17 acceptance): the fleet
+    windowed p99 computed from SUMMED per-replica bucket-count deltas must
+    equal the quantile of one histogram fed every pooled observation —
+    identical ladders make the merge exact, not an average of averages.
+    Includes the edges: a replica with NO histograms at all, and an
+    all-zero window (no traffic between scrapes) reading 0."""
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.obs.fleet import FleetFederation
+    from yet_another_mobilenet_series_tpu.obs.registry import quantiles_from_counts
+
+    regs = [MetricsRegistry() for _ in range(3)]
+    backends = [(f"127.0.0.1:900{i}",
+                 _StubVarz(lambda i=i: _replica_varz(regs[i], f"r{i}")))
+                for i in range(3)]
+    fed = FleetFederation(lambda: backends)
+    rng = np.random.RandomState(11)
+    lat = "serve.latency_seconds.interactive"
+
+    # pre-window history the baseline scrape must consume, NOT leak into
+    # the first window
+    for reg in regs[:2]:
+        for v in np.exp(rng.uniform(np.log(1e-3), np.log(2.0), 50)):
+            reg.histogram(lat).observe(float(v))
+    fed.scrape_once()
+
+    # the window: replicas 0 and 1 observe, replica 2 stays histogram-free
+    window = []
+    for reg in regs[:2]:
+        vs = np.exp(rng.uniform(np.log(1e-3), np.log(2.0), 400))
+        for v in vs:
+            reg.histogram(lat).observe(float(v))
+        window.extend(float(v) for v in vs)
+    summary = fed.scrape_once()
+    assert summary == {"scraped": 3, "errors": 0}
+    assert get_registry().gauge("fleet.federated_replicas").value == 3
+
+    ref = MetricsRegistry().histogram("ref")  # same default ladder
+    for v in window:
+        ref.observe(v)
+    (ref_p99,) = quantiles_from_counts(
+        list(ref.bounds), list(ref.bucket_counts()), (0.99,))
+    fed_p99 = get_registry().gauge("fleet.window_p99_seconds.interactive").value
+    assert fed_p99 == ref_p99  # exact, same interpolation over equal counts
+    assert fed.snapshot()["window_p99_s"]["interactive"] == ref_p99
+
+    # merged CUMULATIVE counts = element-wise sum of both lifetimes so far
+    merged = fed.merged_counts()[lat]
+    per_rep = [list(r.histogram(lat).bucket_counts()) for r in regs[:2]]
+    assert merged["counts"] == [a + b for a, b in zip(*per_rep)]
+
+    # all-zero window: no traffic between scrapes reads a 0 gauge, not NaN
+    fed.scrape_once()
+    assert get_registry().gauge("fleet.window_p99_seconds.interactive").value == 0.0
+
+
+def test_fleet_federation_replica_restart_not_double_counted():
+    """Counter-reset handling: a replica restart zeroes its histograms; the
+    merged cumulative counts must carry BOTH lifetimes exactly once (a
+    naive cumulative sum would lose the first or double the second)."""
+    from yet_another_mobilenet_series_tpu.obs.fleet import FleetFederation
+
+    lat = "serve.latency_seconds.interactive"
+    holder = {"reg": MetricsRegistry()}
+    backends = [("127.0.0.1:9000",
+                 _StubVarz(lambda: _replica_varz(holder["reg"], "r0")))]
+    fed = FleetFederation(lambda: backends)
+    for _ in range(10):
+        holder["reg"].histogram(lat).observe(0.01)
+    fed.scrape_once()
+    holder["reg"] = MetricsRegistry()  # kill -9 + respawn: fresh process
+    for _ in range(4):
+        holder["reg"].histogram(lat).observe(0.01)
+    fed.scrape_once()
+    assert sum(fed.merged_counts()[lat]["counts"]) == 14
+
+    # a dead backend is a skipped scrape, never an exception out of the loop
+    backends.append(("127.0.0.1:9001", _StubVarz(None, status=OSError("down"))))
+    summary = fed.scrape_once()
+    assert summary == {"scraped": 1, "errors": 1}
+
+
+def test_fleet_federation_slo_feed_and_fast_burn_incident(tmp_path):
+    """The scrape loop feeds summed completed/bad deltas into the SLO
+    tracker; sustained burn over BOTH windows trips fast_burn, which arms
+    the flight recorder and the dump names the reason."""
+    from yet_another_mobilenet_series_tpu.obs.fleet import FleetFederation, FlightRecorder
+    from yet_another_mobilenet_series_tpu.serve.signals import SLOTracker
+
+    t = [0.0]
+    slo = SLOTracker(error_budget=0.1, short_window_s=5.0, long_window_s=50.0,
+                     fast_burn=2.0, clock=lambda: t[0])
+    reg = MetricsRegistry()
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0)
+    fed = FleetFederation(
+        lambda: [("a", _StubVarz(lambda: _replica_varz(reg, "ra")))],
+        slo=slo, recorder=rec)
+    fed.scrape_once()  # baseline
+    for _ in range(60):  # 50% bad at a 10% budget = 5x burn, both windows
+        t[0] += 1.0
+        reg.counter("serve.completed.interactive").inc(5)
+        reg.counter("serve.rejected.interactive").inc(5)
+        fed.scrape_once()
+    assert slo.fast_burn
+    assert get_registry().gauge("fleet.slo_burn_rate.short").value >= 2.0
+    path = rec.maybe_dump(fed)
+    assert path and os.path.basename(path) == "incident_slo_fast_burn.json"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "slo_fast_burn"
+    assert "fleet" in doc and "replica_varz" in doc
+    assert doc["fleet"]["slo"]["fast_burn"] is True
+    assert any(e["kind"] == "trigger" for e in doc["events"])
+
+
+def test_fleet_render_prometheus_golden():
+    """Replica-labeled exposition golden: every replica's histograms under
+    the fleet_ namespace (cumulative buckets, le labels, per-family TYPE
+    once), build_info from every replica under ONE family, deterministic
+    ordering — the exact text the router frontend appends to /metrics."""
+    from yet_another_mobilenet_series_tpu.obs.fleet import FleetFederation
+
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    r0.histogram("serve.latency_seconds.interactive", bounds=[0.01, 0.1]).observe(0.005)
+    r0.histogram("serve.queue_wait_seconds", bounds=[0.01]).observe(0.005)
+    r1.histogram("serve.latency_seconds.interactive", bounds=[0.01, 0.1]).observe(0.5)
+    backends = [
+        ("127.0.0.1:9000", _StubVarz(lambda: _replica_varz(
+            r0, "r0", build={"git_sha": "abc", "platform": "cpu"}))),
+        ("127.0.0.1:9001", _StubVarz(lambda: _replica_varz(
+            r1, "r1", build={"git_sha": "abc", "platform": "cpu"}))),
+    ]
+    fed = FleetFederation(lambda: backends)
+    assert fed.render_prometheus() == ""  # nothing scraped yet
+    fed.scrape_once()
+    golden = "\n".join([
+        '# TYPE fleet_build_info gauge',
+        'fleet_build_info{replica="r0",git_sha="abc",platform="cpu"} 1',
+        'fleet_build_info{replica="r1",git_sha="abc",platform="cpu"} 1',
+        '# TYPE fleet_serve_latency_seconds histogram',
+        'fleet_serve_latency_seconds_bucket{replica="r0",class="interactive",le="0.01"} 1',
+        'fleet_serve_latency_seconds_bucket{replica="r0",class="interactive",le="0.1"} 1',
+        'fleet_serve_latency_seconds_bucket{replica="r0",class="interactive",le="+Inf"} 1',
+        'fleet_serve_latency_seconds_sum{replica="r0",class="interactive"} 0.005',
+        'fleet_serve_latency_seconds_count{replica="r0",class="interactive"} 1',
+        '# TYPE fleet_serve_queue_wait_seconds histogram',
+        'fleet_serve_queue_wait_seconds_bucket{replica="r0",le="0.01"} 1',
+        'fleet_serve_queue_wait_seconds_bucket{replica="r0",le="+Inf"} 1',
+        'fleet_serve_queue_wait_seconds_sum{replica="r0"} 0.005',
+        'fleet_serve_queue_wait_seconds_count{replica="r0"} 1',
+        'fleet_serve_latency_seconds_bucket{replica="r1",class="interactive",le="0.01"} 0',
+        'fleet_serve_latency_seconds_bucket{replica="r1",class="interactive",le="0.1"} 0',
+        'fleet_serve_latency_seconds_bucket{replica="r1",class="interactive",le="+Inf"} 1',
+        'fleet_serve_latency_seconds_sum{replica="r1",class="interactive"} 0.5',
+        'fleet_serve_latency_seconds_count{replica="r1",class="interactive"} 1',
+    ]) + "\n"
+    assert fed.render_prometheus() == golden
+
+
+def test_slo_tracker_two_window_gating_and_pruning():
+    """Multi-window burn-rate semantics: a short error burst saturates the
+    SHORT window but the long window's healthy history gates the alarm;
+    sustained burn floods both and trips fast_burn. Ticks prune past the
+    long window."""
+    from yet_another_mobilenet_series_tpu.serve.signals import SLOTracker
+
+    t = [0.0]
+    s = SLOTracker(target_p99_ms=100.0, error_budget=0.01, short_window_s=10.0,
+                   long_window_s=100.0, fast_burn=14.0, clock=lambda: t[0])
+    for _ in range(90):
+        t[0] += 1.0
+        s.observe(100, 0, p99_s=0.05)
+    assert s.burn_rate(10.0) == 0.0 and not s.fast_burn
+    for _ in range(4):  # the burst: 50% errors at a 1% budget
+        t[0] += 1.0
+        s.observe(100, 50, p99_s=0.05)
+    assert s.burn_rate(10.0) >= 14.0
+    assert s.burn_rate(100.0) < 14.0
+    assert not s.fast_burn  # gated by the long window
+    for _ in range(100):  # sustained: both windows saturate
+        t[0] += 1.0
+        s.observe(100, 50, p99_s=0.05)
+    assert s.fast_burn
+    st = s.state()
+    assert st["fast_burn"] and st["burn_short"] >= 14.0 and st["burn_long"] >= 14.0
+    assert st["ticks"] <= 101  # pruned to the long window
+
+
+def test_slo_tracker_latency_breach_burns_budget():
+    """A p99 above target burns budget even with zero errors: the latency
+    burn is the breached-tick fraction over the window / budget."""
+    from yet_another_mobilenet_series_tpu.serve.signals import SLOTracker
+
+    t = [0.0]
+    s = SLOTracker(target_p99_ms=100.0, error_budget=0.1, short_window_s=10.0,
+                   long_window_s=100.0, clock=lambda: t[0])
+    for _ in range(10):
+        t[0] += 1.0
+        s.observe(100, 0, p99_s=0.5)  # 5x over target, no errors
+    assert s.burn_rate(10.0) == 10.0  # every tick breached / 0.1 budget
+    with pytest.raises(ValueError):
+        SLOTracker(error_budget=0.0)
+    with pytest.raises(ValueError):
+        SLOTracker(short_window_s=60.0, long_window_s=30.0)
+
+
+def test_flight_recorder_ring_triggers_and_rate_limit(tmp_path):
+    """Ring semantics + arming: only trigger kinds arm a dump, the rate
+    limiter keeps an armed trigger pending (never drops it), a dump
+    disarms, and the ring is bounded."""
+    from yet_another_mobilenet_series_tpu.obs.fleet import FlightRecorder
+
+    rec = FlightRecorder(str(tmp_path), ring=8, min_interval_s=3600.0)
+    assert rec.maybe_dump() is None  # nothing armed
+    rec.record("hedge_outcome", winner="hedge")  # significant but not a trigger
+    assert rec.maybe_dump() is None
+    rec.record("ejection", replica="127.0.0.1:9000", consecutive_failures=2)
+    p = rec.maybe_dump()
+    assert p and os.path.basename(p) == "incident_ejection.json"
+    with open(p) as f:
+        doc = json.load(f)
+    assert [e["kind"] for e in doc["events"]] == ["hedge_outcome", "ejection"]
+    assert all("t_unix" in e for e in doc["events"])
+    assert "registry" in doc and "fleet" not in doc  # no federation passed
+    # rate-limited: the new trigger stays ARMED until the limiter reopens
+    rec.record("lease_expired", replica="127.0.0.1:9001")
+    assert rec.maybe_dump() is None
+    rec.min_interval_s = 0.0
+    p2 = rec.maybe_dump()
+    assert p2 and os.path.basename(p2) == "incident_lease_expired.json"
+    assert rec.maybe_dump() is None  # disarmed by the dump
+    for i in range(50):
+        rec.record("breaker_flip", state=i % 3)
+    assert len(rec.events()) == 8  # bounded ring
+
+
+def test_flight_recorder_brownout_arming(tmp_path):
+    """The recorder is a brownout TARGET: transitions land in the ring, a
+    climb to incident_level arms a dump, recovery back down does not."""
+    from yet_another_mobilenet_series_tpu.obs.fleet import FlightRecorder
+
+    class _Policy:
+        def __init__(self, level):
+            self.level = level
+            self.shed_classes = {"batch"} if level >= 3 else set()
+            self.hedging = level < 1
+
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0, incident_level=3)
+    rec.apply_brownout(_Policy(1))
+    rec.apply_brownout(_Policy(1))  # same level: no duplicate event
+    assert rec.maybe_dump() is None  # below incident_level
+    rec.apply_brownout(_Policy(3))
+    p = rec.maybe_dump()
+    assert p and os.path.basename(p) == "incident_brownout_l3.json"
+    with open(p) as f:
+        doc = json.load(f)
+    trans = [e for e in doc["events"] if e["kind"] == "brownout_transition"]
+    assert [e["level"] for e in trans] == [1, 3]
+    assert trans[-1]["shed_classes"] == ["batch"]
+    rec.apply_brownout(_Policy(4))
+    assert rec.maybe_dump() is not None
+    rec.apply_brownout(_Policy(3))  # recovery DOWN through the level
+    assert rec.maybe_dump() is None
+
+
+def _trace_merge_mod():
+    spec = importlib.util.spec_from_file_location(
+        "trace_merge", os.path.join(REPO, "scripts", "trace_merge.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_merge_aligns_clocks_and_scopes_ids():
+    """The merge invariants: wall-origin offsets shift every non-metadata
+    event onto the earliest process's timeline, colliding pids get their
+    own lanes, per-process async/flow ids are remapped so equal request
+    ids never fuse across processes — EXCEPT fleet/leg flows, whose ids
+    are the cross-process arrow and must survive untouched."""
+    tm = _trace_merge_mod()
+    router = {
+        "pid": 100, "process_name": "router", "origin_unix": 1000.0,
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 100, "tid": 0, "ts": 0},
+            {"ph": "b", "cat": "serve", "name": "serve/request", "id": 5,
+             "pid": 100, "tid": 1, "ts": 10.0},
+            {"ph": "s", "cat": "serve", "name": "fleet/leg", "id": 80,
+             "pid": 100, "tid": 1, "ts": 12.0, "args": {"trace": 5, "leg": "primary"}},
+        ],
+    }
+    replica = {
+        "pid": 100, "process_name": "r0", "origin_unix": 1000.5,  # pid collision
+        "traceEvents": [
+            {"ph": "b", "cat": "serve", "name": "serve/request", "id": 5,
+             "pid": 100, "tid": 1, "ts": 3.0, "args": {"trace": 5}},
+            {"ph": "f", "bp": "e", "cat": "serve", "name": "fleet/leg", "id": 80,
+             "pid": 100, "tid": 1, "ts": 4.0},
+        ],
+    }
+    merged = tm.merge([router, replica], sources=["router.json", "r0.json"])
+    assert "warnings" not in merged
+    procs = {p["process_name"]: p for p in merged["processes"]}
+    assert procs["router"]["pid"] == 100
+    assert procs["r0"]["pid"] != 100  # collision remapped to its own lane
+    assert procs["router"]["offset_us"] == 0.0
+    assert procs["r0"]["offset_us"] == 500000.0  # +0.5 s wall-origin gap
+    ev = {(e["pid"], e["ph"], e["name"]): e for e in merged["traceEvents"]}
+    rpid = procs["r0"]["pid"]
+    assert ev[(rpid, "b", "serve/request")]["ts"] == 3.0 + 500000.0
+    assert ev[(100, "M", "process_name")]["ts"] == 0  # metadata never shifts
+    a = ev[(100, "b", "serve/request")]["id"]
+    b = ev[(rpid, "b", "serve/request")]["id"]
+    assert a != b  # raw id 5 no longer fuses across processes
+    assert a % tm.ID_STRIDE == 5 and b % tm.ID_STRIDE == 5
+    assert ev[(100, "s", "fleet/leg")]["id"] == 80
+    assert ev[(rpid, "f", "fleet/leg")]["id"] == 80  # the arrow survives
+
+
+def test_trace_merge_cli_discovers_writes_and_warns(tmp_path, capsys):
+    """main(): discovers the fleet layout (router + r*/ sorted), writes
+    merged_trace.json atomically, prints the process table, and a doc
+    missing origin_unix degrades to a warning, never a crash."""
+    tm = _trace_merge_mod()
+    doc = {"pid": 1, "process_name": "router", "origin_unix": 5.0,
+           "traceEvents": [{"ph": "X", "name": "x", "pid": 1, "tid": 1,
+                            "ts": 1.0, "dur": 2.0}]}
+    (tmp_path / "obs_trace.json").write_text(json.dumps(doc))
+    for i, origin in enumerate((5.25, None)):
+        d = dict(doc, pid=2 + i, process_name=f"r{i}")
+        if origin is None:
+            d.pop("origin_unix")
+        else:
+            d["origin_unix"] = origin
+        (tmp_path / f"r{i}").mkdir()
+        (tmp_path / f"r{i}" / "obs_trace.json").write_text(json.dumps(d))
+    assert tm.main([str(tmp_path)]) == 0
+    printed = capsys.readouterr()
+    out = json.load(open(tmp_path / "merged_trace.json"))
+    assert [p["process_name"] for p in out["processes"]] == ["router", "r0", "r1"]
+    assert [p["offset_us"] for p in out["processes"]] == [0.0, 250000.0, 0.0]
+    assert len(out["warnings"]) == 1 and "r1" in out["warnings"][0]
+    assert "merged_trace.json" in printed.out
+    # a dir with no traces is a clean usage error
+    (tmp_path / "empty").mkdir()
+    assert tm.main([str(tmp_path / "empty")]) == 2
+
+
+def test_obs_report_fleet_section(tmp_path, capsys):
+    """--fleet renders replica layout, the merged-trace pointer, and the
+    incident artifact census (reason, event kinds, SLO state)."""
+    (tmp_path / "r0").mkdir()
+    (tmp_path / "r0" / "obs_trace.json").write_text(json.dumps({"traceEvents": []}))
+    (tmp_path / "incident_ejection.json").write_text(json.dumps({
+        "reason": "ejection", "t_unix": 1000.0, "brownout_level": 0,
+        "events": [{"t_unix": 999.0, "kind": "ejection", "replica": "127.0.0.1:9001"}],
+        "registry": {},
+        "fleet": {"replicas": {"127.0.0.1:9000": {}}, "window_p99_s": {"interactive": 0.012},
+                  "scrapes": 5, "scrape_errors": 0,
+                  "slo": {"burn_short": 1.5, "burn_long": 0.2, "fast_burn": False,
+                          "target_p99_ms": 250.0, "error_budget": 0.01}},
+    }))
+    rc = _obs_report_mod().main([str(tmp_path), "--fleet"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "## fleet" in out
+    assert "replica slots: 1 (1 with traces)" in out
+    assert "trace_merge.py" in out  # merged trace not built yet: the hint
+    assert "incident_ejection.json" in out and "reason = ejection" in out
+    assert "ejection x1" in out
+    assert "window p99 [interactive] = 12.00 ms" in out
+    assert "burn short 1.50" in out

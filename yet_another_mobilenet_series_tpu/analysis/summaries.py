@@ -96,32 +96,42 @@ def compute(project, out: dict[str, FunctionSummary]) -> None:
     for fi in infos:
         out[fi.qualname] = FunctionSummary(fi)
 
+    # per-function call-site lists are STATIC across fixpoint rounds: the
+    # walk, the qualified-name lookup, and the enclosing scope never change
+    # — only call-target resolution sharpens round over round. Precomputing
+    # them once keeps later rounds to pure resolution work.
+    sites: dict[str, list] = {}
+    for fi in infos:
+        src = fi.module.src
+        aliases = src.aliases
+        sites[fi.qualname] = [
+            (node, qualified_name(node.func, aliases), cg.enclosing_scope(src, node))
+            for node in ast.walk(fi.node)
+            if isinstance(node, ast.Call)
+        ]
+
     for _ in range(_MAX_ROUNDS):
         changed = False
         for fi in infos:
             s = out[fi.qualname]
-            changed |= _scan_function(project, cg, fi, s)
+            changed |= _scan_function(project, cg, fi, s, sites[fi.qualname])
         if not changed:
             break
 
 
-def _scan_function(project, cg, fi: FunctionInfo, s: FunctionSummary) -> bool:
+def _scan_function(project, cg, fi: FunctionInfo, s: FunctionSummary, sites) -> bool:
     src = fi.module.src
     params = fi.all_params
     pos = fi.pos_params
     changed = False
 
-    for node in ast.walk(fi.node):
-        if not isinstance(node, ast.Call):
-            continue
-        q = qualified_name(node.func, src.aliases)
+    for node, q, scope in sites:
         if q and q.startswith("jax.random.") and q.rsplit(".", 1)[-1] not in _NON_KEY_FIRST_ARG:
             if node.args and isinstance(node.args[0], ast.Name) and node.args[0].id in params:
                 if node.args[0].id not in s.key_params:
                     s.key_params.add(node.args[0].id)
                     changed = True
             continue
-        scope = cg.enclosing_scope(src, node)
         target = cg.resolve_call(src, node, scope)
         if target is None:
             continue

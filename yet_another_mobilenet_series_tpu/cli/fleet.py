@@ -50,13 +50,15 @@ from ..config import Config, parse_cli
 from ..obs import device as obs_device
 from ..obs import registry as obs_registry
 from ..obs import trace as obs_trace
+from ..obs.fleet import FleetFederation, FlightRecorder
+from ..obs.watchdog import StallWatchdog
 from ..serve.autoscale import Autoscaler
 from ..serve.brownout import BrownoutController
 from ..serve.frontend import Frontend, write_listen_addr
 from ..serve.hedge import ROUTER_LATENCY, Hedger
 from ..serve.netchaos import NetChaosTier
 from ..serve.router import Router
-from ..serve.signals import SignalReader
+from ..serve.signals import SignalReader, SLOTracker
 from ..utils.logging import Logger, emit
 
 # repo root (the package's parent): child interpreters must resolve the
@@ -663,8 +665,10 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         reg.set_default_buckets(cfg.obs.histogram_buckets)
     reg.set_build_info(obs_device.build_info())  # no jax import: versions + git sha
     log.set_registry(reg)
-    tracer = obs_trace.configure(enabled=bool(cfg.obs.trace), ring_size=cfg.obs.trace_ring_size)
+    tracer = obs_trace.configure(enabled=bool(cfg.obs.trace), ring_size=cfg.obs.trace_ring_size,
+                                 process_name="router")
     fc = cfg.serve.fleet
+    fobs = fc.obs
     stop_event = threading.Event()
     rolling_event = threading.Event()
 
@@ -704,6 +708,33 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         slow_min_ms=fc.slow_eject.min_ms,
         lat_alpha=fc.slow_eject.lat_alpha,
     ).start()
+    # fleet observability (obs/fleet.py): the incident flight recorder is
+    # the router's event sink, and the federation scrape-merges every live
+    # replica's /varz into fleet-level families on the supervisor loop
+    recorder = None
+    if fobs.flight_recorder and cfg.train.log_dir:
+        recorder = FlightRecorder(
+            cfg.train.log_dir,
+            ring=fobs.recorder_ring,
+            min_interval_s=fobs.recorder_min_interval_s,
+            incident_level=fobs.incident_brownout_level,
+        )
+        router.set_event_sink(recorder.record)
+    federation = None
+    if fobs.federate:
+        federation = FleetFederation(
+            router.backends,
+            slo=SLOTracker(
+                target_p99_ms=fobs.slo_target_p99_ms,
+                error_budget=fobs.slo_error_budget,
+                short_window_s=fobs.slo_short_window_s,
+                long_window_s=fobs.slo_long_window_s,
+                fast_burn=fobs.slo_fast_burn,
+            ),
+            recorder=recorder,
+            signal_classes=(cfg.serve.brownout.signal_class,),
+            scrape_timeout_s=fobs.scrape_timeout_s,
+        )
     # netchaos proxy tier (serve/netchaos.py): the router only ever speaks
     # to supervised replicas THROUGH their per-link fault proxies, so the
     # partition chaos mode (and the serve_bench partition rounds) can
@@ -742,7 +773,7 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
             logger=log,
         )
     result: dict = {}
-    frontend = autoscaler = chaos = brownout = None
+    frontend = autoscaler = chaos = brownout = watchdog = None
     try:
         if fleet is not None:
             fleet.start()
@@ -752,6 +783,7 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
             port=cfg.serve.listen.port,
             request_timeout_s=cfg.serve.listen.request_timeout_s,
             replica_id=cfg.serve.listen.replica_id or "router",
+            federation=federation,
         ).start()
         n_replicas = fleet.n_replicas if fleet is not None else len(attach)
         addr = {"host": cfg.serve.listen.host, "port": frontend.port, "pid": os.getpid(),
@@ -787,7 +819,10 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
                     signal_class=cfg.serve.brownout.signal_class,
                     queue_depth_fn=router.mean_queue_depth,
                 ),
-                targets=(router,),
+                # the flight recorder is a brownout TARGET too: level
+                # transitions land in the event ring, and climbing to
+                # incident_brownout_level arms an incident dump
+                targets=(router,) + ((recorder,) if recorder is not None else ()),
             ).start()
             log.log(f"brownout ladder armed at the router tier "
                     f"(L0..L{cfg.serve.brownout.max_level})")
@@ -805,7 +840,42 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
             ).start()
             log.log(f"CHAOS: replica {fc.chaos.mode} on (seed={fc.chaos.seed}, "
                     f"after={fc.chaos.kill_after_s}s, period={fc.chaos.kill_period_s}s)")
+        # fleet-tier stall watchdog: the supervisor loop heartbeats every
+        # tick, so a wedged ROUTER process dumps a hang report that names
+        # the fleet's state — replica table (weights/ejection), lease ages,
+        # brownout level, and the oldest in-flight router request
+        if cfg.obs.watchdog_deadline_s > 0 and cfg.train.log_dir:
+            watchdog = StallWatchdog(
+                cfg.train.log_dir,
+                cfg.obs.watchdog_deadline_s,
+                tracer=tracer,
+                registry=reg,
+                poll_s=cfg.obs.watchdog_poll_s,
+                logger=log,
+            )
+            watchdog.register_info("fleet", lambda: {
+                "replicas": router.replicas_state(),
+                "lease_ages_s": router.lease_ages(),
+                "brownout_level": int(reg.gauge("serve.brownout_level").value),
+                "oldest_request": router.oldest_inflight(),
+            })
+            if federation is not None:
+                watchdog.register_info("federation", federation.snapshot)
+            watchdog.start()
+        # federation cadence: its own interval, or ride the router's poll
+        scrape_every = fobs.scrape_interval_s or fc.poll_interval_s
+        next_scrape = time.monotonic()
         while not stop_event.wait(0.2):
+            if watchdog is not None:
+                watchdog.arm(phase="serve")
+            now = time.monotonic()
+            if federation is not None and now >= next_scrape:
+                next_scrape = now + scrape_every
+                federation.scrape_once()
+            if recorder is not None:
+                incident = recorder.maybe_dump(federation)
+                if incident:
+                    log.log(f"INCIDENT dumped: {incident}")
             if rolling_event.is_set():
                 rolling_event.clear()
                 if fleet is None:
@@ -817,6 +887,12 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
         result.update({"listened": True, **addr})
     finally:
         t0 = time.perf_counter()
+        if recorder is not None:
+            # an armed trigger must not be lost to shutdown: one last dump
+            # attempt with the latest federated view, then tear down
+            recorder.maybe_dump(federation)
+        if watchdog is not None:
+            watchdog.stop()
         if chaos is not None:
             chaos.stop()
         if brownout is not None:

@@ -360,7 +360,12 @@ def run(cfg: Config) -> dict:
     reg.set_build_info(obs_device.build_info())
     obs_device.install_memory_gauges(reg)
     log.set_registry(reg)
-    tracer = obs_trace.configure(enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size)
+    tracer = obs_trace.configure(
+        enabled=bool(cfg.obs.trace) and is_coord, ring_size=cfg.obs.trace_ring_size,
+        # the merged fleet trace's process-lane label (trace_merge.py):
+        # replicas identify by their supervisor-assigned replica_id
+        process_name=cfg.serve.listen.replica_id or f"replica pid-{os.getpid()}",
+    )
     result: dict = {}
     try:
         bundle_dir = cfg.serve.bundle

@@ -662,6 +662,54 @@ class SlowEjectConfig:
 
 
 @dataclass(frozen=True)
+class FleetObsConfig:
+    """Fleet-wide observability (obs/fleet.py, docs/OBSERVABILITY.md "Fleet
+    observability"): the router supervisor's /varz scrape-and-merge loop
+    over every live replica (federated fleet metrics on the router's
+    /metrics), the multi-window SLO burn-rate tracker over the federated
+    signals, and the incident flight recorder that dumps a bounded event
+    ring + fleet snapshot on ejections, deep brownout, or SLO fast-burn."""
+
+    # scrape-merge every replica's /varz into fleet-level families
+    federate: bool = True
+    # scrape cadence; 0 = ride the router's poll_interval_s
+    scrape_interval_s: float = 0.0
+    # per-scrape /varz read bound (a wedged replica skips a tick, never
+    # stalls the supervisor loop)
+    scrape_timeout_s: float = 2.0
+    # SLO: target tail for the signal class + the error budget (bad-request
+    # fraction) the burn rate is measured against
+    slo_target_p99_ms: float = 250.0
+    slo_error_budget: float = 0.01
+    # multi-window burn-rate alerting: fast-burn fires only when BOTH the
+    # short and the long window burn past slo_fast_burn x budget rate
+    slo_short_window_s: float = 30.0
+    slo_long_window_s: float = 300.0
+    slo_fast_burn: float = 14.0
+    # incident flight recorder: event-ring capacity, dump rate limit, and
+    # the brownout level that triggers a dump on the way up
+    flight_recorder: bool = True
+    recorder_ring: int = 256
+    recorder_min_interval_s: float = 30.0
+    incident_brownout_level: int = 3
+
+    def __post_init__(self):
+        if not 0.0 < self.slo_error_budget < 1.0:
+            raise ValueError(
+                f"fleet.obs.slo_error_budget must be in (0, 1), got {self.slo_error_budget}")
+        if not 0.0 < self.slo_short_window_s < self.slo_long_window_s:
+            raise ValueError(
+                "fleet.obs needs 0 < slo_short_window_s < slo_long_window_s, got "
+                f"{self.slo_short_window_s}/{self.slo_long_window_s}")
+        if self.slo_fast_burn <= 0:
+            raise ValueError(
+                f"fleet.obs.slo_fast_burn must be > 0, got {self.slo_fast_burn}")
+        if self.recorder_ring < 8:
+            raise ValueError(
+                f"fleet.obs.recorder_ring must be >= 8, got {self.recorder_ring}")
+
+
+@dataclass(frozen=True)
 class FleetConfig:
     """Replica fleet (cli/fleet.py + serve/router.py): N cli/serve.py
     --listen subprocesses on ephemeral ports behind one router frontend —
@@ -718,6 +766,9 @@ class FleetConfig:
     # socket-level network chaos: the TCP fault proxy tier between router
     # and replicas (serve/netchaos.py; chaos mode="partition" drives it)
     netchaos: NetChaosConfig = field(default_factory=NetChaosConfig)
+    # fleet-wide observability: /varz federation, SLO burn rate, and the
+    # incident flight recorder (obs/fleet.py)
+    obs: FleetObsConfig = field(default_factory=FleetObsConfig)
 
 
 @dataclass(frozen=True)
@@ -996,6 +1047,7 @@ _SECTION_TYPES = {
     "FleetChaosConfig": FleetChaosConfig,
     "NetChaosConfig": NetChaosConfig,
     "SlowEjectConfig": SlowEjectConfig,
+    "FleetObsConfig": FleetObsConfig,
     "FleetConfig": FleetConfig,
     "BrownoutConfig": BrownoutConfig,
     "QuantConfig": QuantConfig,

@@ -64,6 +64,11 @@ PROM_LABEL_FAMILIES: dict[str, str] = {
     "serve.router.latency_seconds": "class",
     # brownout ladder transitions split by direction (up = degrading)
     "serve.brownout_transitions": "direction",
+    # fleet-federated derived gauges (obs/fleet.py): windowed fleet-wide
+    # p99 per class from exactly-merged replica bucket counts, and the SLO
+    # tracker's burn rate per window (short/long — serve/signals.py)
+    "fleet.window_p99_seconds": "class",
+    "fleet.slo_burn_rate": "window",
 }
 
 
@@ -153,6 +158,22 @@ class Histogram:
         slot. Consistent snapshot: taken under the observe lock."""
         with self._lock:
             return tuple(self._bucket_counts)
+
+    def state(self) -> dict:
+        """The RAW mergeable state — bounds, non-cumulative counts, running
+        count/sum/min/max — as one consistent JSON-safe snapshot. This is
+        what /varz ships for metrics federation (obs/fleet.py): identical
+        fixed bucket ladders make the cross-replica merge an exact count
+        sum, so fleet quantiles lose nothing the per-replica ones had."""
+        with self._lock:
+            return {
+                "bounds": list(self.bounds),
+                "counts": list(self._bucket_counts),
+                "count": self.count,
+                "sum": self.total,
+                "min": self.vmin if self.count else None,
+                "max": self.vmax if self.count else None,
+            }
 
     def _quantiles_locked(self, qs: Sequence[float]) -> list[float]:
         return quantiles_from_counts(
@@ -313,6 +334,15 @@ class MetricsRegistry:
             else:
                 out[name] = float(m.value)
         return out
+
+    def histograms_state(self) -> dict[str, dict]:
+        """``{name: Histogram.state()}`` for every histogram — the /varz
+        federation section a fleet scraper merges exactly (bucket ladders
+        are fixed, so summing counts across replicas is lossless)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        return {name: m.state() for name, m in sorted(metrics.items())
+                if isinstance(m, Histogram)}
 
     def render_prometheus(self) -> str:
         """Prometheus text exposition (version 0.0.4) of the whole registry

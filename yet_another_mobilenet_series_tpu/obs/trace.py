@@ -87,9 +87,14 @@ class _Span:
 
 
 class SpanTracer:
-    def __init__(self, ring_size: int = 4096, enabled: bool = True):
+    def __init__(self, ring_size: int = 4096, enabled: bool = True,
+                 process_name: str = "yamt coordinator"):
         self.enabled = enabled
         self.ring_size = ring_size
+        # the Perfetto process-row label: "router" for the fleet supervisor,
+        # the replica_id for serving replicas — a merged cross-process trace
+        # (scripts/trace_merge.py) needs each process to say who it is
+        self.process_name = process_name
         # completed events: (ph, name, cat, t0_ns, dur_ns, tid, args, ev_id)
         # — ph "X" for duration spans (dur_ns set), "b"/"e" async and
         # "s"/"t"/"f" flow events (ev_id set, dur 0)
@@ -100,6 +105,15 @@ class SpanTracer:
         # tid -> human name for Perfetto thread_name metadata rows
         self._thread_names: dict[int, str] = {}
         self._origin_ns = time.perf_counter_ns()
+        # wall-clock anchor sampled ADJACENT to the monotonic origin: every
+        # event ts is relative to _origin_ns, so origin_unix is the one wall
+        # timestamp that places this process's whole trace on a shared
+        # timeline. trace_merge.py aligns N processes by differencing their
+        # origins — error is bounded by inter-host wall skew plus the
+        # sub-microsecond gap between these two adjacent clock reads.
+        # Identity/alignment use only, never differenced into a duration
+        # within one process (the YAMT017 hazard is same-process intervals).
+        self.origin_unix = time.time()
         self._pid = os.getpid()
 
     # -- hot path -----------------------------------------------------------
@@ -216,7 +230,7 @@ class SpanTracer:
                 "pid": self._pid,
                 "tid": 0,
                 "ts": 0,
-                "args": {"name": "yamt coordinator"},
+                "args": {"name": self.process_name},
             }
         ]
         for tid, name in sorted(self._thread_names.items()):
@@ -248,7 +262,15 @@ class SpanTracer:
             if args:
                 ev["args"] = args
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            # cross-process alignment block (scripts/trace_merge.py): which
+            # process wrote this file and where its ts=0 sits on the wall
+            "pid": self._pid,
+            "process_name": self.process_name,
+            "origin_unix": self.origin_unix,
+        }
 
     def write(self, path: str) -> str:
         """Atomically write the Chrome-trace JSON next to the run's logs."""
@@ -269,8 +291,12 @@ def get_tracer() -> SpanTracer:
     return _TRACER
 
 
-def configure(enabled: bool, ring_size: int = 4096) -> SpanTracer:
-    """Install the process tracer (cli/train.py, coordinator only)."""
+def configure(enabled: bool, ring_size: int = 4096,
+              process_name: str = "yamt coordinator") -> SpanTracer:
+    """Install the process tracer (cli/train.py, coordinator only).
+    ``process_name`` labels this process's Perfetto row — serving processes
+    pass their role ("router") or replica_id so a merged fleet trace reads
+    as named process lanes, not anonymous pids."""
     global _TRACER
-    _TRACER = SpanTracer(ring_size=ring_size, enabled=enabled)
+    _TRACER = SpanTracer(ring_size=ring_size, enabled=enabled, process_name=process_name)
     return _TRACER

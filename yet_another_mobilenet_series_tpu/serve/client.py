@@ -265,6 +265,7 @@ class ReplicaClient:
 
     def predict(self, image: np.ndarray, *, priority: str | None = None,
                 deadline_ms: float | None = None, request_id: str | None = None,
+                trace_parent: str | None = None,
                 timeout_s: float | None = None) -> np.ndarray:
         """POST one (H, W, C) image; returns the logits row. Raises the
         typed hierarchy above on every failure mode. A uint8 array rides
@@ -285,6 +286,11 @@ class ReplicaClient:
             headers["X-Deadline-Ms"] = str(deadline_ms)
         if request_id:
             headers["X-Request-Id"] = str(request_id)
+        if trace_parent:
+            # fleet trace propagation (serve/context.py parse_trace_parent):
+            # "<trace_id>-<seq>-<leg>", stamped per leg by the router so the
+            # replica's trace events carry the fleet-level request id
+            headers["X-Trace-Parent"] = str(trace_parent)
         status, resp_headers, doc = self._request_json(
             "POST", "/predict", body=image.tobytes(), headers=headers, timeout_s=timeout_s
         )

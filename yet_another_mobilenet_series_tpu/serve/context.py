@@ -41,13 +41,45 @@ _IDS = itertools.count(1)
 
 TERMINAL_PHASES = ("completed", "shed", "failed", "resolved")
 
+# hedge legs occupy seq 8+: route_attempts is small (<= ~3 retries per
+# leg), so seq = attempt + LEG_SEQ_HEDGE * 8 is unique per (request, leg,
+# attempt) and the Perfetto flow id (trace_id * 16 + seq) never collides
+TRACE_SEQ_HEDGE_BASE = 8
+
+
+def parse_trace_parent(header: str | None) -> tuple[int, int, str] | None:
+    """Parse an ``X-Trace-Parent: <trace_id>-<seq>-<leg>`` header (the
+    router stamps one per leg — serve/router.py) into ``(trace_id, seq,
+    leg)``. Malformed or absent headers return None: trace propagation is
+    best-effort and must never fail a request."""
+    if not header:
+        return None
+    parts = header.strip().split("-", 2)
+    if len(parts) != 3:
+        return None
+    try:
+        trace_id, seq = int(parts[0]), int(parts[1])
+    except ValueError:
+        return None
+    if trace_id < 0 or not 0 <= seq < 16 or not parts[2]:
+        return None
+    return trace_id, seq, parts[2]
+
+
+def trace_flow_id(trace_id: int, seq: int) -> int:
+    """The Perfetto flow-event id shared by the router's ``flow_start`` and
+    the replica's ``flow_end`` for one leg: 16 seq slots per trace id."""
+    return trace_id * 16 + seq
+
 
 class RequestContext:
     """Identity + QoS + phase for one in-system serving request."""
 
-    __slots__ = ("rid", "cls", "deadline_ms", "client_tag", "t_arrival", "phase")
+    __slots__ = ("rid", "cls", "deadline_ms", "client_tag", "t_arrival", "phase",
+                 "trace_id", "trace_seq", "trace_leg")
 
-    def __init__(self, rid: int, cls: str, deadline_ms: float | None, client_tag: str | None = None):
+    def __init__(self, rid: int, cls: str, deadline_ms: float | None, client_tag: str | None = None,
+                 trace_parent: str | None = None):
         self.rid = rid
         self.cls = cls
         self.deadline_ms = deadline_ms
@@ -56,11 +88,19 @@ class RequestContext:
         self.client_tag = client_tag
         self.t_arrival = time.perf_counter()
         self.phase = "arrived"
+        # fleet-level trace identity (X-Trace-Parent, stamped by the router
+        # on every leg): the ROUTER's request id + this leg's seq/name, so
+        # replica-side trace events carry the fleet-wide correlation key
+        parsed = parse_trace_parent(trace_parent)
+        self.trace_id = parsed[0] if parsed else None
+        self.trace_seq = parsed[1] if parsed else 0
+        self.trace_leg = parsed[2] if parsed else None
 
     @classmethod
     def mint(cls, qos_class: str, deadline_ms: float | None = None,
-             client_tag: str | None = None) -> "RequestContext":
-        return cls(next(_IDS), qos_class, deadline_ms, client_tag)
+             client_tag: str | None = None,
+             trace_parent: str | None = None) -> "RequestContext":
+        return cls(next(_IDS), qos_class, deadline_ms, client_tag, trace_parent)
 
     @property
     def wire_id(self) -> str:
@@ -78,9 +118,32 @@ class RequestContext:
             "deadline_ms": self.deadline_ms,
             "age_s": self.age_s(),
             "phase": self.phase,
+            "trace": self.trace_id,
         }
 
+    def _targs(self) -> dict:
+        """Fleet-trace args attached to every emitted event when a trace
+        parent rode in: the ROUTER-issued request id (and which leg this
+        replica served), so a merged cross-process trace correlates replica
+        events to the fleet request without string joins."""
+        if self.trace_id is None:
+            return {}
+        return {"trace": self.trace_id, "leg": self.trace_leg}
+
     # -- the one trace-emission point ---------------------------------------
+
+    def link_parent(self) -> None:
+        """Emit the ``fleet/leg`` flow ARRIVAL (``ph: f``) binding the
+        router's leg arrow to this replica's enclosing slice — called inside
+        the frontend's ``serve/submit`` span, so Perfetto draws
+        router -> leg -> replica as one connected arrow per leg. No-op
+        without a trace parent (a direct client, no router above us)."""
+        if self.trace_id is None:
+            return
+        obs_trace.get_tracer().flow_end(
+            "fleet/leg", trace_flow_id(self.trace_id, self.trace_seq),
+            trace=self.trace_id, leg=self.trace_leg, rid=self.rid,
+        )
 
     def advance(self, phase: str) -> None:
         """Move to ``phase``, emitting the async/flow trace edges for the
@@ -94,11 +157,11 @@ class RequestContext:
         if not tr.enabled:
             return
         if phase == "queued":
-            tr.async_begin("serve/queued", self.rid)
-            tr.flow_start("serve/req", self.rid, cls=self.cls)
+            tr.async_begin("serve/queued", self.rid, **self._targs())
+            tr.flow_start("serve/req", self.rid, cls=self.cls, **self._targs())
         elif phase == "dispatched":
             tr.async_end("serve/queued", self.rid)
-            tr.async_begin("serve/inflight", self.rid)
+            tr.async_begin("serve/inflight", self.rid, **self._targs())
             tr.flow_step("serve/req", self.rid)
         elif phase in ("completed", "shed", "failed"):
             # close whichever sub-phase the request died in (a reject can
@@ -111,9 +174,11 @@ class RequestContext:
         obs_trace.get_tracer().async_begin(
             "serve/request", self.rid, cls=self.cls,
             deadline_ms=self.deadline_ms if self.deadline_ms is not None else 0.0,
+            **self._targs(),
         )
 
     def close_envelope(self) -> None:
         """Async envelope end (admission, at final future resolution)."""
-        obs_trace.get_tracer().async_end("serve/request", self.rid, outcome=self.phase)
+        obs_trace.get_tracer().async_end("serve/request", self.rid, outcome=self.phase,
+                                         **self._targs())
         self.phase = "resolved"

@@ -221,7 +221,13 @@ class _Handler(BaseHTTPRequestHandler):
         cumulative bucket + quantile lines (obs/registry.py), so
         ``serve_latency_seconds{class="interactive",quantile="0.99"}`` is
         p99 straight off the replica."""
-        body = get_registry().render_prometheus().encode()
+        text = get_registry().render_prometheus()
+        if self.frontend.federation is not None:
+            # the router frontend is ALSO the fleet's scrape surface:
+            # federated families (replica-labeled histograms, fleet gauges,
+            # every replica's build_info) ride the same exposition
+            text += self.frontend.federation.render_prometheus()
+        body = text.encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
         self.send_header("Content-Length", str(len(body)))
@@ -236,14 +242,21 @@ class _Handler(BaseHTTPRequestHandler):
         from ..obs.device import compile_report
 
         fe = self.frontend
-        self._send_json(200, {
+        doc = {
             "metrics": get_registry().snapshot(),
             "admission": fe.admission.state(),
             "draining": fe._draining,
             "replica": fe.identity(),
             "build_info": get_registry().build_info,
             "executables": compile_report(),
-        })
+            # raw bucket counts per histogram: the federation scrape's input
+            # — fixed log-spaced bounds make cross-replica count summation a
+            # LOSSLESS merge (obs/fleet.py)
+            "histograms": get_registry().histograms_state(),
+        }
+        if fe.federation is not None:
+            doc["fleet"] = fe.federation.snapshot()
+        self._send_json(200, doc)
 
     # -- POST /predict ------------------------------------------------------
 
@@ -358,10 +371,16 @@ class _Handler(BaseHTTPRequestHandler):
         ctx = RequestContext.mint(
             priority or fe.admission._default_class, deadline_ms,
             client_tag=self.headers.get("X-Request-Id") or None,
+            # the router's per-leg fleet trace identity (context.py): replica
+            # trace events carry the ROUTER-issued request id, and
+            # link_parent below lands the router->replica flow arrow
+            trace_parent=self.headers.get("X-Trace-Parent") or None,
         )
         rid_hdr = {"X-Request-Id": ctx.wire_id}
         try:
-            with obs_trace.get_tracer().span("serve/submit", "serve", rid=ctx.rid):
+            with obs_trace.get_tracer().span("serve/submit", "serve", rid=ctx.rid,
+                                             **ctx._targs()):
+                ctx.link_parent()
                 fut = fe.admission.submit(
                     image, priority=priority, deadline_ms=deadline_ms, ctx=ctx
                 )
@@ -406,10 +425,15 @@ class Frontend:
         retry_after_s: float = 1.0,
         profiler=None,
         replica_id: str = "",
+        federation=None,
     ):
         self.admission = admission
         # obs/device.py ProfilerCapture (or None): POST /profile/start|stop
         self.profiler = profiler
+        # obs/fleet.py FleetFederation (or None): set on the ROUTER's
+        # frontend, it extends /metrics with replica-labeled federated
+        # families and /varz with the fleet snapshot
+        self.federation = federation
         self._host = host
         self._port = port
         self.request_timeout_s = request_timeout_s

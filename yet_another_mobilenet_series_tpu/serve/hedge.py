@@ -122,6 +122,14 @@ class HedgedCall:
         with self._lock:
             return self._resolved
 
+    @property
+    def hedged(self) -> bool:
+        """True once a duplicate leg actually launched — the flight
+        recorder's discriminator for "this request's outcome was a hedge
+        race", not just an armed timer (obs/fleet.py hedge-outcome events)."""
+        with self._lock:
+            return self.HEDGE in self._launched
+
     def ok(self, leg: str, value) -> bool:
         """Leg ``leg`` answered. True if it won (resolved the future); a
         loser's late answer is dropped and counted, never double-delivered."""

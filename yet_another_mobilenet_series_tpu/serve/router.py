@@ -85,6 +85,7 @@ timer's input), and a ``fleet/route`` span per request.
 
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time
@@ -103,6 +104,7 @@ from .client import (
     ClientTimeout,
     ReplicaClient,
 )
+from .context import TRACE_SEQ_HEDGE_BASE, trace_flow_id
 from .hedge import ROUTER_LATENCY, HedgedCall, Hedger
 
 
@@ -240,7 +242,35 @@ class Router:
         self._poll_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._reg = get_registry()
+        # flight-recorder hook: called with (kind, **fields) for significant
+        # fleet events. Some emit sites hold self._lock, so the sink MUST be
+        # non-blocking (obs/fleet.py FlightRecorder.record is a deque append)
+        self._event_sink = None
+        # in-flight ledger: token -> submit record, for the watchdog's
+        # "oldest in-flight request" hang-report provider. Tokens are
+        # monotonic, so min(token) is the oldest submit
+        self._inflight: dict[int, dict] = {}
+        self._inflight_ids = itertools.count(1)
         self.set_backends(backends)
+
+    # -- flight-recorder event sink ------------------------------------------
+
+    def set_event_sink(self, sink) -> None:
+        """Attach a ``fn(kind, **fields)`` receiving significant fleet
+        events (ejections, readmissions, lease expirations, breaker flips,
+        hedge outcomes, terminal failures, sheds). The sink is called from
+        routing/poll threads — sometimes UNDER the router lock — so it must
+        be non-blocking and must not call back into the router."""
+        self._event_sink = sink  # yamt-lint: disable=YAMT019 — single-writer wiring at startup; emit sites read the slot lock-free by design
+
+    def _emit_event(self, kind: str, **fields) -> None:
+        sink = self._event_sink
+        if sink is None:
+            return
+        try:
+            sink(kind, **fields)
+        except Exception:  # noqa: BLE001 — observability must never fail routing
+            self._reg.counter("fleet.event_sink_errors").inc()
 
     # -- backend set (the supervisor / autoscaler mutate this) ---------------
 
@@ -330,6 +360,7 @@ class Router:
             rep = self._replicas.pop(key)
             rep.client.close()
             self._reg.counter("fleet.lease_expirations").inc()
+            self._emit_event("lease_expired", replica=key)
         if expired:
             self._update_routable_gauge_locked()
 
@@ -417,7 +448,11 @@ class Router:
             with self._lock:
                 rep.consecutive_failures = 0
                 rep.queue_depth = float(doc.get("queued_total") or 0.0)
-                rep.breaker_state = int(doc.get("breaker_state") or 0)
+                breaker = int(doc.get("breaker_state") or 0)
+                if breaker != rep.breaker_state:
+                    self._emit_event("breaker_flip", replica=rep.key,
+                                     state=breaker, prev=rep.breaker_state)
+                rep.breaker_state = breaker
                 rep.draining = bool(doc.get("draining"))
                 if (identity and rep.identity
                         and identity.get("start_unix") != rep.identity.get("start_unix")):
@@ -476,9 +511,12 @@ class Router:
         if routable and not rep.routable:
             rep.routable = True
             self._reg.counter("fleet.readmissions").inc()
+            self._emit_event("readmission", replica=rep.key)
         elif not routable and rep.routable:
             rep.routable = False
             self._reg.counter("fleet.ejections").inc()
+            self._emit_event("ejection", replica=rep.key,
+                             consecutive_failures=rep.consecutive_failures)
         self._update_routable_gauge_locked()
 
     def _record_failure(self, rep: _Replica, kind: str = "connect",
@@ -553,6 +591,8 @@ class Router:
         if cls in self._shed_classes:
             # brownout at the FLEET door: cheaper than a hop to any replica
             self._reg.counter("serve.rejected_brownout").inc()
+            self._emit_event("request_shed", cls=cls, level=self._brownout_level,
+                             rid=ctx.wire_id if ctx is not None else None)
             raise BrownoutShed(
                 f"class {cls!r} shed at brownout level L{self._brownout_level}; "
                 f"retry after {self._brownout_retry_after_s:.1f}s",
@@ -570,18 +610,49 @@ class Router:
         # queueing is part of what a client experiences, so the histogram
         # the autoscaler and hedge timer read must include it
         t_submit = time.perf_counter()
+        token = next(self._inflight_ids)
+        with self._lock:
+            self._inflight[token] = {
+                "t0": t_submit, "cls": cls,
+                "rid": ctx.rid if ctx is not None else None,
+            }
+        if ctx is not None:
+            # router-side request envelope: the router process gets its own
+            # serve/request async span keyed by the ROUTER rid (= the fleet
+            # trace id the legs carry), so a merged trace shows the fleet
+            # view of the request above the per-leg and replica rows
+            ctx.open_envelope()
+            ctx.advance("queued")
+
+        def _settle(f: Future, token: int = token, ctx=ctx) -> None:
+            with self._lock:
+                self._inflight.pop(token, None)
+            if ctx is None:
+                return
+            try:
+                failed = f.exception() is not None
+            except Exception:  # noqa: BLE001 — a cancelled future is "failed"
+                failed = True
+            ctx.advance("failed" if failed else "completed")
+            ctx.close_envelope()
+
+        fut.add_done_callback(_settle)
         self._pool.submit(self._route_guarded, call, image, cls, deadline_ms, ctx, t_submit)
         return fut
 
     def _route_guarded(self, call, image, cls, deadline_ms, ctx, t_submit) -> None:
+        trace_id = ctx.rid if ctx is not None else None
         try:
             self._route(call, image, cls, deadline_ms, ctx, t_submit)
         except Exception as e:  # noqa: BLE001 — a crashed route must not hang its client
             self._reg.counter("fleet.route_errors").inc()
-            call.err(HedgedCall.PRIMARY, e)
+            self._fail_leg(call, HedgedCall.PRIMARY, e, cls=cls, trace_id=trace_id)
 
     def _route(self, call, image, cls, deadline_ms, ctx, t_submit) -> None:
         rid = ctx.wire_id if ctx is not None else None
+        # the fleet trace id every leg's X-Trace-Parent carries: the
+        # router's own monotonic rid (context.py parse_trace_parent)
+        trace_id = ctx.rid if ctx is not None else None
         timer: threading.Timer | None = None
         primary_at: dict = {}
         hedge_s = None
@@ -600,50 +671,85 @@ class Router:
         if hedge_s is not None and self.n_routable() >= 2:
             timer = threading.Timer(
                 hedge_s, self._fire_hedge,
-                args=(call, image, cls, deadline_ms, rid, primary_at, t_submit),
+                args=(call, image, cls, deadline_ms, rid, trace_id, primary_at, t_submit),
             )
             timer.daemon = True
             timer.start()
         try:
-            with obs_trace.get_tracer().span("fleet/route", "serve", cls=cls):
+            targs = {"trace": trace_id} if trace_id is not None else {}
+            with obs_trace.get_tracer().span("fleet/route", "serve", cls=cls, **targs):
                 self._leg(call, HedgedCall.PRIMARY, image, cls, deadline_ms, rid,
-                          exclude=set(), chosen=primary_at, t_submit=t_submit)
+                          exclude=set(), chosen=primary_at, t_submit=t_submit,
+                          trace_id=trace_id)
         finally:
             if timer is not None and call.resolved:
                 timer.cancel()
 
-    def _fire_hedge(self, call, image, cls, deadline_ms, rid, primary_at, t_submit) -> None:
+    def _fire_hedge(self, call, image, cls, deadline_ms, rid, trace_id, primary_at,
+                    t_submit) -> None:
         try:  # Timer threads die as silently as any other (YAMT011 discipline)
             if not call.launch_hedge():
                 return  # primary already resolved; nothing to duplicate
             exclude = {primary_at["key"]} if "key" in primary_at else set()
             self._leg(call, HedgedCall.HEDGE, image, cls, deadline_ms, rid,
-                      exclude=exclude, t_submit=t_submit)
+                      exclude=exclude, t_submit=t_submit, trace_id=trace_id)
         except Exception as e:  # noqa: BLE001 — contain: fail the leg, not the thread
             self._reg.counter("fleet.route_errors").inc()
-            call.err(HedgedCall.HEDGE, e)
+            self._fail_leg(call, HedgedCall.HEDGE, e, cls=cls, trace_id=trace_id)
+
+    def _fail_leg(self, call, leg, exc, *, cls, trace_id) -> None:
+        """Deliver a leg failure; when THIS call settles the request (no
+        other leg can still answer), record the terminal verdict for the
+        flight recorder — failed requests leave a per-request record."""
+        if call.err(leg, exc):
+            self._emit_event("request_failed", trace=trace_id, cls=cls, leg=leg,
+                             error=type(exc).__name__)
 
     def _leg(self, call, leg, image, cls, deadline_ms, rid, *, exclude, chosen=None,
-             t_submit=None) -> None:
+             t_submit=None, trace_id=None) -> None:
         """One leg (primary or hedge) of one request: pick, dispatch, retry
-        transport-level failures on other replicas, resolve the call."""
+        transport-level failures on other replicas, resolve the call.
+
+        Trace propagation: each ATTEMPT of each leg gets a distinct seq
+        (hedge attempts offset by TRACE_SEQ_HEDGE_BASE) stamped into the
+        ``X-Trace-Parent`` header, plus a ``fleet/leg`` span with a flow
+        arrow whose id the replica's ``link_parent`` flow-end shares — the
+        merged trace draws router -> leg -> replica per attempt."""
+        tracer = obs_trace.get_tracer()
         tried = set(exclude)
         last_exc: Exception | None = None
-        for _ in range(self._route_attempts):
+        seq_base = TRACE_SEQ_HEDGE_BASE if leg == HedgedCall.HEDGE else 0
+        for attempt in range(self._route_attempts):
             try:
                 rep = self._pick(tried)
             except NoHealthyReplicas as e:
-                call.err(leg, last_exc or e)
+                self._fail_leg(call, leg, last_exc or e, cls=cls, trace_id=trace_id)
                 return
             if chosen is not None:
                 chosen["key"] = rep.key
+            tp = None
+            targs = {}
+            if trace_id is not None:
+                # seq < 16 is the parse_trace_parent contract; retries past
+                # the hedge offset would collide, so clamp (route_attempts
+                # is small — <= ~3 — in any real config)
+                seq = seq_base + min(attempt, TRACE_SEQ_HEDGE_BASE - 1)
+                tp = f"{trace_id}-{seq}-{leg}"
+                targs = {"trace": trace_id, "leg": leg, "seq": seq}
             t0 = time.perf_counter() if t_submit is None else t_submit
             t_leg = time.perf_counter()
             try:
-                logits = rep.client.predict(
-                    image, priority=cls, deadline_ms=deadline_ms, request_id=rid,
-                    timeout_s=self._client_timeout_s,
-                )
+                with tracer.span("fleet/leg", "serve", replica=rep.key, **targs):
+                    if trace_id is not None:
+                        # flow DEPARTURE, inside the leg slice so Perfetto
+                        # anchors the arrow here; the replica's link_parent
+                        # emits the matching arrival (same name/cat/id)
+                        tracer.flow_start("fleet/leg", trace_flow_id(trace_id, seq),
+                                          **targs)
+                    logits = rep.client.predict(
+                        image, priority=cls, deadline_ms=deadline_ms, request_id=rid,
+                        trace_parent=tp, timeout_s=self._client_timeout_s,
+                    )
             except ClientConnectError as e:
                 # the socket is dead — likely a killed replica: score it,
                 # move the request to the next one (inference is pure)
@@ -680,10 +786,11 @@ class Router:
                     tried.add(rep.key)
                     last_exc = e
                     continue
-                call.err(leg, e)  # per-request verdict: pass through verbatim
+                # per-request verdict: pass through verbatim
+                self._fail_leg(call, leg, e, cls=cls, trace_id=trace_id)
                 return
             except ClientError as e:  # timeout: the request burned its budget
-                call.err(leg, e)
+                self._fail_leg(call, leg, e, cls=cls, trace_id=trace_id)
                 return
             leg_s = time.perf_counter() - t_leg
             with self._lock:
@@ -697,11 +804,48 @@ class Router:
                 )
             self._reg.histogram(f"{ROUTER_LATENCY}.{cls}").observe(time.perf_counter() - t0)
             self._reg.counter("fleet.routed").inc()
-            call.ok(leg, logits)
+            if call.ok(leg, logits) and call.hedged:
+                # a hedge RACE settled: record which leg won and where — the
+                # flight recorder's per-request hedge outcome
+                self._emit_event("hedge_outcome", winner=leg, replica=rep.key,
+                                 trace=trace_id, cls=cls,
+                                 leg_ms=round(leg_s * 1e3, 3))
             return
-        call.err(leg, last_exc or NoHealthyReplicas("route attempts exhausted"))
+        self._fail_leg(call, leg, last_exc or NoHealthyReplicas("route attempts exhausted"),
+                       cls=cls, trace_id=trace_id)
 
     # -- introspection (healthz / varz via the frontend) ---------------------
+
+    def backends(self) -> list:
+        """``(key, client)`` pairs for every registered backend — the
+        federation scrape loop (obs/fleet.py) reuses the router's own
+        keep-alive clients; ReplicaClient connections are per-thread, so a
+        scrape thread never contends with route workers for a socket."""
+        with self._lock:
+            return [(r.key, r.client) for r in self._replicas.values()]
+
+    def lease_ages(self) -> dict:
+        """Per-replica seconds until lease expiry (None = static member, no
+        lease) — a hang-report / federation info provider."""
+        now = time.monotonic()
+        with self._lock:
+            return {r.key: (round(r.lease_until - now, 3) if r.lease_until is not None
+                            else None)
+                    for r in self._replicas.values()}
+
+    def oldest_inflight(self) -> dict | None:
+        """The longest-outstanding submitted request (age, class, rid) plus
+        the in-flight count — what a hang report needs to say WHOSE request
+        the wedged router is sitting on; None when idle."""
+        now = time.perf_counter()
+        with self._lock:
+            if not self._inflight:
+                return None
+            token = min(self._inflight)
+            rec = self._inflight[token]
+            n = len(self._inflight)
+        return {"age_s": round(now - rec["t0"], 3), "class": rec["cls"],
+                "rid": rec["rid"], "inflight": n}
 
     def replicas_state(self) -> list[dict]:
         with self._lock:
