@@ -91,6 +91,25 @@ does not depend on trained weight values.
    REMOVED by lease expiry within TTL + one poll sweep. Emits the
    BENCH_SERVE_r09 shape.
 
+11. **multi-model zoo** (``--zoo``, standalone mode) — the zoo/cascade
+   acceptance (serve/zoo.py, serve/cascade.py): ONE 2-replica
+   model-sharded fleet (slot 0 serves the int8 'small' tier, slot 1 the
+   f32 'big' tier via per-slot ``serve.zoo.models`` assignments, placement
+   advertised to the model-aware router) A/B'd three ways over ONE seeded
+   trace: **big_only** (every request pinned ``X-Model: big`` — the
+   one-model-per-fleet baseline), **sharded** (seeded 50/50 pins; the
+   per-replica ``serve.model_requests.{model}`` deltas must show ZERO
+   misroutes and the books zero 5xx), and **cascade** (unqualified
+   submits: the small tier answers confident requests, low-margin ones
+   re-submit to the big tier at the router). Pinned: escalations > 0 AND
+   answered_small > 0 (the threshold calibrates to the trace's median
+   margin), every cascade answer bitwise-matches exactly one of the two
+   per-image explicit-pin references (escalated answers EQUAL the
+   big-only arm's), and the fleet-wide dispatched-FLOPs/request mean of
+   the cascade arm sits STRICTLY below the big-only arm's (the cost
+   proxy: per-replica ``serve.dispatched_flops`` deltas). Emits the
+   BENCH_SERVE_r11 shape.
+
 9. **overload** (``--overload``, standalone mode) — the brownout ladder's
    acceptance experiment (serve/brownout.py): ONE seeded open-loop Poisson
    storm at ``--overload-multiple`` x the measured closed-loop capacity
@@ -126,6 +145,9 @@ Usage: python scripts/serve_bench.py [--arch mobilenet_v3_large]
            [--partition-poll-s 0.1] [--partition-connect-timeout-s 0.4]
            [--partition-read-timeout-s 2.0] [--partition-lease-ttl-s 1.5]
            [--partition-seed 0] [--out f.json]
+       python scripts/serve_bench.py --zoo [--zoo-requests 48]
+           [--zoo-qps 0] [--zoo-threshold -1] [--zoo-int8-top1-min 0.5]
+           [--zoo-seed 0] [--out f.json]
 """
 
 from __future__ import annotations
@@ -1079,6 +1101,393 @@ def measure_fleet(arch, image_size, buckets, *, replicas, requests, target_qps,
             ),
         }
         out["cpu_rehearsal_note"] = _FLEET_CPU_CAVEAT
+        return out
+    finally:
+        router.stop()
+        fleet.stop()
+
+
+_ZOO_CPU_CAVEAT = (
+    "cpu_rehearsal: both replicas, the router, the cascade policy, and the "
+    "load generator share this box's core(s), so absolute latency/QPS are "
+    "contention-dominated. The pinned structural claims are "
+    "host-independent: the model-sharded arm shows ZERO misroutes (per-"
+    "replica serve.model_requests deltas) and zero 5xx on the same seeded "
+    "trace; the cascade arm escalates > 0 requests, every answer is "
+    "bitwise one of the two per-image references (escalated answers EQUAL "
+    "the big-only arm's), and its fleet-wide dispatched-FLOPs/request mean "
+    "sits strictly below the big-only arm's. Wall-clock speedups are an "
+    "accelerator measurement — same caveat discipline as r06/r07."
+)
+
+
+def _zoo_scrape_flops(router):
+    """Sum ``serve.dispatched_flops`` across every replica's /varz registry
+    snapshot. Dispatch cost is engine-side (per replica process), so per-arm
+    deltas of this sum are the fleet-wide dispatched cost — the cascade's
+    cost-proxy instrument."""
+    total, per = 0.0, {}
+    for key, client in router.backends():
+        _status, doc = client.varz(timeout_s=10.0)
+        v = float(((doc or {}).get("metrics") or {}).get("serve.dispatched_flops", 0))
+        per[key] = v
+        total += v
+    return total, per
+
+
+def _zoo_scrape_model_requests(router, models):
+    """Per-replica ``serve.model_requests.{model}`` counters — the misroute
+    instrument: on a model-sharded fleet a replica must never count a
+    request for a model it does not serve."""
+    per = {}
+    for key, client in router.backends():
+        _status, doc = client.varz(timeout_s=10.0)
+        met = (doc or {}).get("metrics") or {}
+        per[key] = {m: int(met.get(f"serve.model_requests.{m}", 0)) for m in models}
+    return per
+
+
+def _zoo_round(submit, images, models, *, target_qps, seed, result_timeout_s=120.0):
+    """One open-loop Poisson round over a FIXED per-index plan: request i
+    submits ``images[i]`` pinned to ``models[i]`` (None = unqualified — the
+    cascade decides the tier). Latency stamps at resolution like
+    ``_fleet_round``; answers come back INDEXED so the caller can check
+    every one bitwise against its per-image reference."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.serve.client import ClientHTTPError
+
+    rs = np.random.RandomState(seed)
+    n = len(images)
+    gaps = rs.exponential(1.0 / target_qps, size=n)
+    pending = []
+    lat = {}
+    lat_lock = threading.Lock()
+
+    def _stamp(i, t0):
+        def cb(fut):
+            if fut.exception() is None:
+                with lat_lock:
+                    lat[i] = time.perf_counter() - t0
+        return cb
+
+    t_start = time.perf_counter()
+    t_next = t_start
+    for i in range(n):
+        t_next += gaps[i]
+        delay = t_next - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t0 = time.perf_counter()
+        fut = submit(images[i], models[i])
+        fut.add_done_callback(_stamp(i, t0))
+        pending.append(fut)
+    out = {"submitted": n, "completed": 0, "rejected": 0, "failed": 0,
+           "unresolved": 0}
+    answers = [None] * n
+    for i, fut in enumerate(pending):
+        try:
+            answers[i] = np.asarray(fut.result(timeout=result_timeout_s))
+            out["completed"] += 1
+        except FutTimeout:
+            out["unresolved"] += 1  # a real hang: the tier broke its contract
+        except ClientHTTPError as e:
+            out["rejected" if e.status < 500 else "failed"] += 1
+        except Exception:  # noqa: BLE001 — typed route failure
+            out["failed"] += 1
+    wall = time.perf_counter() - t_start
+    per_model = {}
+    for i, m in enumerate(models):
+        if i in lat:
+            per_model.setdefault(m or "cascade", []).append(lat[i])
+    all_lat = sorted(lat.values())
+    out.update({
+        "wall_s": round(wall, 3),
+        "qps": round(out["completed"] / wall, 2) if wall else 0.0,
+        "p50_ms": round(_percentile(all_lat, 0.50) * 1e3, 3),
+        "p99_ms": round(_percentile(all_lat, 0.99) * 1e3, 3),
+        "per_model": {
+            m: {"n": len(v),
+                "p50_ms": round(_percentile(sorted(v), 0.50) * 1e3, 3),
+                "p99_ms": round(_percentile(sorted(v), 0.99) * 1e3, 3)}
+            for m, v in sorted(per_model.items())
+        },
+    })
+    return out, answers
+
+
+def measure_zoo(arch, image_size, *, requests, target_qps, seed, threshold,
+                int8_top1_min, log_root):
+    """The ``--zoo`` measurement: ONE 2-replica model-sharded fleet — slot 0
+    serves the int8 'small' tier, slot 1 the f32 'big' tier, via per-slot
+    ``serve.zoo.models`` assignments with the placement advertised to the
+    router — A/B'd three ways over ONE seeded trace of images:
+
+    1. **big_only** — every request pinned ``X-Model: big``: the
+       one-model-per-fleet cost/latency baseline.
+    2. **sharded** — a seeded 50/50 model-pin mix through the model-aware
+       pick; per-replica ``serve.model_requests.{model}`` deltas must show
+       ZERO misroutes and the books must show zero 5xx.
+    3. **cascade** — unqualified submits through serve/cascade.py: the
+       small tier answers confident requests, low-margin ones re-submit to
+       the big tier. Escalations must be > 0, every answer must be bitwise
+       one of the two per-image references (escalated answers EQUAL the
+       big-only arm's), and the fleet-wide dispatched-FLOPs/request mean
+       must sit STRICTLY below the big_only arm's.
+
+    The threshold defaults to the MEDIAN reference margin, so both cascade
+    outcomes (answered-small and escalated) are populated by construction.
+    Buckets are pinned to [1] so every arm's answers are bitwise-comparable
+    against the explicit-pin reference pass by construction (no padding
+    variation between arms)."""
+    import jax
+    import numpy as np
+
+    from yet_another_mobilenet_series_tpu.cli.fleet import FleetSupervisor
+    from yet_another_mobilenet_series_tpu.config import ModelConfig
+    from yet_another_mobilenet_series_tpu.models import get_model
+    from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+    from yet_another_mobilenet_series_tpu.serve.cascade import CascadeTier, softmax_margin
+    from yet_another_mobilenet_series_tpu.serve.export import export_bundle
+    from yet_another_mobilenet_series_tpu.serve.router import Router
+
+    reg = get_registry()
+    rng = np.random.RandomState(seed)
+    # two genuinely different cost tiers: the small tier is the contract-test
+    # tiny preset (int8 weights), the big tier is deeper/wider so the
+    # cascade's FLOPs win is structural, not noise
+    small_mc = ModelConfig(arch="mobilenet_v2", num_classes=16, dropout=0.0,
+                           block_specs=[{"t": 2, "c": 8, "n": 1, "s": 2},
+                                        {"t": 2, "c": 16, "n": 1, "s": 2}])
+    if arch == "tiny":
+        big_mc = ModelConfig(arch="mobilenet_v2", num_classes=16, dropout=0.0,
+                             block_specs=[{"t": 4, "c": 24, "n": 2, "s": 2},
+                                          {"t": 4, "c": 48, "n": 2, "s": 2},
+                                          {"t": 4, "c": 96, "n": 1, "s": 1}])
+    else:
+        big_mc = ModelConfig(arch=arch)
+    small_net = get_model(small_mc, image_size)
+    sp, ss = small_net.init(jax.random.PRNGKey(seed))
+    calib = rng.normal(0, 1, (8, image_size, image_size, 3)).astype("float32")
+    small_dir = os.path.join(log_root, "small")
+    export_bundle(small_net, sp, ss, small_dir, model_name="small",
+                  quant_weights="int8", calib_images=calib,
+                  int8_top1_min=int8_top1_min)
+    big_net = get_model(big_mc, image_size)
+    bp, bs = big_net.init(jax.random.PRNGKey(seed + 1))
+    big_dir = os.path.join(log_root, "big")
+    export_bundle(big_net, bp, bs, big_dir, model_name="big")
+
+    def _meta(d):
+        with open(os.path.join(d, "meta.json")) as f:
+            return json.load(f)
+
+    small_meta, big_meta = _meta(small_dir), _meta(big_dir)
+
+    base_argv = [
+        f"data.image_size={image_size}",
+        "serve.buckets=[1]",  # bucket-1 everywhere: bitwise identity by construction
+        "serve.max_wait_ms=1.0",
+        "serve.drain_timeout_s=10",
+    ]
+    # model-sharded placement: each slot serves exactly one tenant — the
+    # per-slot argv is the same shape cli/fleet.py slot_overrides() emits
+    per_slot = {
+        0: [f"serve.zoo.models=small={small_dir}", "serve.zoo.default=small"],
+        1: [f"serve.zoo.models=big={big_dir}", "serve.zoo.default=big"],
+    }
+    slot_adverts = {0: {"small": small_meta.get("digest", "")},
+                    1: {"big": big_meta.get("digest", "")}}
+
+    class _StderrLog:
+        # the bench contract owns stdout (ONE JSON line)
+        def log(self, msg):
+            print(msg, file=sys.stderr, flush=True)
+
+    router = Router(poll_interval_s=0.25, eject_failures=2, route_attempts=3,
+                    client_timeout_s=60.0, seed=seed).start()
+    fleet_ref = {}
+
+    def _on_change(addrs):
+        # membership AND placement ride every supervisor notification: the
+        # router learns which tenant each address serves (digest-stamped),
+        # exactly what cli/fleet.py's placement wiring does
+        router.set_backends(addrs)
+        fleet = fleet_ref.get("fleet")
+        if fleet is None:
+            return
+        assignments = {}
+        for r in fleet.replicas():
+            addr = r["addr"]
+            if addr is not None:
+                key = f"{addr['host']}:{addr['port']}"
+                assignments[key] = slot_adverts[r["slot"] % 2]
+        router.set_backend_models(assignments)
+
+    fleet = FleetSupervisor(
+        replica_argv=base_argv, log_dir=log_root, replicas=2,
+        per_slot_argv=per_slot, spawn_timeout_s=240.0, drain_timeout_s=30.0,
+        on_change=_on_change, logger=_StderrLog(),
+    )
+    fleet_ref["fleet"] = fleet
+    out = {"replicas": 2, "image_size": image_size, "seed": seed,
+           "requests": requests,
+           "models": {
+               "small": {"weights": "int8",
+                         "digest": small_meta.get("digest", "")[:12],
+                         "int8_top1": (small_meta.get("quant") or {}).get("top1_agreement")},
+               "big": {"weights": "float32",
+                       "digest": big_meta.get("digest", "")[:12]},
+           }}
+    try:
+        t0 = time.perf_counter()
+        fleet.start()
+        out["spawn_s"] = round(time.perf_counter() - t0, 2)
+        slot_addr = {r["slot"]: r["addr"] for r in fleet.replicas()
+                     if r["addr"] is not None}
+        small_key = f"{slot_addr[0]['host']}:{slot_addr[0]['port']}"
+        big_key = f"{slot_addr[1]['host']}:{slot_addr[1]['port']}"
+        out["placement"] = {small_key: ["small"], big_key: ["big"]}
+
+        images = [rng.normal(0, 1, (image_size, image_size, 3)).astype("float32")
+                  for _ in range(requests)]
+
+        # reference pass: every trace image answered by BOTH tiers via
+        # explicit pins — the per-image bitwise references for all three
+        # arms, and the margins that calibrate the cascade threshold
+        refs_small, refs_big, margins, warm_lat = [], [], [], []
+        for img in images:
+            t1 = time.perf_counter()
+            r = router.submit(img, model="small").result(timeout=120)
+            warm_lat.append(time.perf_counter() - t1)
+            refs_small.append(np.asarray(r))
+            margins.append(softmax_margin(r))
+        for img in images:
+            refs_big.append(np.asarray(
+                router.submit(img, model="big").result(timeout=120)))
+        if threshold is None or threshold < 0:
+            # the median margin splits the trace: ~half answer small, ~half
+            # escalate — both cascade outcomes populated by construction
+            threshold = float(np.median(margins))
+        out["threshold"] = round(threshold, 6)
+        out["margins"] = {"min": round(float(np.min(margins)), 6),
+                          "median": round(float(np.median(margins)), 6),
+                          "max": round(float(np.max(margins)), 6)}
+        warm_lat.sort()
+        p50_s = max(_percentile(warm_lat, 0.5), 1e-3)
+        if target_qps <= 0:
+            target_qps = max(2.0, 0.35 / p50_s)
+        out["target_qps"] = round(target_qps, 2)
+
+        arms = {}
+        # arm 1: one-model-per-fleet baseline — everything pinned big
+        f0, _ = _zoo_scrape_flops(router)
+        rnd, ans = _zoo_round(lambda img, m: router.submit(img, model=m),
+                              images, ["big"] * requests,
+                              target_qps=target_qps, seed=seed + 2)
+        f1, _ = _zoo_scrape_flops(router)
+        rnd["flops_per_request"] = (f1 - f0) / max(rnd["completed"], 1)
+        rnd["bitwise_match_big"] = all(
+            a is not None and np.array_equal(a, refs_big[i])
+            for i, a in enumerate(ans))
+        arms["big_only"] = rnd
+
+        # arm 2: model-sharded 50/50 pins — the zero-misroute/zero-5xx claim
+        mix_rs = np.random.RandomState(seed + 3)
+        mix = ["small" if mix_rs.rand() < 0.5 else "big" for _ in range(requests)]
+        mix[0], mix[1] = "small", "big"  # both tenants always present
+        mr0 = _zoo_scrape_model_requests(router, ("small", "big"))
+        f0, _ = _zoo_scrape_flops(router)
+        rnd, ans = _zoo_round(lambda img, m: router.submit(img, model=m),
+                              images, mix, target_qps=target_qps, seed=seed + 4)
+        f1, _ = _zoo_scrape_flops(router)
+        mr1 = _zoo_scrape_model_requests(router, ("small", "big"))
+        rnd["flops_per_request"] = (f1 - f0) / max(rnd["completed"], 1)
+        rnd["mix"] = {"small": mix.count("small"), "big": mix.count("big")}
+        # a misroute is a request METERED on the replica that does not
+        # serve its model — admission counts serve.model_requests.{m} at
+        # the replica door, so the cross deltas must both be zero
+        rnd["misroutes"] = (
+            (mr1[small_key]["big"] - mr0[small_key]["big"])
+            + (mr1[big_key]["small"] - mr0[big_key]["small"]))
+        rnd["bitwise_match"] = all(
+            a is not None and np.array_equal(
+                a, (refs_small if mix[i] == "small" else refs_big)[i])
+            for i, a in enumerate(ans))
+        arms["sharded"] = rnd
+        if rnd["misroutes"] != 0 or rnd["failed"] != 0 or rnd["unresolved"] != 0:
+            raise AssertionError(
+                f"sharded arm broke placement: misroutes={rnd['misroutes']} "
+                f"failed={rnd['failed']} unresolved={rnd['unresolved']}")
+
+        # arm 3: the confidence cascade over the SAME sharded fleet
+        tier = CascadeTier(router, small="small", big="big", threshold=threshold)
+        s0 = reg.snapshot()
+        f0, _ = _zoo_scrape_flops(router)
+        rnd, ans = _zoo_round(lambda img, _m: tier.submit(img), images,
+                              [None] * requests, target_qps=target_qps,
+                              seed=seed + 5)
+        f1, _ = _zoo_scrape_flops(router)
+        s1 = reg.snapshot()
+
+        def _d(key):
+            return int(s1.get(key, 0) - s0.get(key, 0))
+
+        esc = _d("serve.cascade.escalations")
+        rnd["escalations"] = esc
+        rnd["answered_small"] = _d("serve.cascade.answered_small")
+        rnd["deadline_skips"] = _d("serve.cascade.deadline_skips")
+        rnd["escalation_failures"] = _d("serve.cascade.escalation_failures")
+        decided = esc + rnd["answered_small"]
+        rnd["escalation_rate"] = round(esc / decided, 4) if decided else 0.0
+        rnd["flops_per_request"] = (f1 - f0) / max(rnd["completed"], 1)
+        # bitwise discipline: every answer must equal EXACTLY one of the two
+        # per-image references, and the big-matches must equal the counted
+        # escalations (minus any failures, which must be zero anyway)
+        esc_matches = small_matches = mismatches = 0
+        for i, a in enumerate(ans):
+            if a is None:
+                continue
+            if np.array_equal(a, refs_small[i]):
+                small_matches += 1
+            elif np.array_equal(a, refs_big[i]):
+                esc_matches += 1
+            else:
+                mismatches += 1
+        rnd["answers_big_bitwise"] = esc_matches
+        rnd["answers_small_bitwise"] = small_matches
+        rnd["answer_mismatches"] = mismatches
+        rnd["escalated_bitwise_match_big_only"] = (
+            mismatches == 0 and esc_matches == esc - rnd["escalation_failures"])
+        arms["cascade"] = rnd
+        if esc <= 0 or rnd["answered_small"] <= 0:
+            raise AssertionError(
+                f"cascade did not split the trace: escalations={esc} "
+                f"answered_small={rnd['answered_small']}")
+        if mismatches:
+            raise AssertionError(
+                f"{mismatches} cascade answers matched NEITHER reference")
+
+        out["arms"] = arms
+        big_fpr = arms["big_only"]["flops_per_request"]
+        ratio = (arms["cascade"]["flops_per_request"] / big_fpr) if big_fpr else None
+        out["cost"] = {
+            "big_only_flops_per_request": round(big_fpr, 1),
+            "sharded_flops_per_request": round(arms["sharded"]["flops_per_request"], 1),
+            "cascade_flops_per_request": round(arms["cascade"]["flops_per_request"], 1),
+            "cascade_vs_big_only": round(ratio, 4) if ratio is not None else None,
+        }
+        # the acceptance criterion the whole subsystem exists for: at ~half
+        # escalation rate the blended cost must beat all-big STRICTLY
+        if ratio is None or ratio >= 1.0:
+            raise AssertionError(
+                f"cascade flops/request did not beat big-only: ratio={ratio}")
+        for arm in arms.values():
+            if arm["unresolved"]:
+                raise AssertionError("a zoo arm left futures unresolved")
+        out["cpu_rehearsal_note"] = _ZOO_CPU_CAVEAT
         return out
     finally:
         router.stop()
@@ -2178,6 +2587,30 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-phase-s", default="5,20,10",
                     help="low,high,trough durations (s) of the autoscaler's diurnal schedule")
     ap.add_argument("--fleet-seed", type=int, default=0)
+    ap.add_argument("--zoo", action="store_true",
+                    help="run the multi-model ZOO measurement instead of the "
+                         "single-process suites: a 2-replica model-sharded "
+                         "fleet (slot 0 int8 small tier, slot 1 f32 big "
+                         "tier) A/B'd three ways on one seeded trace — "
+                         "big-only baseline, sharded 50/50 pins (zero "
+                         "misroutes/5xx), and the confidence cascade "
+                         "(escalations > 0, bitwise answers, dispatched-"
+                         "FLOPs/request strictly below big-only)")
+    ap.add_argument("--zoo-requests", type=int, default=48,
+                    help="trace length: requests per zoo arm (each arm "
+                         "replays the SAME seeded trace)")
+    ap.add_argument("--zoo-qps", type=float, default=0.0,
+                    help="open-loop arrival rate per arm; 0 = auto from the "
+                         "measured small-tier p50")
+    ap.add_argument("--zoo-threshold", type=float, default=-1.0,
+                    help="cascade escalation threshold on the top-1 softmax "
+                         "margin; < 0 = calibrate to the trace's MEDIAN "
+                         "reference margin (both outcomes populated)")
+    ap.add_argument("--zoo-int8-top1-min", type=float, default=0.5,
+                    help="int8 export agreement gate for the small tier "
+                         "(random weights/trace: lower than the production "
+                         "0.98 default)")
+    ap.add_argument("--zoo-seed", type=int, default=0)
     ap.add_argument("--overload", action="store_true",
                     help="run the OVERLOAD measurement instead of the single-"
                          "process suites: brownout-off vs brownout-on on one "
@@ -2316,6 +2749,53 @@ def main(argv=None) -> int:
             out.update({"platform": dev.platform, "device_kind": dev.device_kind,
                         "provenance": provenance(), "overload": m})
             out["value"] = m["storm"]["interactive_availability_on"]
+            shutil.rmtree(log_root, ignore_errors=True)
+        except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0
+            out["error"] = f"{type(e).__name__}: {e} (replica logs under {log_root})"
+        line = json.dumps(out)
+        print(line)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(line + "\n")
+        return 0
+
+    if args.zoo:
+        # standalone like --fleet: the zoo arms share one model-sharded
+        # replica fleet, so the single-process suites would only add
+        # redundant compile time to the artifact
+        import shutil
+        import tempfile
+
+        out = {
+            "metric": f"{args.arch}_zoo_cascade_flops_vs_big_only",
+            "value": None,
+            "unit": "cascade/big_only dispatched-FLOPs per request",
+            "vs_baseline": None,
+            "vs_baseline_note": ("the A/B is internal: the big-only arm "
+                                 "(one-model-per-fleet) is the baseline; "
+                                 "value < 1.0 is the cascade's cost win"),
+            "image_size": image_sizes[0],
+            "measured_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        log_root = tempfile.mkdtemp(prefix="serve_bench_zoo_")
+        try:
+            m = measure_zoo(
+                args.arch, image_sizes[0],
+                requests=max(12, args.zoo_requests),
+                target_qps=args.zoo_qps,
+                seed=args.zoo_seed,
+                threshold=args.zoo_threshold,
+                int8_top1_min=args.zoo_int8_top1_min,
+                log_root=log_root,
+            )
+            import jax
+
+            from bench import provenance
+
+            dev = jax.devices()[0]
+            out.update({"platform": dev.platform, "device_kind": dev.device_kind,
+                        "provenance": provenance(), "zoo": m})
+            out["value"] = m["cost"]["cascade_vs_big_only"]
             shutil.rmtree(log_root, ignore_errors=True)
         except Exception as e:  # noqa: BLE001 — contract: structured error, exit 0
             out["error"] = f"{type(e).__name__}: {e} (replica logs under {log_root})"

@@ -116,6 +116,39 @@ def test_quant_block_parses_validates_and_overrides():
         cfg_lib.config_from_dict({"serve": {"quant": {"wier": "uint8"}}})
 
 
+def test_zoo_block_parses_validates_and_overrides():
+    """serve.zoo / serve.zoo.cascade are validated sections reachable by
+    dotted CLI override — cli/fleet spawns per-slot replicas via exactly
+    these argv keys, so this pins the section registration itself."""
+    cfg = cfg_lib.config_from_dict({
+        "serve": {"zoo": {"models": "small=/b/s,big=/b/b", "default": "small",
+                          "placement": "small;big", "quotas": "small=64",
+                          "cascade": {"enable": True, "small": "small",
+                                      "big": "big", "threshold": 0.2}}}
+    })
+    assert cfg.serve.zoo.models == "small=/b/s,big=/b/b"
+    assert cfg.serve.zoo.default == "small"
+    assert cfg.serve.zoo.placement == "small;big"
+    assert cfg.serve.zoo.cascade.enable is True
+    assert cfg.serve.zoo.cascade.threshold == 0.2
+    # dotted CLI overrides reach three levels down — the per-slot replica
+    # argv path (cli/fleet.py slot_overrides) depends on this
+    cfg = cfg_lib.parse_cli(
+        ["serve.zoo.models=a=/x", "serve.zoo.default=a",
+         "serve.zoo.cascade.enable=false", "serve.zoo.cascade.threshold=0.3"])
+    assert cfg.serve.zoo.models == "a=/x" and cfg.serve.zoo.default == "a"
+    assert cfg.serve.zoo.cascade.threshold == 0.3
+    # defaults: the zoo is strictly opt-in
+    assert cfg_lib.Config().serve.zoo.models == ""
+    assert cfg_lib.Config().serve.zoo.cascade.enable is False
+    with pytest.raises(ValueError, match="threshold"):
+        cfg_lib.config_from_dict({"serve": {"zoo": {"cascade": {"threshold": 1.5}}}})
+    with pytest.raises(ValueError, match="small"):
+        cfg_lib.config_from_dict({"serve": {"zoo": {"cascade": {"enable": True}}}})
+    with pytest.raises(KeyError):
+        cfg_lib.config_from_dict({"serve": {"zoo": {"modles": "a=/x"}}})
+
+
 def test_shipped_apps_parse():
     apps_dir = os.path.join(os.path.dirname(cfg_lib.__file__), "apps")
     ymls = [f for f in os.listdir(apps_dir) if f.endswith(".yml")]

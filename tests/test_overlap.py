@@ -184,7 +184,8 @@ def test_dispatch_failure_orphans_slot_buffer(bundle):
     key = (4, 24, 1)
     pool = ov._staging[key]
     bufs_before = [s.buf for s in pool.slots]
-    exe = ov._compiled[key]
+    ckey = ("default",) + key  # _compiled keys carry the model tenant
+    exe = ov._compiled[ckey]
 
     class _Boom(RuntimeError):
         pass
@@ -192,10 +193,10 @@ def test_dispatch_failure_orphans_slot_buffer(bundle):
     def failing_exe(params, xx):
         raise _Boom("injected dispatch failure")
 
-    ov._compiled[key] = failing_exe
+    ov._compiled[ckey] = failing_exe
     with pytest.raises(_Boom):
         ov.predict(x)
-    ov._compiled[key] = exe
+    ov._compiled[ckey] = exe
     # exactly one slot was consumed by the failed dispatch: its buffer was
     # replaced (orphaned) and its fence left clear
     replaced = [i for i, s in enumerate(pool.slots) if s.buf is not bufs_before[i]]

@@ -184,9 +184,13 @@ def test_engine_bucket_padding_bitwise(tmp_path):
     bundle = _bundle(tmp_path)
     eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24)
     eng.warmup()
-    # warmup precompiled every (bucket, size, K): per-chunk pairs plus the
-    # fused (max-bucket, size, K) scan for each K on the default fuse ladder
-    assert set(eng._compiled) == {(2, 24, 1), (4, 24, 1), (4, 24, 2), (4, 24, 4)}
+    # warmup precompiled every (model, bucket, size, K): per-chunk pairs
+    # plus the fused (max-bucket, size, K) scan for each K on the default
+    # fuse ladder, all under the single-bundle engine's "default" tenant
+    assert set(eng._compiled) == {
+        ("default", 2, 24, 1), ("default", 4, 24, 1),
+        ("default", 4, 24, 2), ("default", 4, 24, 4),
+    }
     x = np.random.RandomState(0).normal(0, 1, (4, 24, 24, 3)).astype(np.float32)
     full = eng.predict(x)  # exact bucket, no padding
     part = eng.predict(x[:3])  # 3 -> padded to 4
@@ -268,7 +272,10 @@ def test_engine_mixed_size_ladder_no_postwarmup_compile(tmp_path):
     eng = InferenceEngine(bundle, buckets=(2, 4), donate_input=False, image_size=24,
                           image_sizes=(24, 32), fuse_ladder=())
     eng.warmup()
-    assert set(eng._compiled) == {(2, 24, 1), (4, 24, 1), (2, 32, 1), (4, 32, 1)}
+    assert set(eng._compiled) == {
+        ("default", 2, 24, 1), ("default", 4, 24, 1),
+        ("default", 2, 32, 1), ("default", 4, 32, 1),
+    }
     reg = get_registry()
     before = reg.snapshot()["serve.compile_seconds.count"]
     rs = np.random.RandomState(3)
@@ -452,11 +459,11 @@ def test_cold_compile_does_not_block_warm_dispatch(tmp_path):
     entered = threading.Event()
     real_build = eng._build
 
-    def slow_build(bucket, size, k):
+    def slow_build(model, bucket, size, k):
         if size == 16:  # the cold size hangs in "compile" until released
             entered.set()
             assert gate.wait(10)
-        return real_build(bucket, size, k)
+        return real_build(model, bucket, size, k)
 
     eng._build = slow_build  # type: ignore[method-assign]
     cold_out = []
@@ -519,15 +526,15 @@ def test_offladder_lru_bounds_caches(tmp_path):
     for s in (8, 12, 16, 20):  # adversarial off-ladder size scan
         out = eng.predict(np.zeros((1, s, s, 3), np.float32))  # padded -> staging too
         assert out.shape == (1, 10)
-    assert (2, 24, 1) in eng._compiled  # the ladder executable is pinned
-    off = sorted(k[1] for k in eng._compiled if k[1] != 24)
+    assert ("default", 2, 24, 1) in eng._compiled  # the ladder executable is pinned
+    off = sorted(k[2] for k in eng._compiled if k[2] != 24)
     assert off == [16, 20]  # LRU kept the two most recent scan sizes
     assert reg.snapshot()["serve.evicted_executables"] - base == 2
     assert all(k[1] in (24, 16, 20) for k in eng._staging)  # staging evicts too
     # an LRU hit refreshes recency: 16 survives the next insertion, 20 goes
     eng.predict(np.zeros((1, 16, 16, 3), np.float32))
     eng.predict(np.zeros((1, 28, 28, 3), np.float32))
-    assert sorted(k[1] for k in eng._compiled if k[1] != 24) == [16, 28]
+    assert sorted(k[2] for k in eng._compiled if k[2] != 24) == [16, 28]
     with pytest.raises(ValueError, match="offladder_cache"):
         InferenceEngine(bundle, buckets=(2,), offladder_cache=0)
 

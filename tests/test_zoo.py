@@ -1,0 +1,600 @@
+"""Multi-model zoo tests (docs/SERVING.md "Multi-model zoo & cascade").
+
+The subsystem's load-bearing claims, each pinned:
+
+- **multi-tenant parity**: a zoo engine's answer for model M is bitwise
+  identical to a dedicated single-bundle engine serving M's bundle — the
+  shared slot pool and dispatch path add NOTHING to any tenant's math.
+- **per-model offladder isolation** (satellite): a size-churn burst on one
+  tenant never evicts another tenant's warm executables; the SHARED staging
+  pool for an evicted geometry survives while any tenant still holds it.
+- **typed unknown-model rejection** (satellite): X-Model naming an unserved
+  model is a typed arrival-time error carrying the served list, counted.
+- **bundle identity** (satellite): model_name + content digest stamp the
+  artifact; load verifies; an alias across names is refused; a fleet where
+  one name maps to two digests refuses the late joiner's registration.
+- **model-aware placement**: the router routes a request for M only to
+  replicas advertising M; a healthy fleet with no M replica is a typed
+  placement gap (503), distinct from NoHealthyReplicas.
+- **confidence cascade**: low-margin small-tier answers escalate to the big
+  tier on the cascade trace band, preserving remaining deadline; a burned
+  budget or a failed escalation returns the small answer, never a failure.
+- **staging-slot reuse under model churn** (satellite): two models with
+  different image ladders through ONE pipelined batcher over ONE slot pool
+  stay bitwise-correct and drain clean — no fence is crossed between models.
+"""
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from yet_another_mobilenet_series_tpu.config import CascadeConfig, ModelConfig, ZooConfig
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+from yet_another_mobilenet_series_tpu.serve.admission import (
+    AdmissionController,
+    ModelQueueFull,
+    UnknownModel,
+)
+from yet_another_mobilenet_series_tpu.serve.cascade import CascadeTier, softmax_margin
+from yet_another_mobilenet_series_tpu.serve.context import TRACE_SEQ_CASCADE_BASE
+from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.export import (
+    BundleDigestMismatch,
+    export_bundle,
+    load_bundle,
+)
+from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+from yet_another_mobilenet_series_tpu.serve.router import (
+    ModelDigestConflict,
+    NoHealthyReplicas,
+    NoReplicaForModel,
+    Router,
+)
+from yet_another_mobilenet_series_tpu.serve.zoo import (
+    ModelZoo,
+    parse_image_sizes,
+    parse_models,
+    parse_placement,
+    parse_quotas,
+    slot_models,
+    slot_overrides,
+)
+
+
+def _snap(key):
+    return get_registry().snapshot().get(key, 0)
+
+
+def _small_net(num_classes=10, image_size=24):
+    specs = [
+        {"t": 2, "c": 8, "n": 1, "s": 2},
+        {"t": 3, "c": 16, "n": 2, "s": 2},
+    ]
+    return get_model(
+        ModelConfig(arch="mobilenet_v2", num_classes=num_classes, block_specs=specs, dropout=0.0),
+        image_size=image_size,
+    )
+
+
+def _export(tmp_path, name, *, seed=0, num_classes=10, model_name=None):
+    import jax
+    import jax.numpy as jnp
+
+    net = _small_net(num_classes=num_classes)
+    params, state = net.init(jax.random.PRNGKey(seed))
+    # non-trivial BN stats so the folded forward is not the identity affine
+    k = jax.random.PRNGKey(seed + 1)
+    leaves, treedef = jax.tree.flatten(state)
+    keys = jax.random.split(k, len(leaves))
+    state = jax.tree.unflatten(
+        treedef,
+        [l + 0.1 * jnp.abs(jax.random.normal(kk, l.shape)) + 0.01
+         for l, kk in zip(leaves, keys)],
+    )
+    out = export_bundle(net, params, state, str(tmp_path / name), model_name=model_name)
+    return load_bundle(out)
+
+
+# ---------------------------------------------------------------------------
+# zoo config parsers + per-slot placement overrides
+# ---------------------------------------------------------------------------
+
+
+def test_zoo_spec_parsers():
+    assert parse_models("small=/b/s, big=/b/b") == {"small": "/b/s", "big": "/b/b"}
+    for bad in ("small", "small=", "=x", "a b=/x", "small=/a,small=/b"):
+        with pytest.raises(ValueError):
+            parse_models(bad)
+    groups = parse_placement("small|big;big", ["small", "big"])
+    assert groups == [("small", "big"), ("big",)]
+    # groups repeat cyclically over fleet slots
+    assert [slot_models(groups, i) for i in range(3)] == [
+        ("small", "big"), ("big",), ("small", "big")]
+    assert parse_placement("", ["small", "big"]) == [("small", "big")]
+    with pytest.raises(ValueError, match="unknown model"):
+        parse_placement("small|nope", ["small"])
+    with pytest.raises(ValueError, match="unroutable"):
+        parse_placement("small", ["small", "big"])  # big placed nowhere
+    with pytest.raises(ValueError, match="empty slot group"):
+        parse_placement("small;;small", ["small"])
+    assert parse_quotas("small=64,big=16") == {"small": 64, "big": 16}
+    with pytest.raises(ValueError):
+        parse_quotas("small=0")
+    assert parse_image_sizes("small=192|160,big=224") == {
+        "small": (160, 192), "big": (224,)}
+    with pytest.raises(ValueError):
+        parse_image_sizes("small=-3")
+
+
+def test_slot_overrides_filter_to_the_slot_subset():
+    zc = ZooConfig(models="small=/b/s,big=/b/b", default="small",
+                   placement="small|big;big", quotas="small=64,big=16",
+                   image_sizes="small=160|192,big=224")
+    # slot 1 serves only "big": small's quota/sizes must NOT ride along (a
+    # replica config naming a model it does not load is a validation error)
+    ov = slot_overrides(zc, 1)
+    assert "serve.zoo.models=big=/b/b" in ov
+    assert "serve.zoo.placement=" in ov  # a replica serves its whole assignment
+    assert "serve.zoo.default=big" in ov  # the configured default is absent here
+    assert "serve.zoo.quotas=big=16" in ov
+    assert "serve.zoo.image_sizes=big=224" in ov
+    # slot 0 serves both: everything passes through, default preserved
+    ov0 = slot_overrides(zc, 0)
+    assert "serve.zoo.models=small=/b/s,big=/b/b" in ov0
+    assert "serve.zoo.default=small" in ov0
+    assert "serve.zoo.quotas=small=64,big=16" in ov0
+
+
+# ---------------------------------------------------------------------------
+# bundle identity: model_name + content digest (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_identity_stamp_verify_and_tamper(tmp_path):
+    b = _export(tmp_path, "stamped", model_name="small")
+    assert b.model_name == "small"
+    assert b.digest and len(b.digest) >= 16
+    # tamper with one weight: the load-time digest check refuses the artifact
+    npz = tmp_path / "stamped" / "weights.npz"
+    flat = dict(np.load(npz))
+    key = sorted(flat)[0]
+    flat[key] = flat[key] + 1.0
+    np.savez(npz, **flat)
+    with pytest.raises(BundleDigestMismatch):
+        load_bundle(str(tmp_path / "stamped"))
+
+
+def test_zoo_from_config_loads_and_refuses_aliases(tmp_path):
+    _export(tmp_path, "s", seed=0, model_name="small")
+    _export(tmp_path, "b", seed=7, model_name="big")
+    zc = ZooConfig(models=f"small={tmp_path / 's'},big={tmp_path / 'b'}",
+                   default="big", quotas="small=8", image_sizes="small=24")
+    zoo = ModelZoo.from_config(zc)
+    assert zoo.models == ("small", "big") and zoo.default == "big"
+    digests = zoo.digests()
+    assert digests["small"] and digests["small"] != digests["big"]
+    # lease advertisement carries every name with its digest
+    assert set(zoo.lease_models()) == {"small", "big"}
+    assert zoo.admission_kwargs()["model_quotas"] == {"small": 8}
+    # a bundle stamped "small" configured under the name "huge" is an alias
+    # pointing at the wrong artifact — exactly what the stamp exists to catch
+    with pytest.raises(ValueError, match="stamped model_name"):
+        ModelZoo.from_config(ZooConfig(models=f"huge={tmp_path / 's'}"))
+    with pytest.raises(ValueError, match="not among models"):
+        ModelZoo.from_config(ZooConfig(models=f"small={tmp_path / 's'}", default="nope"))
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant engine: parity, shared staging, per-model offladder LRU
+# ---------------------------------------------------------------------------
+
+
+def test_engine_multitenant_parity_and_shared_staging(tmp_path):
+    """Each tenant of a zoo engine answers bitwise-identically to a
+    dedicated engine serving that bundle alone; staging pools stay keyed by
+    geometry only (tenants SHARE them)."""
+    get_registry().reset()
+    bs = _export(tmp_path, "s", seed=0, num_classes=10)
+    bb = _export(tmp_path, "b", seed=7, num_classes=7)
+    eng = InferenceEngine(models={"small": bs, "big": bb}, buckets=(2,),
+                          fuse_ladder=())
+    eng.warmup()
+    ref_s = InferenceEngine(bs, buckets=(2,), fuse_ladder=())
+    ref_b = InferenceEngine(bb, buckets=(2,), fuse_ladder=())
+    x = np.random.RandomState(3).normal(0, 1, (2, 24, 24, 3)).astype(np.float32)
+    out_s = eng.predict(x.copy(), model="small")
+    out_b = eng.predict(x.copy(), model="big")
+    assert out_s.shape == (2, 10) and out_b.shape == (2, 7)
+    np.testing.assert_array_equal(out_s, ref_s.predict(x.copy()))
+    np.testing.assert_array_equal(out_b, ref_b.predict(x.copy()))
+    # default tenant answers unqualified requests (first name wins here)
+    assert eng.default_model == "small"
+    np.testing.assert_array_equal(eng.predict(x.copy()), out_s)
+    # executables are per-tenant; the staging pool for the shared geometry
+    # is ONE (keyed (bucket, size, K) — host buffers know no tenant), shared
+    # by the padded dispatches both tenants just made
+    np.testing.assert_array_equal(eng.predict(x[:1].copy(), model="small"),
+                                  ref_s.predict(x[:1].copy()))
+    np.testing.assert_array_equal(eng.predict(x[:1].copy(), model="big"),
+                                  ref_b.predict(x[:1].copy()))
+    assert ("small", 2, 24, 1) in eng._compiled and ("big", 2, 24, 1) in eng._compiled
+    assert sum(1 for k in eng._staging if k == (2, 24, 1)) == 1
+
+
+def test_offladder_lru_is_per_model_no_cross_eviction(tmp_path):
+    """Satellite: a size-churn burst on one tenant fills only ITS offladder
+    slice; the other tenant's warm executables survive, and a shared-geometry
+    staging pool is dropped only when NO tenant still compiles it."""
+    get_registry().reset()
+    bs = _export(tmp_path, "s", seed=0)
+    bb = _export(tmp_path, "b", seed=7)
+    eng = InferenceEngine(models={"small": bs, "big": bb}, buckets=(2,),
+                          fuse_ladder=(), offladder_cache=2)
+    eng.warmup()
+    for s in (8, 12, 16, 20):  # churn burst on "small" only
+        assert eng.predict(np.zeros((1, s, s, 3), np.float32), model="small").shape == (1, 10)
+    # small's slice kept the 2 most recent; evictions counted
+    assert sorted(k[2] for k in eng._compiled if k[0] == "small" and k[2] != 24) == [16, 20]
+    assert _snap("serve.evicted_executables") == 2
+    # the OTHER tenant's ladder executable was never a candidate
+    assert ("big", 2, 24, 1) in eng._compiled
+    # churn on "big" lives in big's own slice; small's survivors stay warm
+    for s in (8, 16):
+        eng.predict(np.zeros((1, s, s, 3), np.float32), model="big")
+    assert sorted(k[2] for k in eng._compiled if k[0] == "small" and k[2] != 24) == [16, 20]
+    assert sorted(k[2] for k in eng._compiled if k[0] == "big" and k[2] != 24) == [8, 16]
+    # now BOTH tenants hold geometry 16. Churning 16 out of small's slice
+    # must keep the shared staging pool alive (big still dispatches into it)
+    eng.predict(np.zeros((1, 26, 26, 3), np.float32), model="small")  # evicts 16
+    eng.predict(np.zeros((1, 28, 28, 3), np.float32), model="small")  # evicts 20
+    assert ("small", 2, 16, 1) not in eng._compiled
+    assert ("big", 2, 16, 1) in eng._compiled
+    assert (2, 16, 1) in eng._staging  # survives: big still holds it
+    assert (2, 20, 1) not in eng._staging  # no tenant holds 20 anymore
+    # and big's answers through the surviving shared pool stay correct
+    assert eng.predict(np.zeros((1, 16, 16, 3), np.float32), model="big").shape == (1, 10)
+
+
+# ---------------------------------------------------------------------------
+# admission edge: unknown-model rejection + per-model quotas (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _GateEngine:
+    """predict_async double whose results block on a gate: requests stay
+    in-system until released, making in-system quotas testable."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+
+    def predict_async(self, images, model=None):
+        gate = self.gate
+
+        class _H:
+            def result(_self):
+                assert gate.wait(10)
+                return images[:, 0, 0, :1]
+
+        return _H()
+
+    def predict(self, images, model=None):
+        return self.predict_async(images, model=model).result()
+
+
+def test_admission_rejects_unknown_model_typed_and_counted():
+    get_registry().reset()
+    eng = _GateEngine()
+    eng.gate.set()  # nothing blocks in this test
+    batcher = PipelinedBatcher(eng, max_batch=1, max_wait_ms=0.0,
+                               drain_timeout_s=2.0).start()
+    try:
+        adm = AdmissionController(batcher, max_retries=0,
+                                  models=("small", "big"), default_model="small")
+        with pytest.raises(UnknownModel) as ei:
+            adm.submit(np.zeros((4, 4, 3), np.float32), model="nope")
+        assert ei.value.model == "nope" and ei.value.served == ("small", "big")
+        assert _snap("serve.rejected_unknown_model") == 1
+        # unqualified requests resolve to the default model and serve
+        out = adm.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+        assert out is not None
+        assert _snap("serve.model_requests.small") == 1
+        doc = adm.state()["models"]
+        assert set(doc) == {"small", "big"} and doc["small"]["default"] is True
+    finally:
+        batcher.stop()
+
+
+def test_admission_per_model_quota_cannot_starve_other_tenants():
+    get_registry().reset()
+    eng = _GateEngine()
+    batcher = PipelinedBatcher(eng, max_batch=1, max_wait_ms=0.0,
+                               drain_timeout_s=2.0).start()
+    try:
+        adm = AdmissionController(batcher, max_retries=0,
+                                  models=("small", "big"), default_model="small",
+                                  model_quotas={"big": 1})
+        img = np.zeros((4, 4, 3), np.float32)
+        f_big = adm.submit(img, model="big")  # occupies big's whole quota
+        with pytest.raises(ModelQueueFull):
+            adm.submit(img, model="big")
+        assert _snap("serve.rejected_model_full") == 1
+        # the full tenant does not starve the others
+        f_small = adm.submit(img, model="small")
+        eng.gate.set()
+        assert f_big.result(timeout=5) is not None
+        assert f_small.result(timeout=5) is not None
+        # completion released the slot: big admits again
+        assert adm.submit(img, model="big").result(timeout=5) is not None
+    finally:
+        eng.gate.set()
+        batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# router: model-aware placement + digest-conflict refusal
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplicaClient:
+    def __init__(self, host, port):
+        self.key = f"{host}:{port}"
+        self.predicts = 0
+        self.health = (200, {"breaker_state": 0, "queued_total": 0, "draining": False,
+                             "replica": {"replica_id": self.key, "start_unix": 1.0}})
+
+    def predict(self, image, **kw):
+        self.predicts += 1
+        return np.asarray([float(self.key.rsplit(":", 1)[1])], np.float32)
+
+    def healthz(self, timeout_s=None):
+        return self.health
+
+    def close(self):
+        pass
+
+
+def _fake_router(n=2, **kw):
+    fakes = {}
+
+    def factory(host, port):
+        fakes[f"{host}:{port}"] = c = _FakeReplicaClient(host, port)
+        return c
+
+    backends = [("127.0.0.1", 9000 + i) for i in range(n)]
+    return Router(backends, client_factory=factory, seed=0, **kw), fakes
+
+
+def test_router_model_aware_pick_and_typed_placement_gap():
+    get_registry().reset()
+    router, fakes = _fake_router(2)
+    try:
+        router.set_backend_models({"127.0.0.1:9000": {"small": ""},
+                                   "127.0.0.1:9001": {"big": ""}})
+        img = np.zeros((4, 4, 3), np.float32)
+        # every small request lands on the only replica advertising small
+        for _ in range(6):
+            assert float(router.submit(img, model="small").result(timeout=5)[0]) == 9000.0
+        assert fakes["127.0.0.1:9001"].predicts == 0
+        # a model nobody advertises is a typed placement gap — a subclass of
+        # NoHealthyReplicas so every existing 503 path still catches it
+        with pytest.raises(NoReplicaForModel) as ei:
+            router.submit(img, model="nope").result(timeout=5)
+        assert isinstance(ei.value, NoHealthyReplicas)
+        assert ei.value.model == "nope" and ei.value.served == ("big", "small")
+        # clearing an advertisement returns the replica to route-everything
+        router.set_backend_models({"127.0.0.1:9001": None})
+        got = {float(router.submit(img, model="nope").result(timeout=5)[0])
+               for _ in range(4)}
+        assert got == {9001.0}
+        assert router.state()["fleet"]["models"] == ["small"]
+    finally:
+        router.stop()
+
+
+def test_router_register_refuses_digest_conflicts():
+    get_registry().reset()
+    router, _ = _fake_router(0)
+    try:
+        out = router.register("127.0.0.1", 9100, models={"m": "aaa"})
+        assert out["models"] == ["m"]
+        # same name + same digest: a healthy twin, admitted
+        router.register("127.0.0.1", 9101, models={"m": "aaa"})
+        # same name + DIFFERENT digest: split-brain artifact identity — the
+        # late joiner is refused loudly, not folded into the pick lottery
+        with pytest.raises(ModelDigestConflict):
+            router.register("127.0.0.1", 9102, models={"m": "bbb"})
+        assert _snap("fleet.rejected_digest_conflict") == 1
+        assert "127.0.0.1:9102" not in {key for key, _ in router.backends()}
+        # an EMPTY digest is placement-only knowledge, never a conflict
+        router.register("127.0.0.1", 9103, models={"m": ""})
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# confidence cascade
+# ---------------------------------------------------------------------------
+
+
+def test_softmax_margin_properties():
+    assert softmax_margin(np.asarray([5.0])) == 1.0  # single class: certain
+    assert softmax_margin(np.asarray([3.0, 3.0])) == pytest.approx(0.0)
+    assert softmax_margin(np.asarray([9.0, 0.0])) > softmax_margin(np.asarray([1.0, 0.0]))
+    # shift invariance (the stable-softmax property)
+    a = np.asarray([2.0, 1.0, 0.5])
+    assert softmax_margin(a) == pytest.approx(softmax_margin(a + 100.0))
+
+
+class _ScriptRouter:
+    """submit() double: answers per-model scripted logits (or raises)."""
+
+    def __init__(self, logits):
+        self.logits = dict(logits)
+        self.calls = []
+
+    def submit(self, image, *, priority=None, deadline_ms=None, ctx=None,
+               model=None, seq_base=None):
+        self.calls.append({"model": model, "deadline_ms": deadline_ms,
+                           "ctx": ctx, "seq_base": seq_base})
+        f = Future()
+        v = self.logits[model]
+        if isinstance(v, Exception):
+            f.set_exception(v)
+        else:
+            f.set_result(v)
+        return f
+
+    def state(self):
+        return {"router": True}
+
+    def register(self, host, port, **kw):
+        return {"ok": True, "key": f"{host}:{port}"}
+
+
+def test_cascade_confident_answers_small_no_escalation():
+    get_registry().reset()
+    rt = _ScriptRouter({"s": np.asarray([30.0, 0.0, 0.0]), "b": np.asarray([1.0, 2.0, 3.0])})
+    tier = CascadeTier(rt, small="s", big="b", threshold=0.15)
+    out = tier.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+    np.testing.assert_array_equal(out, rt.logits["s"])
+    assert [c["model"] for c in rt.calls] == ["s"]
+    assert _snap("serve.cascade.answered_small") == 1
+    assert _snap("serve.cascade.escalations") == 0
+    assert _snap("serve.cascade.escalation_rate") == 0.0
+
+
+def test_cascade_escalates_low_margin_on_cascade_trace_band():
+    get_registry().reset()
+    rt = _ScriptRouter({"s": np.asarray([0.0, 0.0, 0.0]), "b": np.asarray([1.0, 2.0, 3.0])})
+    tier = CascadeTier(rt, small="s", big="b", threshold=0.15)
+    out = tier.submit(np.zeros((4, 4, 3), np.float32),
+                      deadline_ms=60_000.0).result(timeout=5)
+    np.testing.assert_array_equal(out, rt.logits["b"])  # the big tier answered
+    assert [c["model"] for c in rt.calls] == ["s", "b"]
+    esc = rt.calls[1]
+    # the escalation is its own routed request: fresh ctx pinned to the big
+    # tier, legs stamped in the cascade seq band (never a retry/hedge seq),
+    # and the REMAINING deadline budget — not the original — rides along
+    assert esc["seq_base"] == TRACE_SEQ_CASCADE_BASE
+    assert esc["ctx"].model == "b"
+    assert esc["deadline_ms"] is not None and 0 < esc["deadline_ms"] <= 60_000.0
+    assert _snap("serve.cascade.escalations") == 1
+    assert _snap("serve.cascade.escalation_rate") == 1.0
+    assert tier.state()["cascade"]["escalations"] == 1
+    assert tier.state()["router"] is True  # state merges over the router's
+
+
+def test_cascade_burned_deadline_returns_small_answer():
+    get_registry().reset()
+    small = np.asarray([0.0, 0.0, 0.0])
+    rt = _ScriptRouter({"s": small, "b": np.asarray([9.0, 0.0, 0.0])})
+    tier = CascadeTier(rt, small="s", big="b", threshold=0.15)
+    # any elapsed small-tier time exceeds this budget: escalating would be
+    # a certain 504 — the degraded answer beats a typed failure
+    out = tier.submit(np.zeros((4, 4, 3), np.float32),
+                      deadline_ms=1e-9).result(timeout=5)
+    np.testing.assert_array_equal(out, small)
+    assert [c["model"] for c in rt.calls] == ["s"]
+    assert _snap("serve.cascade.deadline_skips") == 1
+    assert _snap("serve.cascade.escalations") == 0
+
+
+def test_cascade_escalation_failure_falls_back_to_small_answer():
+    get_registry().reset()
+    small = np.asarray([0.0, 0.0, 0.0])
+    rt = _ScriptRouter({"s": small, "b": NoReplicaForModel("b", ("s",))})
+    tier = CascadeTier(rt, small="s", big="b", threshold=0.15)
+    out = tier.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+    np.testing.assert_array_equal(out, small)  # never fail an answered request
+    assert _snap("serve.cascade.escalation_failures") == 1
+    # but a small-tier FAILURE passes through verbatim — cascading is for
+    # answers, not for masking the fleet's admission verdicts
+    rt2 = _ScriptRouter({"s": NoReplicaForModel("s", ()), "b": small})
+    tier2 = CascadeTier(rt2, small="s", big="b")
+    with pytest.raises(NoReplicaForModel):
+        tier2.submit(np.zeros((4, 4, 3), np.float32)).result(timeout=5)
+
+
+def test_cascade_respects_explicit_model_pins_and_delegates():
+    get_registry().reset()
+    big = np.asarray([0.0, 0.0, 0.0])  # ambiguous — would escalate if cascaded
+    rt = _ScriptRouter({"s": np.asarray([9.0, 0.0, 0.0]), "b": big})
+    tier = CascadeTier(rt, small="s", big="b", threshold=0.15)
+    out = tier.submit(np.zeros((4, 4, 3), np.float32), model="b").result(timeout=5)
+    np.testing.assert_array_equal(out, big)  # the chosen model, uncascaded
+    assert [c["model"] for c in rt.calls] == ["b"]
+    assert _snap("serve.cascade.bypassed_explicit") == 1
+    assert _snap("serve.cascade.escalations") == 0
+    # everything but submit/state reaches the wrapped router (membership)
+    assert tier.register("127.0.0.1", 9200)["ok"] is True
+    with pytest.raises(ValueError, match="threshold"):
+        CascadeTier(rt, small="s", big="b", threshold=1.5)
+    with pytest.raises(ValueError, match="both"):
+        CascadeTier(rt, small="s", big="s")
+
+
+def test_cascade_config_validation():
+    with pytest.raises(ValueError, match="small= and big="):
+        CascadeConfig(enable=True, small="", big="b")
+    with pytest.raises(ValueError, match="threshold"):
+        CascadeConfig(threshold=2.0)
+
+
+# ---------------------------------------------------------------------------
+# staging-slot reuse under model churn (satellite): one pipelined batcher,
+# two tenants with different ladders, one shared slot pool
+# ---------------------------------------------------------------------------
+
+
+def test_staging_slot_reuse_under_model_churn_bitwise_and_clean_drain(tmp_path):
+    """Interleaved two-model traffic through ONE PipelinedBatcher over an
+    overlapped engine with a SINGLE staging slot per geometry: every
+    dispatch reuses the same host buffer across tenants, so a missing fence
+    wait between models would tear a row. Answers stay bitwise-identical to
+    dedicated sync engines, and the drain leaves every fence clear."""
+    get_registry().reset()
+    ba = _export(tmp_path, "a", seed=0)
+    bb = _export(tmp_path, "b", seed=7)
+    eng = InferenceEngine(models={"a": ba, "b": bb},
+                          model_image_sizes={"a": (24, 32), "b": (24,)},
+                          buckets=(2,), fuse_ladder=(),
+                          overlap_staging=True, staging_slots=1)
+    eng.warmup()
+    ref_a = InferenceEngine(ba, buckets=(2,), image_size=24, image_sizes=(24, 32),
+                            fuse_ladder=())
+    ref_b = InferenceEngine(bb, buckets=(2,), image_size=24, fuse_ladder=())
+    # prime the 24px pool: it is shared by both tenants and has exactly ONE
+    # slot, so cross-model reuse happens on every alternation below
+    eng.predict(np.zeros((1, 24, 24, 3), np.float32), model="a")
+    assert len(eng._staging[(2, 24, 1)].slots) == 1
+    rng = np.random.RandomState(11)
+    plan = []  # (model, image, ref_row)
+    for i in range(12):
+        model = "a" if i % 2 == 0 else "b"
+        size = 32 if (model == "a" and i % 4 == 0) else 24
+        x = rng.normal(0, 1, (1, size, size, 3)).astype(np.float32)
+        ref = (ref_a if model == "a" else ref_b).predict(x.copy())[0]
+        plan.append((model, x[0], ref))
+    b = PipelinedBatcher(eng, max_batch=2, max_wait_ms=1.0, max_inflight=2,
+                         drain_timeout_s=10.0)
+    b.start()
+    try:
+        futs = [b.submit(img, model=model) for model, img, _ in plan]
+        for (model, _, ref), f in zip(plan, futs):
+            np.testing.assert_array_equal(f.result(timeout=30), ref)
+    finally:
+        b.stop(drain=True)
+    # clean drain: nothing in flight, and the pools (fences clear lazily on
+    # the NEXT acquire, so one may still be armed — but its dispatch synced
+    # when the drain resolved every future) keep serving bitwise answers
+    assert b.inflight() == 0
+    assert set(eng._staging) == {(2, 24, 1), (2, 32, 1)}
+    x = rng.normal(0, 1, (1, 24, 24, 3)).astype(np.float32)
+    np.testing.assert_array_equal(eng.predict(x.copy(), model="a"),
+                                  ref_a.predict(x.copy()))
+    np.testing.assert_array_equal(eng.predict(x.copy(), model="b"),
+                                  ref_b.predict(x.copy()))

@@ -747,10 +747,37 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
             jitter_ms=nc.jitter_ms, bandwidth_kbps=nc.bandwidth_kbps,
             flap_period_s=nc.flap_period_s, flap_down_s=nc.flap_down_s,
         )
-    route_backends = (
-        (lambda addrs: router.set_backends(tier.route(addrs)))
-        if tier is not None else router.set_backends
-    )
+    # model-sharded placement (serve.zoo.placement): each fleet slot spawns
+    # with its OWN serve.zoo.models subset (serve/zoo.py slot_overrides),
+    # and the router learns which models each address serves so its pick
+    # only routes a model to replicas that load it
+    per_slot_argv: dict[int, list[str]] = {}
+    slot_names: dict[int, tuple[str, ...]] = {}
+    if cfg.serve.zoo.models:
+        from ..serve import zoo as zoo_mod
+        paths = zoo_mod.parse_models(cfg.serve.zoo.models)
+        groups = zoo_mod.parse_placement(cfg.serve.zoo.placement, list(paths))
+        for i in range(fc.replicas):
+            per_slot_argv[i] = zoo_mod.slot_overrides(cfg.serve.zoo, i)
+            slot_names[i] = zoo_mod.slot_models(groups, i)
+        log.log("zoo placement: " + "; ".join(
+            f"r{i}:{'|'.join(slot_names[i])}" for i in sorted(slot_names)))
+
+    def _apply_placement() -> None:
+        if not slot_names or fleet is None:
+            return
+        assignments = {}
+        for r in fleet.replicas():
+            if r["addr"] is not None and r["slot"] in slot_names:
+                key = f"{r['addr']['host']}:{r['addr']['port']}"
+                # digest '' = placement-only knowledge; a replica that ALSO
+                # lease-registers overwrites with its stamped digests
+                assignments[key] = {n: "" for n in slot_names[r["slot"]]}
+        router.set_backend_models(assignments)
+
+    def route_backends(addrs) -> None:
+        router.set_backends(tier.route(addrs) if tier is not None else addrs)
+        _apply_placement()
     # --attach (serve.fleet.attach): the router tier over EXTERNALLY-managed
     # replicas — no local spawn, no supervisor. This IS the multi-host
     # deployment shape, rehearsed on loopback: replicas live wherever they
@@ -769,16 +796,31 @@ def run(cfg: Config, replica_argv: list[str]) -> dict:
             restart_backoff_max_s=fc.restart_backoff_max_s,
             spawn_timeout_s=fc.spawn_timeout_s,
             drain_timeout_s=cfg.serve.drain_timeout_s + 10.0,
+            per_slot_argv=per_slot_argv,
             on_change=route_backends,
             logger=log,
         )
+    # confidence cascade (serve/cascade.py): the frontend consumes the
+    # cascade TIER instead of the bare router — small model answers, low
+    # top-1-margin answers re-submit to the big tier; membership/
+    # registration/introspection delegate through to the router
+    serving_tier = router
+    if cfg.serve.zoo.cascade.enable:
+        from ..serve.cascade import CascadeTier
+        cc = cfg.serve.zoo.cascade
+        serving_tier = CascadeTier(
+            router, small=cc.small, big=cc.big, threshold=cc.threshold,
+            respect_explicit_model=cc.respect_explicit_model,
+        )
+        log.log(f"cascade armed: {cc.small} -> {cc.big} "
+                f"(escalate below margin {cc.threshold:.2f})")
     result: dict = {}
     frontend = autoscaler = chaos = brownout = watchdog = None
     try:
         if fleet is not None:
             fleet.start()
         frontend = Frontend(
-            router,
+            serving_tier,
             host=cfg.serve.listen.host,
             port=cfg.serve.listen.port,
             request_timeout_s=cfg.serve.listen.request_timeout_s,
@@ -945,10 +987,11 @@ def main(argv=None):
         cleaned.append(a)
         i += 1
     cfg = parse_cli(cleaned)
-    if not cfg.serve.fleet.attach and not (cfg.serve.bundle or cfg.serve.export_from):
+    if not cfg.serve.fleet.attach and not (
+            cfg.serve.bundle or cfg.serve.export_from or cfg.serve.zoo.models):
         # attach mode spawns nothing: the remote replicas own their bundles
-        raise ValueError("fleet: needs serve.bundle (replicas load it at spawn) "
-                         "or --attach host:port,...")
+        raise ValueError("fleet: needs serve.bundle or serve.zoo.models (replicas "
+                         "load them at spawn) or --attach host:port,...")
     return run(cfg, cleaned)
 
 

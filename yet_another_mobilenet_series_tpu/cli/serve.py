@@ -188,7 +188,7 @@ def _serving_info(batcher, admission) -> dict:
     return info
 
 
-def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
+def _listen(cfg: Config, engine, log: Logger, reg, tracer, zoo=None) -> dict:
     """The front-door serving loop: HTTP frontend + admission + batcher,
     running until SIGTERM/SIGINT."""
     stop_event = threading.Event()
@@ -244,6 +244,10 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
         batcher,
         cfg.serve.admission,
         heartbeat=(lambda: watchdog.arm(phase="serve")) if watchdog is not None else None,
+        # zoo'd replicas validate X-Model at the door and meter per-model
+        # quotas (serve/zoo.py admission_kwargs); a bundle replica keeps the
+        # pre-zoo behavior (no model vocabulary, nothing to reject)
+        **(zoo.admission_kwargs() if zoo is not None else {}),
     )
     if watchdog is not None:
         watchdog.register_info("serving", lambda: _serving_info(batcher, admission))
@@ -296,11 +300,15 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
     # is the router's signal this process (or the route to it) vanished.
     reg_client = None
     if cfg.serve.listen.register_to:
-        from ..serve.client import ReplicaClient
+        from ..serve.client import ClientHTTPError, ReplicaClient
         r_host, r_port = cfg.serve.listen.register_to.rsplit(":", 1)
         ttl_s = cfg.serve.listen.register_ttl_s
         reg_client = ReplicaClient(r_host, int(r_port), timeout_s=5.0,
                                    connect_timeout_s=2.0)
+        # the lease's served-model advertisement ({name: digest}): the
+        # router routes a model only to replicas advertising it, and refuses
+        # a digest that conflicts with another live replica's for the name
+        lease_models = zoo.lease_models() if zoo is not None else None
 
         def _heartbeat():
             try:  # YAMT011: a dead heartbeat thread = silent lease expiry
@@ -308,8 +316,18 @@ def _listen(cfg: Config, engine, log: Logger, reg, tracer) -> dict:
                 while not stop_event.is_set():
                     try:
                         reg_client.register(addr["host"], addr["port"], ttl_s=ttl_s,
-                                            replica_id=frontend.replica_id)
+                                            replica_id=frontend.replica_id,
+                                            models=lease_models)
                         reg.counter("serve.register_heartbeats").inc()
+                    except ClientHTTPError as e:
+                        if e.tag == "digest_conflict":
+                            # the fleet serves a DIFFERENT artifact under one
+                            # of our model names: renewing can never succeed,
+                            # so stop beating loudly instead of spinning
+                            reg.counter("serve.register_conflicts").inc()
+                            log.log(f"[serve] register REFUSED (digest conflict): {e}")
+                            return
+                        reg.counter("serve.register_failures").inc()
                     except Exception:  # noqa: BLE001 — the router may be down;
                         # keep beating: the next renewal re-admits us
                         reg.counter("serve.register_failures").inc()
@@ -394,13 +412,19 @@ def run(cfg: Config) -> dict:
             log.log(f"exported {cfg.serve.export_from} -> {bundle_dir}"
                     + (" (int8 weights, parity-gated)" if calib is not None else ""))
             result["bundle"] = bundle_dir
-        if not bundle_dir:
-            raise ValueError("serve: needs serve.bundle and/or serve.export_from")
+        # multi-model zoo (serve.zoo.models set): N named bundles behind one
+        # engine/admission edge, each request picking its tenant via X-Model
+        zoo = None
+        if cfg.serve.zoo.models:
+            from ..serve.zoo import ModelZoo
+            zoo = ModelZoo.from_config(cfg.serve.zoo)
+            log.log(f"zoo: serving {', '.join(zoo.models)} (default {zoo.default})")
+        if not bundle_dir and zoo is None:
+            raise ValueError(
+                "serve: needs serve.bundle, serve.zoo.models, and/or serve.export_from")
 
-        bundle = load_bundle(bundle_dir)
         mesh = mesh_lib.make_mesh(cfg.dist.num_devices) if cfg.serve.data_parallel else None
-        engine = InferenceEngine(
-            bundle,
+        eng_kw = dict(
             buckets=cfg.serve.buckets,
             compute_dtype=cfg.serve.compute_dtype,
             mesh=mesh,
@@ -415,6 +439,11 @@ def run(cfg: Config) -> dict:
             wire_mean=cfg.data.mean,
             wire_std=cfg.data.std,
         )
+        if zoo is not None:
+            engine = InferenceEngine(**zoo.engine_kwargs(), **eng_kw)
+        else:
+            bundle = load_bundle(bundle_dir)
+            engine = InferenceEngine(bundle, **eng_kw)
         # quantization mode rides the build_info family (/metrics, /varz):
         # a scraped fleet can group replicas by the bytes they serve with
         reg.set_build_info({**obs_device.build_info(), "quant_mode": engine.quant_mode})
@@ -441,7 +470,7 @@ def run(cfg: Config) -> dict:
             finally:
                 batcher.stop()
         if cfg.serve.listen.enable:
-            result.update(_listen(cfg, engine, log, reg, tracer))
+            result.update(_listen(cfg, engine, log, reg, tracer, zoo=zoo))
         return result
     finally:
         if tracer.enabled and cfg.train.log_dir and is_coord:

@@ -899,6 +899,63 @@ class OverlapConfig:
 
 
 @dataclass(frozen=True)
+class CascadeConfig:
+    """Confidence cascade (serve/cascade.py, docs/SERVING.md "Multi-model
+    zoo & cascade"): the cheap small-tier model answers every request; a
+    response whose top-1 softmax margin falls below ``threshold``
+    re-submits to the big tier at the ROUTER (riding the existing leg
+    machinery with a distinct trace seq). Escalation preserves the
+    request's remaining deadline. At millions-of-users scale this is the
+    dominant serving-cost lever: most traffic never touches the big model."""
+
+    enable: bool = False
+    # zoo model names of the two tiers; both must be served by the fleet
+    small: str = ""
+    big: str = ""
+    # escalate when top-1 softmax probability minus top-2 is below this
+    threshold: float = 0.15
+    # explicit X-Model requests bypass the cascade (the client asked for a
+    # specific model); False forces everything through the small tier first
+    respect_explicit_model: bool = True
+
+    def __post_init__(self):
+        if not 0.0 <= self.threshold <= 1.0:
+            raise ValueError(
+                f"serve.zoo.cascade.threshold must be in [0, 1], got {self.threshold}")
+        if self.enable and (not self.small or not self.big):
+            raise ValueError("serve.zoo.cascade needs both small= and big= model names")
+
+
+@dataclass(frozen=True)
+class ZooConfig:
+    """Multi-model zoo (serve/zoo.py, docs/SERVING.md "Multi-model zoo &
+    cascade"): N named InferenceBundles behind ONE multi-tenant engine —
+    per-model AOT ladders keyed (model, bucket, image_size, K) over a
+    SHARED staging slot pool and dispatch path, per-model admission
+    quotas, an X-Model wire identity, and model-aware fleet placement
+    (the lease registration advertises each replica's served set;
+    cli/fleet.py spawns per-slot assignments from ``placement``)."""
+
+    # "name=/bundle/dir,name2=/dir2" — the served set; "" = single-bundle
+    # legacy serving via serve.bundle
+    models: str = ""
+    # model an X-Model-less request is served by; "" = first spec entry
+    default: str = ""
+    # fleet placement: ";"-separated slot groups of "|"-joined model names,
+    # e.g. "small|big;big" = slot 0 serves both, slot 1 serves big only;
+    # "" = every slot serves the full model set
+    placement: str = ""
+    # per-model in-system request quotas: "small=64,big=16"; unlisted
+    # models are bounded only by the queue depth
+    quotas: str = ""
+    # per-model image-size ladders: "small=160|192,big=224"; unlisted
+    # models ride serve.image_sizes
+    image_sizes: str = ""
+    # the confidence cascade over the zoo's small/big tiers
+    cascade: CascadeConfig = field(default_factory=CascadeConfig)
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Inference serving (serve/, docs/SERVING.md): export a checkpoint to a
     folded InferenceBundle and/or serve a bundle through the AOT-batched
@@ -952,6 +1009,9 @@ class ServeConfig:
     # entries are pinned): a size-scanning client cannot OOM the server;
     # evictions count serve.evicted_executables
     offladder_cache: int = 8
+    # multi-model zoo: N named bundles behind one multi-tenant engine,
+    # X-Model wire identity, model-sharded fleet placement, cascade
+    zoo: ZooConfig = field(default_factory=ZooConfig)
     # quantized serving: uint8 wire + int8 weight export (parity-gated)
     quant: QuantConfig = field(default_factory=QuantConfig)
     # fused multi-chunk dispatch: whole-request inference in one dispatch
@@ -1053,6 +1113,8 @@ _SECTION_TYPES = {
     "QuantConfig": QuantConfig,
     "FuseChunksConfig": FuseChunksConfig,
     "OverlapConfig": OverlapConfig,
+    "CascadeConfig": CascadeConfig,
+    "ZooConfig": ZooConfig,
     "ServeConfig": ServeConfig,
     "Config": Config,
 }

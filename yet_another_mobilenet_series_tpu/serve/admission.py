@@ -94,6 +94,26 @@ class ClassQueueFull(QueueFull):
     share (other classes may still be admitting)."""
 
 
+class UnknownModel(ValueError):
+    """Rejected at arrival: the request's ``X-Model`` names a model this
+    replica does not serve. Typed so the frontend answers 400 with the
+    served-model list in the body (never a KeyError-shaped 500) and the
+    client surfaces a typed :class:`~.client.ClientHTTPError` tag.
+    ``served`` rides the exception for the error body."""
+
+    def __init__(self, model: str, served):
+        self.model = model
+        self.served = tuple(served)
+        super().__init__(
+            f"unknown model {model!r}; served: {', '.join(self.served) or '(none)'}")
+
+
+class ModelQueueFull(QueueFull):
+    """Rejected at arrival: this model is at its configured in-system quota
+    (serve.zoo.quotas) — other models may still be admitting, so a burst on
+    one zoo tenant can never starve the others."""
+
+
 class CircuitBreaker:
     """Consecutive-failure breaker with a single half-open probe.
 
@@ -181,9 +201,10 @@ class _Pending:
     """Admission-side bookkeeping for one in-system request (survives
     retries — the class quota slot is held until final resolution)."""
 
-    __slots__ = ("cls", "image", "t_submit", "t_deadline", "retries_left", "probe", "attempt", "ctx")
+    __slots__ = ("cls", "image", "t_submit", "t_deadline", "retries_left", "probe", "attempt", "ctx",
+                 "model")
 
-    def __init__(self, cls, image, deadline_s, retries_left, probe, ctx):
+    def __init__(self, cls, image, deadline_s, retries_left, probe, ctx, model=None):
         self.cls = cls
         self.image = image
         self.t_submit = time.perf_counter()
@@ -192,6 +213,7 @@ class _Pending:
         self.probe = probe
         self.attempt = 0
         self.ctx = ctx
+        self.model = model
 
 
 class AdmissionController:
@@ -219,6 +241,9 @@ class AdmissionController:
         predictor_quantile: float = 0.9,
         seed: int = 0,
         heartbeat=None,
+        models=None,
+        default_model: str | None = None,
+        model_quotas=None,
     ):
         if predictor not in ("ewma", "quantile"):
             raise ValueError(f"predictor must be 'ewma' or 'quantile', got {predictor!r}")
@@ -259,6 +284,16 @@ class AdmissionController:
         # rid -> RequestContext for every request currently in the system:
         # the hang report's "whose request is wedged" section reads this
         self._inflight_ctx: dict[int, RequestContext] = {}
+        # zoo tenancy (serve/zoo.py): the served-model set (None = legacy
+        # single-model process, X-Model left unvalidated here), the name
+        # unqualified requests resolve to, and optional per-model in-system
+        # quotas so a burst on one tenant can never starve the others
+        self._models: tuple[str, ...] | None = tuple(models) if models else None
+        if default_model is not None and self._models is not None and default_model not in self._models:
+            raise ValueError(f"default_model {default_model!r} not in served set {self._models}")
+        self._default_model = default_model or (self._models[0] if self._models else None)
+        self._model_quota = {k: int(v) for k, v in dict(model_quotas or {}).items()}
+        self._in_model: dict[str, int] = {}
         self._reg = get_registry()
 
     # -- the arrival-time wait predictor ------------------------------------
@@ -330,12 +365,22 @@ class AdmissionController:
         priority: str | None = None,
         deadline_ms: float | None = None,
         ctx: RequestContext | None = None,
+        model: str | None = None,
     ) -> Future:
         cls = priority or self._default_class
         if cls not in CLASSES:
             raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
+        # model resolution + validation FIRST: a client naming an unserved
+        # model is a 400-class error regardless of brownout/breaker state —
+        # reject before any policy machinery can spend a probe or a slot
+        model = model or (ctx.model if ctx is not None else None) or self._default_model
+        if self._models is not None and model is not None and model not in self._models:
+            self._reject(cls, "serve.rejected_unknown_model")
+            raise UnknownModel(model, self._models)
         if ctx is None:  # direct callers get an id too; the frontend mints its own
-            ctx = RequestContext.mint(cls, deadline_ms)
+            ctx = RequestContext.mint(cls, deadline_ms, model=model)
+        elif ctx.model is None:
+            ctx.model = model
         # brownout class shed FIRST (before the breaker can spend a probe
         # slot): the cheapest possible rejection — no quota, no queue, no
         # engine load, and a Retry-After so well-behaved clients back off
@@ -366,30 +411,44 @@ class AdmissionController:
                 raise DeadlineUnmeetable(
                     f"predicted wait {wait * 1e3:.1f}ms exceeds deadline {deadline_ms:.1f}ms"
                 )
+        model_cap = self._model_quota.get(model) if model is not None else None
         with self._lock:
             if self._in_queue[cls] >= self._quota[cls]:
-                over_quota = True
+                over_quota = "class"
+            elif model_cap is not None and self._in_model.get(model, 0) >= model_cap:
+                over_quota = "model"
             else:
-                over_quota = False
+                over_quota = None
                 self._in_queue[cls] += 1
-        if over_quota:
+                if model is not None:
+                    self._in_model[model] = self._in_model.get(model, 0) + 1
+        if over_quota is not None:
             if probe:
                 self.breaker.cancel_probe()
-            self._reject(cls, "serve.rejected_class_full")
-            raise ClassQueueFull(
-                f"class {cls!r} at its weighted queue share ({self._quota[cls]})"
+            if over_quota == "class":
+                self._reject(cls, "serve.rejected_class_full")
+                raise ClassQueueFull(
+                    f"class {cls!r} at its weighted queue share ({self._quota[cls]})"
+                )
+            self._reject(cls, "serve.rejected_model_full")
+            raise ModelQueueFull(
+                f"model {model!r} at its in-system quota ({model_cap})"
             )
-        pending = _Pending(cls, image, deadline_s, self._max_retries, probe, ctx)
+        pending = _Pending(cls, image, deadline_s, self._max_retries, probe, ctx, model=model)
         outer: Future = Future()
         try:
-            inner = self._batcher.submit(image, deadline_ms=deadline_ms, priority=cls, ctx=ctx)
+            inner = self._batcher.submit(
+                image, deadline_ms=deadline_ms, priority=cls, ctx=ctx, model=model
+            )
         except Exception:
-            self._release(cls)
+            self._release(cls, model)
             if probe:
                 self.breaker.cancel_probe()
             self._reject(cls, None)  # rejected_full already counted by the batcher
             raise
         self._reg.counter(f"serve.requests.{cls}").inc()
+        if model is not None:
+            self._reg.counter(f"serve.model_requests.{model}").inc()
         ctx.open_envelope()
         with self._lock:
             self._inflight_ctx[ctx.rid] = ctx
@@ -402,9 +461,11 @@ class AdmissionController:
         if cause_counter:
             self._reg.counter(cause_counter).inc()
 
-    def _release(self, cls: str) -> None:
+    def _release(self, cls: str, model: str | None = None) -> None:
         with self._lock:
             self._in_queue[cls] = max(0, self._in_queue[cls] - 1)
+            if model is not None and model in self._in_model:
+                self._in_model[model] = max(0, self._in_model[model] - 1)
 
     # -- completion side (runs on batcher worker / timer threads) -----------
 
@@ -426,14 +487,19 @@ class AdmissionController:
         exc = inner.exception()
         if exc is None:
             self.breaker.on_success(pending.probe)
-            self._observe(pending.cls, time.perf_counter() - pending.t_submit)
+            latency_s = time.perf_counter() - pending.t_submit
+            self._observe(pending.cls, latency_s)
             self._reg.counter(f"serve.completed.{pending.cls}").inc()
-            self._release(pending.cls)
+            if pending.model is not None:
+                self._reg.histogram(
+                    f"serve.model_latency_seconds.{pending.model}").observe(latency_s)
+                self._reg.counter(f"serve.model_completed.{pending.model}").inc()
+            self._release(pending.cls, pending.model)
             self._resolve(pending, outer, value=inner.result())
             return
         if isinstance(exc, (DeadlineExceeded, DrainTimeout)):
             # sheds are policy, not engine health: no breaker, no retry
-            self._release(pending.cls)
+            self._release(pending.cls, pending.model)
             self._resolve(pending, outer, exc=exc)
             return
         # engine failure: breaker accounting, then bounded retry
@@ -445,7 +511,7 @@ class AdmissionController:
         if pending.retries_left <= 0 or not retries_enabled or self.breaker.state == BREAKER_OPEN or (
             pending.t_deadline is not None and time.perf_counter() >= pending.t_deadline
         ):
-            self._release(pending.cls)
+            self._release(pending.cls, pending.model)
             self._resolve(pending, outer, exc=exc)
             return
         pending.retries_left -= 1
@@ -461,11 +527,11 @@ class AdmissionController:
 
     def _retry(self, pending: _Pending, outer: Future, prev_exc: Exception) -> None:
         if pending.t_deadline is not None and time.perf_counter() >= pending.t_deadline:
-            self._release(pending.cls)
+            self._release(pending.cls, pending.model)
             self._resolve(pending, outer, exc=DeadlineExceeded("deadline passed during retry backoff"))
             return
         if self.breaker.state == BREAKER_OPEN:
-            self._release(pending.cls)
+            self._release(pending.cls, pending.model)
             self._resolve(pending, outer, exc=prev_exc)
             return
         remaining_ms = (
@@ -474,10 +540,11 @@ class AdmissionController:
         )
         try:
             inner = self._batcher.submit(
-                pending.image, deadline_ms=remaining_ms, priority=pending.cls, ctx=pending.ctx
+                pending.image, deadline_ms=remaining_ms, priority=pending.cls,
+                ctx=pending.ctx, model=pending.model,
             )
         except Exception as e:  # noqa: BLE001 — stopped batcher / QueueFull: final answer
-            self._release(pending.cls)
+            self._release(pending.cls, pending.model)
             self._resolve(pending, outer, exc=e)
             return
         inner.add_done_callback(lambda fut: self._on_done(pending, outer, fut))
@@ -498,6 +565,7 @@ class AdmissionController:
         """JSON-safe snapshot: breaker, per-class occupancy/quota, predictor."""
         with self._lock:
             in_queue = dict(self._in_queue)
+            in_model = dict(self._in_model)
             ewma = self._ewma_s
             brownout = {
                 "level": self._brownout_level,
@@ -522,11 +590,22 @@ class AdmissionController:
                 }
                 for cls in CLASSES
             },
+            "models": None if self._models is None else {
+                m: {
+                    "in_system": in_model.get(m, 0),
+                    "quota": self._model_quota.get(m),
+                    "default": m == self._default_model,
+                }
+                for m in self._models
+            },
         }
 
     @classmethod
-    def from_config(cls, batcher, ac, *, heartbeat=None, seed: int = 0) -> "AdmissionController":
-        """Build from a config.AdmissionConfig block (cli/serve.py)."""
+    def from_config(cls, batcher, ac, *, heartbeat=None, seed: int = 0,
+                    models=None, default_model: str | None = None,
+                    model_quotas=None) -> "AdmissionController":
+        """Build from a config.AdmissionConfig block (cli/serve.py); the zoo
+        kwargs ride alongside from the serve.zoo block (serve/zoo.py)."""
         return cls(
             batcher,
             weights=tuple(ac.weights),
@@ -542,4 +621,7 @@ class AdmissionController:
             predictor_quantile=ac.predictor_quantile,
             seed=seed,
             heartbeat=heartbeat,
+            models=models,
+            default_model=default_model,
+            model_quotas=model_quotas,
         )

@@ -60,6 +60,7 @@ row and obs_registry.json snapshot carries.
 
 from __future__ import annotations
 
+import inspect
 import queue
 import threading
 import time
@@ -93,10 +94,10 @@ class DrainTimeout(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("image", "future", "t_enqueue", "t_deadline", "priority", "ctx")
+    __slots__ = ("image", "future", "t_enqueue", "t_deadline", "priority", "ctx", "model")
 
     def __init__(self, image: np.ndarray, deadline_s: float | None, priority: str | None = None,
-                 ctx=None):
+                 ctx=None, model: str | None = None):
         self.image = image
         self.future: Future = Future()
         self.t_enqueue = time.perf_counter()
@@ -105,6 +106,10 @@ class _Request:
         # RequestContext (serve/context.py) when the caller threads identity
         # through; phase advances ride the request across the thread hops
         self.ctx = ctx
+        # zoo model identity (serve/zoo.py): batches never mix models — the
+        # grouping key below includes it, so each engine batch targets one
+        # model's (model, bucket, image_size, K) executable
+        self.model = model
 
     def _advance(self, phase: str) -> None:
         if self.ctx is not None:
@@ -112,12 +117,14 @@ class _Request:
 
 
 def _group_by_shape(reqs: list["_Request"]) -> list[list["_Request"]]:
-    """Partition a coalesced batch by image shape (insertion-ordered): mixed
-    image-size traffic dispatches one engine batch per size, each hitting
-    its own (bucket, image_size) executable — never a stack error."""
+    """Partition a coalesced batch by (model, image shape), insertion-ordered:
+    mixed image-size traffic dispatches one engine batch per size, each
+    hitting its own (bucket, image_size) executable — never a stack error —
+    and mixed-MODEL traffic (serve/zoo.py) never shares a batch, so every
+    dispatch targets exactly one model's ladder."""
     groups: dict[tuple, list[_Request]] = {}
     for r in reqs:
-        groups.setdefault(r.image.shape, []).append(r)
+        groups.setdefault((r.model, r.image.shape), []).append(r)
     return list(groups.values())
 
 
@@ -142,6 +149,13 @@ class MicroBatcher:
         if max_wait_ms < 0:
             raise ValueError(f"max_wait_ms must be >= 0, got {max_wait_ms}")
         self._predict = predict_fn
+        # zoo-aware predict fns (serve/engine.py multi-model) take a model=
+        # kwarg; plain fns (tests, lambdas) don't — detect once, like the
+        # pipelined batcher's ctxs detection, so both keep working unchanged
+        try:
+            self._predict_takes_model = "model" in inspect.signature(predict_fn).parameters
+        except (TypeError, ValueError):
+            self._predict_takes_model = False
         # the serving WIRE dtype (serve.quant.wire via the engine): submit
         # coerces every image to it ONCE, so stacked batches reach the
         # engine already wire-typed — never a hardcoded np.float32 (the
@@ -265,6 +279,7 @@ class MicroBatcher:
         deadline_ms: float | None = None,
         priority: str | None = None,
         ctx=None,
+        model: str | None = None,
     ) -> Future:
         """Enqueue one (H, W, 3) image; returns a Future resolving to its
         logits row. Raises :class:`QueueFull` when the bounded queue is at
@@ -272,11 +287,12 @@ class MicroBatcher:
         request with its QoS class (serve/admission.py) for per-class shed
         attribution; the batcher itself stays FIFO. ``ctx`` is the optional
         :class:`~.context.RequestContext` correlating this request's trace
-        events across the thread hops."""
+        events across the thread hops. ``model`` names the zoo tenant
+        (serve/zoo.py); requests for different models never share a batch."""
         if self._thread is None:
             raise RuntimeError("batcher not started")
         deadline_s = deadline_ms / 1e3 if deadline_ms is not None else self._default_deadline_s
-        req = _Request(coerce_wire(image, self._wire_dtype), deadline_s, priority, ctx)
+        req = _Request(coerce_wire(image, self._wire_dtype), deadline_s, priority, ctx, model)
         with self._live_lock:
             self._live.add(req)
         try:
@@ -392,7 +408,11 @@ class MicroBatcher:
             for req in group:  # queued -> in-flight edge, dispatch thread
                 req._advance("dispatched")
             try:
-                logits = self._predict(np.stack([r.image for r in group]))
+                stacked = np.stack([r.image for r in group])
+                if self._predict_takes_model and group[0].model is not None:
+                    logits = self._predict(stacked, model=group[0].model)
+                else:
+                    logits = self._predict(stacked)
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
                 for req in group:
                     self._finish_err(req, e)

@@ -266,12 +266,17 @@ class ReplicaClient:
     def predict(self, image: np.ndarray, *, priority: str | None = None,
                 deadline_ms: float | None = None, request_id: str | None = None,
                 trace_parent: str | None = None,
-                timeout_s: float | None = None) -> np.ndarray:
+                timeout_s: float | None = None,
+                model: str | None = None) -> np.ndarray:
         """POST one (H, W, C) image; returns the logits row. Raises the
         typed hierarchy above on every failure mode. A uint8 array rides
         the wire RAW (``X-Dtype: u8`` — the quantized wire's 4x byte drop
         crosses the fleet instead of being silently upcast); anything else
-        is coerced to the little-endian float32 contract."""
+        is coerced to the little-endian float32 contract. ``model`` names
+        the zoo tenant (``X-Model`` header); None = the replica's default
+        model. An unserved name comes back as a typed 400
+        (``unknown_model`` — :class:`ClientHTTPError` with that tag, the
+        served-model list riding in the error body)."""
         image = np.asarray(image)
         code = wire_dtype_code(image.dtype)
         image = np.ascontiguousarray(image, dtype=WIRE_DTYPES[code])
@@ -291,6 +296,8 @@ class ReplicaClient:
             # "<trace_id>-<seq>-<leg>", stamped per leg by the router so the
             # replica's trace events carry the fleet-level request id
             headers["X-Trace-Parent"] = str(trace_parent)
+        if model:
+            headers["X-Model"] = str(model)
         status, resp_headers, doc = self._request_json(
             "POST", "/predict", body=image.tobytes(), headers=headers, timeout_s=timeout_s
         )
@@ -300,13 +307,20 @@ class ReplicaClient:
         return np.asarray(doc["logits"], np.float32)
 
     def register(self, host: str, port: int, *, ttl_s: float,
-                 replica_id: str = "", timeout_s: float | None = None) -> dict:
+                 replica_id: str = "", timeout_s: float | None = None,
+                 models: dict | None = None) -> dict:
         """POST /register: announce (or heartbeat-renew) a replica address
-        with a TTL lease on a router frontend. Returns the router's lease
-        verdict (``{"ok", "ttl_s", ...}``); raises :class:`ClientHTTPError`
-        when the target is not a router (404) or rejects the body (400)."""
-        body = json.dumps({"host": host, "port": int(port), "ttl_s": ttl_s,
-                           "replica_id": replica_id}).encode()
+        with a TTL lease on a router frontend. ``models`` is the served-
+        model advertisement (``{name: digest}``) driving the router's
+        model-aware placement. Returns the router's lease verdict
+        (``{"ok", "ttl_s", ...}``); raises :class:`ClientHTTPError` when
+        the target is not a router (404), rejects the body (400), or
+        refuses a conflicting model digest (409, ``digest_conflict``)."""
+        payload = {"host": host, "port": int(port), "ttl_s": ttl_s,
+                   "replica_id": replica_id}
+        if models is not None:
+            payload["models"] = dict(models)
+        body = json.dumps(payload).encode()
         status, _, doc = self._request_json(
             "POST", "/register", body=body,
             headers={"Content-Type": "application/json"}, timeout_s=timeout_s,

@@ -46,6 +46,12 @@ TERMINAL_PHASES = ("completed", "shed", "failed", "resolved")
 # attempt) and the Perfetto flow id (trace_id * 16 + seq) never collides
 TRACE_SEQ_HEDGE_BASE = 8
 
+# cascade escalation legs occupy seq 4..7 (between the primary's 0..3 and
+# the hedge's 8+): the big-tier re-submit of a low-confidence small-tier
+# answer is its own leg in the fleet trace, never confused with a retry or
+# a hedge of the small-tier dispatch (serve/cascade.py)
+TRACE_SEQ_CASCADE_BASE = 4
+
 
 def parse_trace_parent(header: str | None) -> tuple[int, int, str] | None:
     """Parse an ``X-Trace-Parent: <trace_id>-<seq>-<leg>`` header (the
@@ -76,13 +82,16 @@ class RequestContext:
     """Identity + QoS + phase for one in-system serving request."""
 
     __slots__ = ("rid", "cls", "deadline_ms", "client_tag", "t_arrival", "phase",
-                 "trace_id", "trace_seq", "trace_leg")
+                 "trace_id", "trace_seq", "trace_leg", "model")
 
     def __init__(self, rid: int, cls: str, deadline_ms: float | None, client_tag: str | None = None,
-                 trace_parent: str | None = None):
+                 trace_parent: str | None = None, model: str | None = None):
         self.rid = rid
         self.cls = cls
         self.deadline_ms = deadline_ms
+        # zoo model identity (X-Model header, serve/zoo.py): which named
+        # bundle serves this request; None = the replica's default model
+        self.model = model
         # a client-supplied X-Request-Id is echoed back verbatim; the
         # internal rid stays monotonic (trace ids must be process-unique)
         self.client_tag = client_tag
@@ -99,8 +108,9 @@ class RequestContext:
     @classmethod
     def mint(cls, qos_class: str, deadline_ms: float | None = None,
              client_tag: str | None = None,
-             trace_parent: str | None = None) -> "RequestContext":
-        return cls(next(_IDS), qos_class, deadline_ms, client_tag, trace_parent)
+             trace_parent: str | None = None,
+             model: str | None = None) -> "RequestContext":
+        return cls(next(_IDS), qos_class, deadline_ms, client_tag, trace_parent, model)
 
     @property
     def wire_id(self) -> str:
@@ -115,6 +125,7 @@ class RequestContext:
         return {
             "id": self.rid,
             "class": self.cls,
+            "model": self.model,
             "deadline_ms": self.deadline_ms,
             "age_s": self.age_s(),
             "phase": self.phase,

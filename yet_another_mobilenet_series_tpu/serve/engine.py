@@ -155,7 +155,13 @@ from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..parallel import mesh as mesh_lib
 from . import quant
+from .admission import UnknownModel
 from .export import InferenceBundle, apply_folded
+
+# the implicit model name of a single-bundle engine: its cost keys carry no
+# model suffix, so every pre-zoo dashboard/bench key (serve_b8_s224_k1)
+# stays valid — only explicitly-named zoo tenants get the _m<name> suffix
+DEFAULT_MODEL = "default"
 
 # bf16 serving parity bar vs the fp32 forward on the same folded weights:
 # bf16 has an 8-bit mantissa (~0.4% relative), accumulated through a deep
@@ -224,6 +230,29 @@ class _SlotPool:
             reg.histogram("serve.slot_wait_seconds").observe(time.perf_counter() - t0)
             slot.fence = None
         return slot
+
+
+class _ModelState:
+    """Per-tenant state of one loaded bundle inside a multi-model engine
+    (serve/zoo.py): its network, device-resident params, weight mode, cost
+    tag, and image-size ladder. Executables are keyed ``(model, bucket,
+    image_size, K)``; staging slot pools stay keyed ``(bucket, image_size,
+    K)`` and are SHARED across tenants — a host staging buffer's shape and
+    dtype depend only on the geometry and the wire, never on whose weights
+    consume it (the fence lifecycle already guarantees the previous
+    consumer, whichever model it was, finished reading before reuse)."""
+
+    __slots__ = ("name", "net", "params", "weights", "cost_tag", "image_size", "image_sizes")
+
+    def __init__(self, name: str, net: Network, params, weights: str, cost_tag: str,
+                 image_size: int, image_sizes: tuple[int, ...]):
+        self.name = name
+        self.net = net
+        self.params = params
+        self.weights = weights
+        self.cost_tag = cost_tag
+        self.image_size = image_size
+        self.image_sizes = image_sizes
 
 
 class PendingPrediction:
@@ -298,8 +327,11 @@ class InferenceEngine:
 
     def __init__(
         self,
-        bundle: InferenceBundle,
+        bundle: InferenceBundle | None = None,
         *,
+        models: dict[str, InferenceBundle] | None = None,
+        default_model: str | None = None,
+        model_image_sizes: dict[str, Sequence[int]] | None = None,
         buckets: Sequence[int] = (1, 8, 32),
         compute_dtype: str = "float32",
         mesh=None,
@@ -319,11 +351,26 @@ class InferenceEngine:
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         if self.buckets[0] < 1:
             raise ValueError(f"batch buckets must be >= 1, got {self.buckets}")
-        self.net: Network = bundle.net
-        self.image_size = int(image_size or bundle.net.image_size)
-        self.image_sizes = tuple(sorted(set(int(s) for s in (image_sizes or ())) | {self.image_size}))
-        if self.image_sizes[0] < 1:
-            raise ValueError(f"image sizes must be >= 1, got {self.image_sizes}")
+        # tenant resolution (serve/zoo.py): the legacy single-bundle form is
+        # a one-model zoo under the reserved DEFAULT_MODEL name, whose cost
+        # keys carry no model suffix — pre-zoo callers see zero change
+        if models:
+            if bundle is not None:
+                raise ValueError("pass either bundle= or models=, not both")
+            bundles = dict(models)
+        else:
+            if bundle is None:
+                raise ValueError("engine needs a bundle or a models= dict")
+            bundles = {DEFAULT_MODEL: bundle}
+        for name in bundles:
+            if not name or not name.replace("-", "").replace("_", "").isalnum():
+                raise ValueError(
+                    f"model name {name!r} must be non-empty [A-Za-z0-9_-] "
+                    "(it becomes a metric-family and cost-key component)")
+        self._default = default_model or next(iter(bundles))
+        if self._default not in bundles:
+            raise ValueError(
+                f"default_model {self._default!r} not among loaded models {tuple(bundles)}")
         # chunk-count ladder for fused dispatch; K=1 (the per-chunk path) is
         # implicit, so only K >= 2 entries are meaningful. () disables fusion.
         self.fuse_ladder = tuple(sorted(set(int(k) for k in (fuse_ladder or ()) if int(k) >= 2)))
@@ -343,20 +390,12 @@ class InferenceEngine:
         # at 1/4 the bytes; the compiled program denormalizes on device with
         # the pipeline's mean/std (serve/quant.py — a single per-channel
         # multiply when the mean is zero, which is the bitwise-parity case).
+        # There is ONE wire per engine — it is a transport property, so every
+        # zoo tenant shares it (and the shared staging slot pools).
         self._wire = wire
         self._wire_np = quant.wire_np_dtype(wire)  # validates the name too
         self._wire_jnp = jnp.uint8 if wire == "uint8" else jnp.float32
         self._denorm_scale, self._denorm_shift = quant.denorm_constants(wire_mean, wire_std)
-        # int8-weight bundles (serve.quant.weights) need no engine plumbing
-        # — apply_folded dequantizes in-program — but the cost-gauge keys
-        # must not collide with an f32 engine's in the same process
-        self._weights = "int8" if any(
-            "w_q" in leaf for leaf in jax.tree.leaves(
-                bundle.params, is_leaf=lambda x: isinstance(x, dict) and "w_q" in x)
-            if isinstance(leaf, dict)
-        ) else "float32"
-        self._cost_tag = ("_u8" if wire == "uint8" else "") + (
-            "_w8" if self._weights == "int8" else "")
         self._mesh = mesh
         self._donate = donate_input
         if mesh is not None:
@@ -366,16 +405,57 @@ class InferenceEngine:
                     f"buckets {bad} not divisible by the {mesh.size}-device mesh; "
                     "data-parallel serving pads to whole per-device shards"
                 )
-            self._params = mesh_lib.replicate(bundle.params, mesh)
-        else:
-            self._params = jax.tree.map(jnp.asarray, bundle.params)
-        # executables and staging slot pools are keyed (bucket, image_size,
-        # K); K == 1 is the plain per-chunk executable, K >= 2 the fused scan
-        self._compiled: dict[tuple[int, int, int], jax.stages.Compiled] = {}
+        # per-tenant state: net, device params, weight mode (int8-weight
+        # bundles need no engine plumbing — apply_folded dequantizes
+        # in-program — but cost-gauge keys must not collide across modes OR
+        # models in one process), cost tag, and image-size ladder. The
+        # legacy image_size/image_sizes kwargs apply to the default model.
+        sizes_by_model = dict(model_image_sizes or {})
+        self._model_states: dict[str, _ModelState] = {}
+        for name, b in bundles.items():
+            m_size = int(image_size) if (image_size and name == self._default) \
+                else int(b.net.image_size)
+            extra = sizes_by_model.get(name)
+            if extra is None and name == self._default:
+                extra = image_sizes
+            m_sizes = tuple(sorted(set(int(s) for s in (extra or ())) | {m_size}))
+            if m_sizes[0] < 1:
+                raise ValueError(f"image sizes must be >= 1, got {m_sizes} for {name!r}")
+            m_weights = "int8" if any(
+                "w_q" in leaf for leaf in jax.tree.leaves(
+                    b.params, is_leaf=lambda x: isinstance(x, dict) and "w_q" in x)
+                if isinstance(leaf, dict)
+            ) else "float32"
+            cost_tag = ("_u8" if wire == "uint8" else "") + (
+                "_w8" if m_weights == "int8" else "") + (
+                f"_m{name}" if name != DEFAULT_MODEL else "")
+            params = (mesh_lib.replicate(b.params, mesh) if mesh is not None
+                      else jax.tree.map(jnp.asarray, b.params))
+            self._model_states[name] = _ModelState(
+                name, b.net, params, m_weights, cost_tag, m_size, m_sizes)
+        # single-model compatibility surface: the default tenant's identity
+        # IS the engine's (tests, healthz, and the sync batcher read these)
+        _st = self._model_states[self._default]
+        self.net: Network = _st.net
+        self.image_size = _st.image_size
+        self.image_sizes = _st.image_sizes
+        self._params = _st.params
+        self._weights = _st.weights
+        self._cost_tag = _st.cost_tag
+        # executables are keyed (model, bucket, image_size, K); K == 1 is the
+        # plain per-chunk executable, K >= 2 the fused scan. Staging slot
+        # pools stay keyed (bucket, image_size, K) — geometry + wire fully
+        # determine a host buffer, so tenants SHARE the pools (fences make
+        # cross-model reuse safe exactly like same-model reuse).
+        self._compiled: dict[tuple[str, int, int, int], jax.stages.Compiled] = {}
         self._staging: dict[tuple[int, int, int], _SlotPool] = {}
-        # off-ladder keys live in a bounded LRU (on-ladder keys are pinned):
-        # a size-scanning client must not grow the caches without bound
-        self._offladder: OrderedDict[tuple[int, int, int], None] = OrderedDict()
+        # off-ladder keys live in a bounded PER-MODEL LRU (on-ladder keys are
+        # pinned): a size-scanning client must not grow the caches without
+        # bound, and a churn burst on one tenant must never evict another
+        # tenant's warm executables (each model gets its own offladder_cache
+        # budget — the no-cross-eviction contract tests/test_zoo.py pins)
+        self._offladder: dict[str, OrderedDict[tuple[int, int, int], None]] = {
+            name: OrderedDict() for name in self._model_states}
         # one dispatcher at a time: staging buffers are reused across calls
         self._dispatch_lock = threading.Lock()
         # compiles serialize with each other but NOT with dispatch: a cold
@@ -389,6 +469,34 @@ class InferenceEngine:
         # gauges + the achieved-FLOPS dispatch-efficiency gauge
         obs_device.install_memory_gauges(self._reg)
         obs_device.install_dispatch_efficiency_gauge(self._reg)
+
+    # -- zoo surface --------------------------------------------------------
+
+    @property
+    def models(self) -> tuple[str, ...]:
+        """Names of the loaded tenants (a single-bundle engine reports the
+        reserved ``("default",)``) — the set the lease advertises and the
+        admission edge validates X-Model against."""
+        return tuple(self._model_states)
+
+    @property
+    def default_model(self) -> str:
+        """The tenant unqualified requests (no X-Model) resolve to."""
+        return self._default
+
+    def model_weights(self, model: str) -> str:
+        """Weight storage of one tenant's bundle ("float32" | "int8")."""
+        return self._model_state(model).weights
+
+    def model_image_ladder(self, model: str) -> tuple[int, ...]:
+        """One tenant's warmed image-size ladder."""
+        return self._model_state(model).image_sizes
+
+    def _model_state(self, model: str | None) -> _ModelState:
+        st = self._model_states.get(model or self._default)
+        if st is None:
+            raise UnknownModel(model, self._model_states)
+        return st
 
     # -- quantization surface ----------------------------------------------
 
@@ -425,15 +533,17 @@ class InferenceEngine:
 
     # -- compilation --------------------------------------------------------
 
-    def _on_ladder(self, key: tuple[int, int, int]) -> bool:
+    def _on_ladder(self, model: str, key: tuple[int, int, int]) -> bool:
         bucket, size, k = key
         return (
             bucket in self.buckets
-            and size in self.image_sizes
+            and size in self._model_states[model].image_sizes
             and (k == 1 or k in self.fuse_ladder)
         )
 
-    def _build(self, bucket: int, size: int, k: int):
+    def _build(self, model: str, bucket: int, size: int, k: int):
+        st = self._model_states[model]
+
         def run_one(params, x):
             if self._wire == "uint8":
                 # the uint8 wire's in-program denorm prelude: raw pixels ->
@@ -441,7 +551,7 @@ class InferenceEngine:
                 # per-channel multiply when the mean is zero — the bitwise
                 # case; serve/quant.py). Fused into the same dispatch.
                 x = quant.denormalize_device(x, self._denorm_scale, self._denorm_shift)
-            return apply_folded(self.net, params, x, compute_dtype=self._compute_dtype)
+            return apply_folded(st.net, params, x, compute_dtype=self._compute_dtype)
 
         if k == 1:
             run = run_one
@@ -465,57 +575,67 @@ class InferenceEngine:
             )
         fn = jax.jit(run, donate_argnums=(1,) if self._donate else (), **kwargs)
         t0 = time.perf_counter()
-        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket, image_size=size, k=k):
+        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket, image_size=size,
+                                         k=k, model=model):
             # obs/device.py: compile time -> obs.compile_seconds/obs.compiles,
             # cost_analysis flops/bytes -> per-executable obs.cost_* gauges —
             # every warmed executable is cost-accounted in the obs snapshot
             compiled = obs_device.timed_compile(
-                fn.lower(self._params, x_shape), _cost_key(bucket, size, k, self._cost_tag),
+                fn.lower(st.params, x_shape), _cost_key(bucket, size, k, st.cost_tag),
                 registry=self._reg,
             )
         self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
         return compiled
 
-    def _ensure_compiled(self, key: tuple[int, int, int]):
-        """Executable for ``key``, compiling on miss WITHOUT holding the
-        dispatch lock (double-checked insert): warm traffic keeps flowing
-        while a cold size pays its compile."""
+    def _ensure_compiled(self, model: str, key: tuple[int, int, int]):
+        """Executable for ``(model, *key)``, compiling on miss WITHOUT
+        holding the dispatch lock (double-checked insert): warm traffic
+        keeps flowing while a cold size pays its compile. Off-ladder
+        eviction is scoped to ``model``'s own LRU slice; the shared staging
+        pool for the evicted geometry is dropped only when NO tenant still
+        holds an executable of that geometry."""
+        full = (model,) + key
         with self._cache_lock:
-            exe = self._compiled.get(key)
+            exe = self._compiled.get(full)
             if exe is not None:
-                if key in self._offladder:
-                    self._offladder.move_to_end(key)
+                lru = self._offladder[model]
+                if key in lru:
+                    lru.move_to_end(key)
                 return exe
         with self._compile_lock:
             with self._cache_lock:
-                exe = self._compiled.get(key)
+                exe = self._compiled.get(full)
             if exe is not None:
                 return exe
-            exe = self._build(*key)
+            exe = self._build(model, *key)
             with self._cache_lock:
-                self._compiled[key] = exe
-                if not self._on_ladder(key):
-                    self._offladder[key] = None
-                    self._offladder.move_to_end(key)
-                    while len(self._offladder) > self._offladder_cap:
-                        old, _ = self._offladder.popitem(last=False)
-                        self._compiled.pop(old, None)
-                        self._staging.pop(old, None)
+                self._compiled[full] = exe
+                if not self._on_ladder(model, key):
+                    lru = self._offladder[model]
+                    lru[key] = None
+                    lru.move_to_end(key)
+                    while len(lru) > self._offladder_cap:
+                        old, _ = lru.popitem(last=False)
+                        self._compiled.pop((model,) + old, None)
+                        if not any((m,) + old in self._compiled for m in self._model_states):
+                            self._staging.pop(old, None)
                         self._reg.counter("serve.evicted_executables").inc()
             return exe
 
     def warmup(self) -> None:
         """AOT-compile every ladder executable up front so the first request
-        of any size never hits a compile stall: each (bucket, image_size)
-        pair, plus — when fusion is on — the fused (max-bucket, size, K)
-        scan for every K on the fuse ladder."""
+        of any size never hits a compile stall: for EVERY tenant, each
+        (bucket, image_size) pair of its own ladder, plus — when fusion is
+        on — the fused (max-bucket, size, K) scan for every K on the fuse
+        ladder."""
         cap = self.buckets[-1]
-        for s in self.image_sizes:
-            for b in self.buckets:
-                self._ensure_compiled((b, s, 1))
-            if self._mesh is None:
-                for k in self.fuse_ladder:
-                    self._ensure_compiled((cap, s, k))
+        for model, st in self._model_states.items():
+            for s in st.image_sizes:
+                for b in self.buckets:
+                    self._ensure_compiled(model, (b, s, 1))
+                if self._mesh is None:
+                    for k in self.fuse_ladder:
+                        self._ensure_compiled(model, (cap, s, k))
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -584,15 +704,17 @@ class InferenceEngine:
         return slot.buf, slot
 
     def _dispatch_piece(self, images: np.ndarray, piece: tuple[int, int, int, int], size: int,
-                        ctxs=()):
-        """Stage + dispatch ONE piece (a chunk, or K fused chunks); returns
-        (device_logits, real_rows) without syncing. The device array handed
-        to the executable is donated; it is never read afterwards (YAMT008
-        discipline). ``ctxs`` are the piece's request contexts: their ids
-        land on the dispatch span and their flow steps bind inside it."""
+                        ctxs=(), model: str | None = None):
+        """Stage + dispatch ONE piece (a chunk, or K fused chunks) against
+        ``model``'s executable; returns (device_logits, real_rows) without
+        syncing. The device array handed to the executable is donated; it is
+        never read afterwards (YAMT008 discipline). ``ctxs`` are the piece's
+        request contexts: their ids land on the dispatch span and their flow
+        steps bind inside it."""
         start, rows, bucket, k = piece
+        st = self._model_state(model)
         key = (bucket, size, k)
-        exe = self._ensure_compiled(key)  # pre-warmed by predict_async; a hit
+        exe = self._ensure_compiled(st.name, key)  # pre-warmed by predict_async; a hit
         tracer = obs_trace.get_tracer()
         t0 = time.perf_counter()
         slot = None
@@ -628,11 +750,11 @@ class InferenceEngine:
                             x = jnp.asarray(staged)
                     self._reg.histogram("serve.h2d_seconds").observe(time.perf_counter() - t_h2d)
             span = "serve/dispatch" if k == 1 else "serve/dispatch_fused"
-            span_args = dict(bucket=bucket, image_size=size, rows=rows, k=k)
+            span_args = dict(bucket=bucket, image_size=size, rows=rows, k=k, model=st.name)
             if ctxs:
                 span_args["rids"] = [c.rid for c in ctxs[:16]]  # keep args tiny
             with tracer.span(span, "serve", **span_args):
-                logits = exe(self._params, x)
+                logits = exe(st.params, x)
                 for c in ctxs:  # in-span: the flow arrow binds to this slice
                     c.advance("dispatched")
                     tracer.flow_step("serve/req", c.rid)
@@ -674,16 +796,17 @@ class InferenceEngine:
             ("serve.dispatched_flops", obs_device.flops_for),
             ("serve.dispatched_bytes", obs_device.bytes_for),
         ):
-            cost = lookup(_cost_key(bucket, size, k, self._cost_tag))
+            cost = lookup(_cost_key(bucket, size, k, st.cost_tag))
             if k > 1:
-                per_chunk = lookup(_cost_key(bucket, size, 1, self._cost_tag))
+                per_chunk = lookup(_cost_key(bucket, size, 1, st.cost_tag))
                 if per_chunk:
                     cost = per_chunk * k
             if cost:
                 self._reg.counter(counter).inc(cost)
         return logits, rows
 
-    def predict_async(self, images: np.ndarray, ctxs=None) -> PendingPrediction:
+    def predict_async(self, images: np.ndarray, ctxs=None,
+                      model: str | None = None) -> PendingPrediction:
         """Dispatch without syncing: (N, S, S, 3) in the WIRE dtype -> handle
         whose ``result()`` yields (N, num_classes) float32 logits. On the
         float32 wire inputs are already-normalized pixels (pipeline
@@ -701,11 +824,18 @@ class InferenceEngine:
         phase/flow trace edges fire inside the engine's spans, so one
         request correlates from HTTP handler to completion thread.
 
+        ``model`` (optional) names the zoo tenant to serve this batch
+        (serve/zoo.py); None resolves to the default tenant, and an unserved
+        name raises the typed :class:`~.admission.UnknownModel` — never a
+        KeyError. One batch targets exactly one model (the batchers group by
+        (model, shape) upstream).
+
         Caller contract under overlapped staging: an exact-bucket batch is
         transferred zero-copy via async ``device_put``, so ``images`` must
         not be mutated until ``result()`` returns (the batchers always pass
         freshly-stacked arrays; with ``overlap_staging=False`` the transfer
         copies synchronously and no such constraint exists)."""
+        st = self._model_state(model)  # typed UnknownModel before any work
         images = quant.coerce_wire(images, self._wire_np)
         if images.ndim != 4 or images.shape[1] != images.shape[2]:
             raise ValueError(f"predict expects (N, S, S, 3), got shape {images.shape}")
@@ -715,12 +845,14 @@ class InferenceEngine:
         ctxs = tuple(ctxs or ())
         size = int(images.shape[1])
         self._reg.counter("serve.infer_images").inc(n)
+        if st.name != DEFAULT_MODEL:
+            self._reg.counter(f"serve.infer_images.{st.name}").inc(n)
         t_start = time.perf_counter()
         pieces = self._plan(n, size)
         # compile anything cold BEFORE taking the dispatch lock: a cold size
         # must not stall concurrent warm-size dispatches
         for key in {(bucket, size, k) for _, _, bucket, k in pieces}:
-            self._ensure_compiled(key)
+            self._ensure_compiled(st.name, key)
         # row i <-> ctxs[i] only when the caller submitted one ctx per row
         # (the batcher's coalesced single-image requests); otherwise the
         # whole batch belongs to every ctx (a multi-row client request)
@@ -730,15 +862,16 @@ class InferenceEngine:
                 self._dispatch_piece(
                     images, piece, size,
                     ctxs=ctxs[piece[0] : piece[0] + piece[1]] if per_row else ctxs,
+                    model=st.name,
                 )
                 for piece in pieces
             ]
         return PendingPrediction(self, parts, t_start, time.perf_counter(), ctxs=ctxs)
 
-    def predict(self, images: np.ndarray, ctxs=None) -> np.ndarray:
+    def predict(self, images: np.ndarray, ctxs=None, model: str | None = None) -> np.ndarray:
         """(N, S, S, 3) in the wire dtype (float32 wire: already-normalized
         pipeline pixels; uint8 wire: raw pixels, denormalized on device) ->
         (N, num_classes) float32 logits. N is unconstrained: > max bucket is
         served fused (one dispatch per ladder piece), all dispatched before
-        the single sync."""
-        return self.predict_async(images, ctxs=ctxs).result()
+        the single sync. ``model`` selects the zoo tenant (None = default)."""
+        return self.predict_async(images, ctxs=ctxs, model=model).result()

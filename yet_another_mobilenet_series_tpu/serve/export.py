@@ -40,6 +40,7 @@ tree stay ~4x smaller than the f32 fold.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from dataclasses import dataclass
@@ -231,6 +232,33 @@ def apply_folded(net: Network, params: dict, x, *, compute_dtype=jnp.float32, co
 # ---------------------------------------------------------------------------
 
 
+class BundleDigestMismatch(ValueError):
+    """The bundle's on-disk content no longer matches the digest stamped in
+    ``meta.json`` at export: the artifact was corrupted or hand-edited.
+    Loading refuses rather than serving silently-wrong weights — the same
+    identity the fleet lease advertises per model name, so a name whose
+    digest differs across replicas is caught at registration
+    (serve/router.py), not by users seeing model-dependent answers."""
+
+
+def bundle_digest(spec: dict, flat_params: dict[str, np.ndarray]) -> str:
+    """Deterministic content digest of a bundle: the canonicalized spec JSON
+    plus every weight's path/dtype/shape/bytes, in sorted path order. Stamped
+    into ``meta.json`` at export, re-derived and verified at load, and
+    advertised per model name on the fleet lease — two replicas claiming the
+    same model name with different digests is the mixed-version foot-gun the
+    router refuses at registration."""
+    h = hashlib.sha256()
+    h.update(json.dumps(spec, sort_keys=True).encode())
+    for path in sorted(flat_params):
+        a = np.ascontiguousarray(flat_params[path])
+        h.update(path.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
 @dataclass(frozen=True)
 class InferenceBundle:
     """A loaded serving artifact: the (pruned) Network spec + folded params.
@@ -249,6 +277,18 @@ class InferenceBundle:
         measured top-1 agreement) — None for an f32 bundle."""
         return self.meta.get("quant")
 
+    @property
+    def model_name(self) -> str | None:
+        """The zoo identity stamped at export (``export_bundle(...,
+        model_name=)``) — None for a pre-zoo bundle."""
+        return self.meta.get("model_name")
+
+    @property
+    def digest(self) -> str | None:
+        """The verified content digest stamped at export (see
+        :func:`bundle_digest`) — None for a pre-zoo bundle."""
+        return self.meta.get("digest")
+
 
 def export_bundle(
     net: Network,
@@ -261,10 +301,16 @@ def export_bundle(
     quant_weights: str = "float32",
     calib_images: np.ndarray | None = None,
     int8_top1_min: float = 0.98,
+    model_name: str | None = None,
 ) -> str:
     """Write an InferenceBundle directory. ``masks`` (a live AtomNAS mask
     dict) are hard-applied via nas/rematerialize first; pass the EMA trees as
     (params, state) to export the shadow weights.
+
+    ``model_name`` stamps the bundle's zoo identity into ``meta.json``,
+    alongside a content digest (:func:`bundle_digest`) that
+    :func:`load_bundle` verifies and the fleet lease advertises — the
+    tamper/mixed-version guard.
 
     ``quant_weights="int8"`` additionally runs the gated post-training
     quantization pass (serve/quant.py): per-output-channel symmetric int8
@@ -300,9 +346,14 @@ def export_bundle(
             )
             get_registry().counter("serve.int8_exports").inc()
         os.makedirs(out_dir, exist_ok=True)
+        spec_dict = network_to_dict(net, inference=True)
+        flat = flatten_tree(folded)
+        if model_name is not None:
+            meta["model_name"] = model_name
+        meta["digest"] = bundle_digest(spec_dict, flat)
         with open(os.path.join(out_dir, "spec.json"), "w") as f:
-            json.dump(network_to_dict(net, inference=True), f, indent=1)
-        np.savez(os.path.join(out_dir, "weights.npz"), **flatten_tree(folded))
+            json.dump(spec_dict, f, indent=1)
+        np.savez(os.path.join(out_dir, "weights.npz"), **flat)
         with open(os.path.join(out_dir, "meta.json"), "w") as f:
             json.dump(meta, f, indent=1, default=str)
     get_registry().counter("serve.exports").inc()
@@ -364,4 +415,16 @@ def load_bundle(bundle_dir: str) -> InferenceBundle:
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
+    # identity verification: a digest-stamped bundle (every zoo export) is
+    # re-derived from what was actually read off disk; a mismatch refuses to
+    # load rather than serving corrupted/hand-edited weights. Pre-zoo
+    # bundles (no digest in meta) load as before.
+    stamped = meta.get("digest")
+    if stamped is not None:
+        actual = bundle_digest(spec, {k: np.asarray(v) for k, v in flatten_tree(params).items()})
+        if actual != stamped:
+            raise BundleDigestMismatch(
+                f"bundle {bundle_dir!r} content digest {actual} != stamped {stamped}; "
+                "the artifact was modified after export — re-export it"
+            )
     return InferenceBundle(net=net, params=params, meta=meta)

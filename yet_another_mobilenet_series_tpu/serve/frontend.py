@@ -72,11 +72,17 @@ import numpy as np
 from ..obs import trace as obs_trace
 from ..obs.registry import get_registry
 from ..utils.logging import emit
-from .admission import BreakerOpen, BrownoutShed, DeadlineUnmeetable, BREAKER_OPEN
+from .admission import (
+    BreakerOpen,
+    BrownoutShed,
+    DeadlineUnmeetable,
+    BREAKER_OPEN,
+    UnknownModel,
+)
 from .batcher import DeadlineExceeded, DrainTimeout, QueueFull
 from .client import WIRE_DTYPES, ClientHTTPError, ClientTimeout
 from .context import RequestContext
-from .router import NoHealthyReplicas
+from .router import ModelDigestConflict, NoHealthyReplicas, NoReplicaForModel
 
 # this process's birth time: the replica-identity field a router compares to
 # detect a RESTARTED replica behind an unchanged address (same host:port,
@@ -85,14 +91,19 @@ from .router import NoHealthyReplicas
 # — the YAMT017 hazard is subtraction, not the reading).
 _PROC_START_UNIX = time.time()
 
-# exception type -> (HTTP status, wire error tag); anything else is a 500
+# exception type -> (HTTP status, wire error tag); anything else is a 500.
+# Subtype rows precede their base (isinstance scan): UnknownModel is a
+# client-side naming error (400, never overload-shaped), NoReplicaForModel a
+# placement gap distinct from a dead fleet
 _ERROR_MAP = [
     (BreakerOpen, 503, "breaker_open"),
     (BrownoutShed, 503, "brownout"),
     (DeadlineUnmeetable, 429, "deadline_unmeetable"),
-    (QueueFull, 429, "queue_full"),  # covers ClassQueueFull too
+    (UnknownModel, 400, "unknown_model"),
+    (QueueFull, 429, "queue_full"),  # covers ClassQueueFull / ModelQueueFull too
     (DeadlineExceeded, 504, "deadline_exceeded"),
     (DrainTimeout, 503, "draining"),
+    (NoReplicaForModel, 503, "no_replica_for_model"),
     (NoHealthyReplicas, 503, "no_healthy_replicas"),
     (ClientTimeout, 504, "timeout"),
 ]
@@ -186,7 +197,14 @@ class _Handler(BaseHTTPRequestHandler):
         retry_after = _retry_after_s(exc, status, tag, self.frontend.retry_after_s)
         if retry_after is not None:
             headers["Retry-After"] = f"{max(retry_after, 0.0):.0f}"
-        self._send_error_json(status, tag, str(exc), headers)
+        body = {"error": tag, "message": str(exc)}
+        # model-routing verdicts carry the served-model list structurally, so
+        # a client can correct its X-Model without parsing prose
+        served = getattr(exc, "served", None)
+        if served is not None:
+            body["served"] = sorted(served)
+        get_registry().counter("serve.http_errors").inc()
+        self._send_json(status, body, headers)
 
     # -- GET /healthz, /metrics, /varz --------------------------------------
 
@@ -333,12 +351,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if self.path == "/register":
-                out = fe.admission.register(
-                    host, port, ttl_s=doc.get("ttl_s"),
-                    replica_id=str(doc.get("replica_id", "")),
-                )
+                kw = dict(ttl_s=doc.get("ttl_s"),
+                          replica_id=str(doc.get("replica_id", "")))
+                if doc.get("models") is not None:
+                    # only zoo replicas advertise; keeps pre-zoo register()
+                    # implementations (and test doubles) working unchanged
+                    kw["models"] = doc["models"]
+                out = fe.admission.register(host, port, **kw)
             else:
                 out = fe.admission.deregister(host, port)
+        except ModelDigestConflict as e:
+            # split-brain artifact identity: same model name, different
+            # content digest across live replicas — the late joiner is
+            # refused with a conflict verdict, not folded into the lottery
+            self._send_error_json(409, "digest_conflict", str(e))
+            return
         except ValueError as e:
             self._send_error_json(400, "bad_request", str(e))
             return
@@ -360,6 +387,11 @@ class _Handler(BaseHTTPRequestHandler):
             deadline_hdr = self.headers.get("X-Deadline-Ms")
             deadline_ms = float(deadline_hdr) if deadline_hdr else None
             priority = self.headers.get("X-Priority") or None
+            # X-Model names the zoo tenant; absent = the default model (a
+            # pre-zoo client keeps working). It rides the RequestContext
+            # into admission (validation + per-model quota), the batcher's
+            # (model, shape) grouping, and the router's model-aware pick
+            model = (self.headers.get("X-Model") or "").strip() or None
         except ValueError as e:
             self._send_error_json(400, "bad_request", str(e))
             return
@@ -375,6 +407,7 @@ class _Handler(BaseHTTPRequestHandler):
             # trace events carry the ROUTER-issued request id, and
             # link_parent below lands the router->replica flow arrow
             trace_parent=self.headers.get("X-Trace-Parent") or None,
+            model=model,
         )
         rid_hdr = {"X-Request-Id": ctx.wire_id}
         try:

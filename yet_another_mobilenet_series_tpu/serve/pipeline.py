@@ -161,9 +161,14 @@ class PipelinedBatcher(MicroBatcher):
         # predict_async(images) keep working — the batcher's own phase
         # advances cover them)
         try:
-            self._engine_takes_ctxs = "ctxs" in inspect.signature(engine.predict_async).parameters
+            params = inspect.signature(engine.predict_async).parameters
+            self._engine_takes_ctxs = "ctxs" in params
+            # zoo-aware engines additionally take model= (serve/zoo.py);
+            # groups are (model, shape)-pure so one kwarg per dispatch works
+            self._engine_takes_model = "model" in params
         except (TypeError, ValueError):
             self._engine_takes_ctxs = False
+            self._engine_takes_model = False
         # dispatched-but-unsynced budget, acquired BEFORE each dispatch so
         # at most max_inflight executions are ever enqueued device-side
         self._window = threading.BoundedSemaphore(max_inflight)
@@ -330,12 +335,12 @@ class PipelinedBatcher(MicroBatcher):
                 req._advance("dispatched")
             try:
                 stacked = np.stack([r.image for r in group])
+                kwargs = {}
                 if self._engine_takes_ctxs:
-                    handle = self._engine.predict_async(
-                        stacked, ctxs=[r.ctx for r in group if r.ctx is not None]
-                    )
-                else:
-                    handle = self._engine.predict_async(stacked)
+                    kwargs["ctxs"] = [r.ctx for r in group if r.ctx is not None]
+                if self._engine_takes_model and group[0].model is not None:
+                    kwargs["model"] = group[0].model
+                handle = self._engine.predict_async(stacked, **kwargs)
             except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
                 self._window.release()
                 for req in group:

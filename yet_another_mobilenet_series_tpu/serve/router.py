@@ -113,13 +113,35 @@ class NoHealthyReplicas(RuntimeError):
     cannot serve this request (mapped to 503 by the frontend)."""
 
 
+class NoReplicaForModel(NoHealthyReplicas):
+    """Replicas are routable, but none ADVERTISES the request's model: a
+    placement gap, not a health failure. Subclasses NoHealthyReplicas so
+    every existing 503 mapping holds; carries the model so the frontend
+    can tag the verdict distinctly."""
+
+    def __init__(self, model: str, served: tuple = ()):  # noqa: D107
+        self.model = model
+        self.served = tuple(sorted(served))
+        super().__init__(
+            f"no routable replica serves model {model!r}"
+            + (f"; fleet serves: {', '.join(self.served)}" if self.served else "")
+        )
+
+
+class ModelDigestConflict(ValueError):
+    """Two replicas are advertising the SAME model name with DIFFERENT
+    content digests: routing would be a lottery over which weights answer.
+    The conflicting registration is refused (mapped to 409 by the
+    frontend); the operator must converge the fleet on one artifact."""
+
+
 class _Replica:
     """Router-side view of one backend: client + polled health."""
 
     __slots__ = ("key", "host", "port", "client", "routable", "consecutive_failures",
                  "queue_depth", "breaker_state", "draining", "identity",
                  "lat_ewma_s", "slow_strikes", "slow_until", "weight_scale", "next_poll_t",
-                 "source", "lease_until", "eject_until")
+                 "source", "lease_until", "eject_until", "models")
 
     def __init__(self, host: str, port: int, client, source: str = "static"):
         self.key = f"{host}:{port}"
@@ -149,6 +171,11 @@ class _Replica:
         # post-ejection probation (monotonic): a healthy poll may not
         # readmit before this — the flap-ping-pong damper
         self.eject_until = 0.0
+        # model-sharded placement: {model_name: digest} the replica's lease
+        # advertised ('' = unstamped pre-zoo bundle). None = no advertisement
+        # (static member / pre-zoo replica) — routable for EVERY model, so a
+        # zoo-unaware fleet keeps the pre-zoo routing behavior
+        self.models: dict[str, str] | None = None
 
     def weight(self) -> float:
         return self.weight_scale / (1.0 + max(self.queue_depth, 0.0))
@@ -166,6 +193,7 @@ class _Replica:
             "slow_strikes": self.slow_strikes,
             "weight_scale": self.weight_scale,
             "identity": self.identity,
+            "models": sorted(self.models) if self.models is not None else None,
         }
 
 
@@ -300,27 +328,74 @@ class Router:
                     self._replicas[key].lease_until = None
             self._update_routable_gauge_locked()
 
+    def set_backend_models(self, assignments: dict) -> None:
+        """Attach served-model advertisements to members by key
+        (``"host:port" -> {model: digest}`` — digest '' when the caller
+        only knows placement, e.g. the local supervisor's slot assignment).
+        Unknown keys are skipped (the member may have just died); a key
+        mapped to None clears its advertisement (routes everything)."""
+        with self._lock:
+            for key, models in assignments.items():
+                rep = self._replicas.get(key)
+                if rep is None:
+                    continue
+                rep.models = (
+                    None if models is None
+                    else {str(n): str(d or "") for n, d in dict(models).items()}
+                )
+
     # -- TTL-leased membership (the multi-host registration path) ------------
 
     def register(self, host: str, port: int, *, ttl_s: float | None = None,
-                 replica_id: str = "") -> dict:
+                 replica_id: str = "", models=None) -> dict:
         """Admit (or heartbeat-renew) a self-registered backend with a TTL
         lease. First sight counts ``fleet.registrations``; renewals count
         ``fleet.lease_renewals``; a lease that expires unrenewed is swept
         out of membership by the poll loop (``fleet.lease_expirations``).
         Registering an address the static set already owns is a harmless
-        renewal no-op (static membership has no lease to expire)."""
+        renewal no-op (static membership has no lease to expire).
+
+        ``models`` is the replica's served-model advertisement,
+        ``{name: digest}`` (digest '' for an unstamped bundle) — the
+        model-aware pick routes a request for model M only to replicas
+        advertising M. A registration advertising a name whose NON-EMPTY
+        digest differs from another live replica's for the same name is
+        refused (:class:`ModelDigestConflict`,
+        ``fleet.rejected_digest_conflict``): a split-brain fleet where one
+        name maps to two different artifacts must fail the late joiner
+        loudly, not answer from whichever replica the weighted pick lands
+        on."""
         ttl = float(ttl_s) if ttl_s else self._lease_ttl_s
         if ttl <= 0:
             raise ValueError(f"lease ttl_s must be > 0, got {ttl}")
+        adv: dict[str, str] | None = None
+        if models is not None:
+            adv = {str(name): str(digest or "") for name, digest in dict(models).items()}
         key = f"{host}:{int(port)}"
         now = time.monotonic()
         with self._lock:
+            if adv:
+                for other in self._replicas.values():
+                    if other.key == key or not other.models:
+                        continue
+                    for name, digest in adv.items():
+                        have = other.models.get(name)
+                        if digest and have and have != digest:
+                            self._reg.counter("fleet.rejected_digest_conflict").inc()
+                            self._emit_event("digest_conflict", replica=key,
+                                             model=name, digest=digest,
+                                             holder=other.key, holder_digest=have)
+                            raise ModelDigestConflict(
+                                f"replica {key} advertises model {name!r} with digest "
+                                f"{digest} but live replica {other.key} serves digest "
+                                f"{have}; refusing registration — one name, one artifact"
+                            )
             rep = self._replicas.get(key)
             if rep is None:
                 rep = _Replica(host, int(port), self._client_factory(host, int(port)),
                                source="lease")
                 rep.lease_until = now + ttl
+                rep.models = adv
                 self._replicas[key] = rep
                 self._reg.counter("fleet.registrations").inc()
                 self._update_routable_gauge_locked()
@@ -328,10 +403,13 @@ class Router:
             else:
                 if rep.source == "lease":
                     rep.lease_until = now + ttl
+                if adv is not None:
+                    rep.models = adv
                 self._reg.counter("fleet.lease_renewals").inc()
                 new = False
         return {"ok": True, "key": key, "ttl_s": ttl, "new": new,
-                "source": rep.source, "replica_id": replica_id}
+                "source": rep.source, "replica_id": replica_id,
+                "models": sorted(adv) if adv is not None else None}
 
     def deregister(self, host: str, port: int) -> dict:
         """Drop a leased membership immediately (the clean-drain path —
@@ -539,7 +617,7 @@ class Router:
 
     # -- picking -------------------------------------------------------------
 
-    def _pick(self, exclude: set[str]) -> _Replica:
+    def _pick(self, exclude: set[str], model: str | None = None) -> _Replica:
         with self._lock:
             pool = [r for r in self._replicas.values() if r.routable and r.key not in exclude]
             if not pool:
@@ -547,6 +625,18 @@ class Router:
                     f"no routable replica ({len(self._replicas)} registered, "
                     f"{len(exclude)} excluded)"
                 )
+            if model is not None:
+                # model-sharded placement: only replicas ADVERTISING the
+                # model may answer for it (None advertisement = pre-zoo
+                # replica, serves everything). Healthy-but-wrong-model is a
+                # placement gap, distinct from NoHealthyReplicas
+                served = [r for r in pool if r.models is None or model in r.models]
+                if not served:
+                    raise NoReplicaForModel(
+                        model,
+                        {m for r in pool if r.models for m in r.models},
+                    )
+                pool = served
             weights = [r.weight() for r in pool]
             return self._rng.choices(pool, weights=weights, k=1)[0]
 
@@ -584,7 +674,14 @@ class Router:
     # -- the serving protocol (what Frontend consumes) -----------------------
 
     def submit(self, image, *, priority: str | None = None,
-               deadline_ms: float | None = None, ctx=None) -> Future:
+               deadline_ms: float | None = None, ctx=None,
+               model: str | None = None, seq_base: int | None = None) -> Future:
+        # the request's model: explicit kwarg wins, else the ctx's parsed
+        # X-Model, else None (pre-zoo request — any replica may answer).
+        # seq_base overrides the primary leg's trace-seq origin (the
+        # cascade's escalation legs stamp TRACE_SEQ_CASCADE_BASE so a merged
+        # trace tells an escalation from a first-tier attempt)
+        model = model or (ctx.model if ctx is not None else None)
         cls = priority or self._default_class
         if cls not in CLASSES:
             raise ValueError(f"unknown priority class {cls!r}; valid: {CLASSES}")
@@ -637,18 +734,21 @@ class Router:
             ctx.close_envelope()
 
         fut.add_done_callback(_settle)
-        self._pool.submit(self._route_guarded, call, image, cls, deadline_ms, ctx, t_submit)
+        self._pool.submit(self._route_guarded, call, image, cls, deadline_ms, ctx,
+                          t_submit, model, seq_base)
         return fut
 
-    def _route_guarded(self, call, image, cls, deadline_ms, ctx, t_submit) -> None:
+    def _route_guarded(self, call, image, cls, deadline_ms, ctx, t_submit,
+                       model=None, seq_base=None) -> None:
         trace_id = ctx.rid if ctx is not None else None
         try:
-            self._route(call, image, cls, deadline_ms, ctx, t_submit)
+            self._route(call, image, cls, deadline_ms, ctx, t_submit, model, seq_base)
         except Exception as e:  # noqa: BLE001 — a crashed route must not hang its client
             self._reg.counter("fleet.route_errors").inc()
             self._fail_leg(call, HedgedCall.PRIMARY, e, cls=cls, trace_id=trace_id)
 
-    def _route(self, call, image, cls, deadline_ms, ctx, t_submit) -> None:
+    def _route(self, call, image, cls, deadline_ms, ctx, t_submit,
+               model=None, seq_base=None) -> None:
         rid = ctx.wire_id if ctx is not None else None
         # the fleet trace id every leg's X-Trace-Parent carries: the
         # router's own monotonic rid (context.py parse_trace_parent)
@@ -671,28 +771,32 @@ class Router:
         if hedge_s is not None and self.n_routable() >= 2:
             timer = threading.Timer(
                 hedge_s, self._fire_hedge,
-                args=(call, image, cls, deadline_ms, rid, trace_id, primary_at, t_submit),
+                args=(call, image, cls, deadline_ms, rid, trace_id, primary_at, t_submit,
+                      model),
             )
             timer.daemon = True
             timer.start()
         try:
             targs = {"trace": trace_id} if trace_id is not None else {}
+            if model is not None:
+                targs["model"] = model
             with obs_trace.get_tracer().span("fleet/route", "serve", cls=cls, **targs):
                 self._leg(call, HedgedCall.PRIMARY, image, cls, deadline_ms, rid,
                           exclude=set(), chosen=primary_at, t_submit=t_submit,
-                          trace_id=trace_id)
+                          trace_id=trace_id, model=model, seq_base=seq_base)
         finally:
             if timer is not None and call.resolved:
                 timer.cancel()
 
     def _fire_hedge(self, call, image, cls, deadline_ms, rid, trace_id, primary_at,
-                    t_submit) -> None:
+                    t_submit, model=None) -> None:
         try:  # Timer threads die as silently as any other (YAMT011 discipline)
             if not call.launch_hedge():
                 return  # primary already resolved; nothing to duplicate
             exclude = {primary_at["key"]} if "key" in primary_at else set()
             self._leg(call, HedgedCall.HEDGE, image, cls, deadline_ms, rid,
-                      exclude=exclude, t_submit=t_submit, trace_id=trace_id)
+                      exclude=exclude, t_submit=t_submit, trace_id=trace_id,
+                      model=model)
         except Exception as e:  # noqa: BLE001 — contain: fail the leg, not the thread
             self._reg.counter("fleet.route_errors").inc()
             self._fail_leg(call, HedgedCall.HEDGE, e, cls=cls, trace_id=trace_id)
@@ -706,22 +810,27 @@ class Router:
                              error=type(exc).__name__)
 
     def _leg(self, call, leg, image, cls, deadline_ms, rid, *, exclude, chosen=None,
-             t_submit=None, trace_id=None) -> None:
+             t_submit=None, trace_id=None, model=None, seq_base=None) -> None:
         """One leg (primary or hedge) of one request: pick, dispatch, retry
         transport-level failures on other replicas, resolve the call.
 
         Trace propagation: each ATTEMPT of each leg gets a distinct seq
-        (hedge attempts offset by TRACE_SEQ_HEDGE_BASE) stamped into the
-        ``X-Trace-Parent`` header, plus a ``fleet/leg`` span with a flow
-        arrow whose id the replica's ``link_parent`` flow-end shares — the
-        merged trace draws router -> leg -> replica per attempt."""
+        (hedge attempts offset by TRACE_SEQ_HEDGE_BASE; a cascade
+        escalation's primary legs by TRACE_SEQ_CASCADE_BASE via
+        ``seq_base``) stamped into the ``X-Trace-Parent`` header, plus a
+        ``fleet/leg`` span with a flow arrow whose id the replica's
+        ``link_parent`` flow-end shares — the merged trace draws
+        router -> leg -> replica per attempt."""
         tracer = obs_trace.get_tracer()
         tried = set(exclude)
         last_exc: Exception | None = None
-        seq_base = TRACE_SEQ_HEDGE_BASE if leg == HedgedCall.HEDGE else 0
+        if leg == HedgedCall.HEDGE:
+            seq_base = TRACE_SEQ_HEDGE_BASE
+        elif seq_base is None:
+            seq_base = 0
         for attempt in range(self._route_attempts):
             try:
-                rep = self._pick(tried)
+                rep = self._pick(tried, model)
             except NoHealthyReplicas as e:
                 self._fail_leg(call, leg, last_exc or e, cls=cls, trace_id=trace_id)
                 return
@@ -730,12 +839,17 @@ class Router:
             tp = None
             targs = {}
             if trace_id is not None:
-                # seq < 16 is the parse_trace_parent contract; retries past
-                # the hedge offset would collide, so clamp (route_attempts
-                # is small — <= ~3 — in any real config)
-                seq = seq_base + min(attempt, TRACE_SEQ_HEDGE_BASE - 1)
+                # seq < 16 is the parse_trace_parent contract; retries must
+                # stay inside their band (primary 0..3, cascade 4..7, hedge
+                # 8..15), so clamp to the band width (route_attempts is
+                # small — <= ~3 — in any real config)
+                span = ((TRACE_SEQ_HEDGE_BASE - seq_base)
+                        if seq_base < TRACE_SEQ_HEDGE_BASE else (16 - seq_base))
+                seq = seq_base + min(attempt, span - 1)
                 tp = f"{trace_id}-{seq}-{leg}"
                 targs = {"trace": trace_id, "leg": leg, "seq": seq}
+                if model is not None:
+                    targs["model"] = model
             t0 = time.perf_counter() if t_submit is None else t_submit
             t_leg = time.perf_counter()
             try:
@@ -749,6 +863,7 @@ class Router:
                     logits = rep.client.predict(
                         image, priority=cls, deadline_ms=deadline_ms, request_id=rid,
                         trace_parent=tp, timeout_s=self._client_timeout_s,
+                        model=model,
                     )
             except ClientConnectError as e:
                 # the socket is dead — likely a killed replica: score it,
@@ -871,5 +986,10 @@ class Router:
                 "leased": sum(1 for r in reps if r["source"] == "lease"),
                 "lease_ttl_s": self._lease_ttl_s,
             },
-            "fleet": {"total": len(reps), "routable": routable, "replicas": reps},
+            "fleet": {
+                "total": len(reps), "routable": routable, "replicas": reps,
+                # the union of advertised model names (None = zoo-unaware
+                # fleet): what NoReplicaForModel's 503 body reports as served
+                "models": sorted({m for r in reps if r["models"] for m in r["models"]}) or None,
+            },
         }
