@@ -6,22 +6,31 @@
 # for CI / pre-commit: machine-readable output on stdout, findings count on
 # stderr. Usage:
 #   scripts/lint.sh [--format json|text|github] [--changed]
-#                   [--check-suppressions] [extra paths...]
+#                   [--check-suppressions] [--artifact FILE] [extra paths...]
 # --format github emits ::error workflow annotations so a GitHub Actions run
 # marks the offending lines in the PR diff (analysis/reporters.py).
 # --changed lints only .py files differing from the merge-base with
 # ${LINT_BASE:-main} (plus uncommitted and untracked files) — same exit and
 # format semantics, for fast pre-commit runs. Interprocedural rules see only
 # the changed files in this mode; the tier-1 gate still sweeps everything.
+# The whole-package PAIRING rules (YAMT022-025: sent-vs-parsed headers,
+# escaping exceptions vs _ERROR_MAP, metric/config drift) are deselected
+# here — on a partial file set every contract's other side looks absent and
+# they would flood false positives; only the full sweep can judge them.
 # --check-suppressions audits suppression comments instead of linting:
 # a suppression whose rule no longer fires at its site exits nonzero
 # (YAMT900) so stale ones cannot accumulate.
+# --artifact FILE (or LINT_ARTIFACT=FILE) additionally writes ONE combined
+# machine-readable JSON document — {"package": <report>, "scripts": <report>}
+# — to FILE for pre-push hooks / CI upload, regardless of --format; the
+# on-stdout format semantics are unchanged.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
 FORMAT=json
 CHANGED=0
+ARTIFACT="${LINT_ARTIFACT:-}"
 MODEFLAGS=()
 EXTRA=()
 while [ $# -gt 0 ]; do
@@ -29,6 +38,7 @@ while [ $# -gt 0 ]; do
         --format) FORMAT="$2"; shift 2 ;;
         --changed) CHANGED=1; shift ;;
         --check-suppressions) MODEFLAGS+=(--check-suppressions); shift ;;
+        --artifact) ARTIFACT="$2"; shift 2 ;;
         *) EXTRA+=("$1"); shift ;;
     esac
 done
@@ -40,7 +50,9 @@ SCRIPT_RULES="YAMT002,YAMT006"
 
 PKG_PATHS=(yet_another_mobilenet_series_tpu/)
 SCRIPT_PATHS=(scripts/)
+PKG_DESELECT=()
 if [ "$CHANGED" -eq 1 ]; then
+    PKG_DESELECT=(--deselect "YAMT022,YAMT023,YAMT024,YAMT025")
     base=$(git merge-base HEAD "${LINT_BASE:-main}" 2>/dev/null || echo HEAD)
     mapfile -t files < <(
         { git diff --name-only "$base" -- '*.py'
@@ -68,7 +80,7 @@ rc=0
 out=""
 if [ "${#PKG_PATHS[@]}" -gt 0 ] || [ "${#EXTRA[@]}" -gt 0 ]; then
     out=$(python -m yet_another_mobilenet_series_tpu.analysis --format "$FORMAT" \
-        ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} \
+        ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} ${PKG_DESELECT[@]+"${PKG_DESELECT[@]}"} \
         ${PKG_PATHS[@]+"${PKG_PATHS[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}) || rc=$?
     echo "$out"
 fi
@@ -79,6 +91,45 @@ if [ "${#SCRIPT_PATHS[@]}" -gt 0 ]; then
         ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} \
         --select "$SCRIPT_RULES" ${SCRIPT_PATHS[@]+"${SCRIPT_PATHS[@]}"}) || rc2=$?
     echo "$out2"
+fi
+if [ -n "$ARTIFACT" ]; then
+    # one combined JSON document whatever the display format; when stdout is
+    # already JSON the reports are reused, otherwise the lint re-runs quietly
+    # (pure AST, a few seconds) rather than complicating the display path
+    pkg_json="$out"
+    scr_json="$out2"
+    if [ "$FORMAT" != json ]; then
+        pkg_json=""
+        scr_json=""
+        if [ "${#PKG_PATHS[@]}" -gt 0 ] || [ "${#EXTRA[@]}" -gt 0 ]; then
+            pkg_json=$(python -m yet_another_mobilenet_series_tpu.analysis \
+                --format json ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} \
+                ${PKG_DESELECT[@]+"${PKG_DESELECT[@]}"} \
+                ${PKG_PATHS[@]+"${PKG_PATHS[@]}"} ${EXTRA[@]+"${EXTRA[@]}"}) || true
+        fi
+        if [ "${#SCRIPT_PATHS[@]}" -gt 0 ]; then
+            scr_json=$(python -m yet_another_mobilenet_series_tpu.analysis \
+                --format json ${MODEFLAGS[@]+"${MODEFLAGS[@]}"} \
+                --select "$SCRIPT_RULES" ${SCRIPT_PATHS[@]+"${SCRIPT_PATHS[@]}"}) || true
+        fi
+    fi
+    PKG_JSON="$pkg_json" SCR_JSON="$scr_json" python - "$ARTIFACT" <<'PY'
+import json, os, sys
+
+def load(text):
+    text = text.strip()
+    return json.loads(text) if text else {"count": 0, "findings": []}
+
+doc = {
+    "package": load(os.environ.get("PKG_JSON", "")),
+    "scripts": load(os.environ.get("SCR_JSON", "")),
+}
+doc["count"] = doc["package"]["count"] + doc["scripts"]["count"]
+with open(sys.argv[1], "w") as fh:
+    json.dump(doc, fh, indent=2)
+    fh.write("\n")
+PY
+    echo "yamt-lint: artifact written to ${ARTIFACT}" >&2
 fi
 if [ "$rc" -ne 0 ] || [ "$rc2" -ne 0 ]; then
     if [ "$FORMAT" = json ]; then

@@ -1,0 +1,13 @@
+"""Typed serve exceptions for the unmapped-escape fixture."""
+
+
+class EngineError(Exception):
+    """Base of every typed serve verdict in this package."""
+
+
+class QueueFull(EngineError):
+    """Bounded queue at capacity — the caller's backpressure signal."""
+
+
+class QuotaExceeded(EngineError):
+    """Per-tenant quota exhausted. No _ERROR_MAP row: the bug under test."""

@@ -1,0 +1,14 @@
+"""The wire boundary: typed exception -> (status, tag, retry-after?)."""
+
+from .errors import QueueFull
+
+_ERROR_MAP = [
+    (QueueFull, 429, "queue_full", True),
+]
+
+
+def classify(exc):
+    for typ, status, tag, _retry_after in _ERROR_MAP:
+        if isinstance(exc, typ):
+            return status, tag
+    return 500, "engine_error"

@@ -1,0 +1,26 @@
+"""BAD: ``QuotaExceeded`` escapes ``Gate.submit`` (via the ``_admit``
+helper, one frame down) but ``frontend._ERROR_MAP`` has no row for it —
+the frontend degrades the typed verdict to a generic 500. ``QueueFull``
+escapes too, but its row keeps it silent."""
+
+from .errors import QueueFull, QuotaExceeded
+
+
+class Gate:
+    def __init__(self, limit, quota):
+        self._limit = limit
+        self._quota = quota
+        self._used = 0
+        self._backlog = 0
+
+    def submit(self, job):
+        self._admit()
+        if self._backlog >= self._limit:
+            raise QueueFull(f"backlog at capacity ({self._limit})")
+        self._backlog += 1
+        return job
+
+    def _admit(self):
+        if self._used >= self._quota:
+            raise QuotaExceeded(f"quota {self._quota} exhausted")
+        self._used += 1

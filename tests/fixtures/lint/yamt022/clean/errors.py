@@ -1,0 +1,17 @@
+"""Typed serve exceptions for the unmapped-escape clean fixture."""
+
+
+class EngineError(Exception):
+    """Base of every typed serve verdict in this package."""
+
+
+class QueueFull(EngineError):
+    """Bounded queue at capacity — the caller's backpressure signal."""
+
+
+class QuotaExceeded(EngineError):
+    """Per-tenant quota exhausted — mapped here, unlike the bad twin."""
+
+
+class TransientSlot(EngineError):
+    """Retryable slot contention: absorbed on the submit path, never wired."""

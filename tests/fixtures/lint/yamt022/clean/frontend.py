@@ -1,0 +1,15 @@
+"""The wire boundary: typed exception -> (status, tag, retry-after?)."""
+
+from .errors import QueueFull, QuotaExceeded
+
+_ERROR_MAP = [
+    (QueueFull, 429, "queue_full", True),
+    (QuotaExceeded, 429, "quota_exceeded", True),
+]
+
+
+def classify(exc):
+    for typ, status, tag, _retry_after in _ERROR_MAP:
+        if isinstance(exc, typ):
+            return status, tag
+    return 500, "engine_error"

@@ -1,0 +1,34 @@
+"""CLEAN: every typed exception that escapes ``Gate.submit`` has an
+``_ERROR_MAP`` row. ``TransientSlot`` is raised two frames down but the
+submit path absorbs it with a narrow except (bounded retry), so it never
+crosses the tier — the escape model must see the narrowing, not the raise."""
+
+from .errors import QueueFull, QuotaExceeded, TransientSlot
+
+
+class Gate:
+    def __init__(self, limit, quota):
+        self._limit = limit
+        self._quota = quota
+        self._used = 0
+        self._backlog = 0
+
+    def submit(self, job):
+        self._admit()
+        for _ in range(3):
+            try:
+                return self._reserve(job)
+            except TransientSlot:
+                continue
+        raise QueueFull(f"backlog at capacity ({self._limit})")
+
+    def _admit(self):
+        if self._used >= self._quota:
+            raise QuotaExceeded(f"quota {self._quota} exhausted")
+        self._used += 1
+
+    def _reserve(self, job):
+        if self._backlog >= self._limit:
+            raise TransientSlot("slot contended; retry")
+        self._backlog += 1
+        return job

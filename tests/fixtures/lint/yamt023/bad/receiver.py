@@ -1,0 +1,9 @@
+"""BAD (half 2): the handler parses ``X-Deadline-Ms`` but no sending side
+in the package ever sets it — a dead parse that reads as a live contract."""
+
+
+def handle(handler):
+    deadline_ms = handler.headers.get("X-Deadline-Ms")
+    if deadline_ms is not None:
+        return float(deadline_ms)
+    return None

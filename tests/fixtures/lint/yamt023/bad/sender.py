@@ -1,0 +1,15 @@
+"""BAD (half 1): ``X-Request-Class`` is set on every outbound request but
+no receiving side in the package ever reads it — the bytes cross the wire
+and die. (``Content-Type`` is not a custom contract header; not checked.)"""
+
+import http.client
+
+
+def call(host, port, body):
+    conn = http.client.HTTPConnection(host, port, timeout=5.0)
+    conn.putrequest("POST", "/infer")
+    conn.putheader("Content-Type", "application/octet-stream")
+    conn.putheader("X-Request-Class", "interactive")
+    conn.endheaders()
+    conn.send(body)
+    return conn.getresponse()

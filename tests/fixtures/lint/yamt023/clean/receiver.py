@@ -1,0 +1,7 @@
+"""CLEAN: the receiving side of both custom headers sender.py sets."""
+
+
+def handle(handler):
+    cls = handler.headers.get("X-Request-Class") or "best_effort"
+    deadline_ms = handler.headers.get("X-Deadline-Ms")
+    return cls, (float(deadline_ms) if deadline_ms is not None else None)

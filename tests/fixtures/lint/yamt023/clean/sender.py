@@ -1,0 +1,15 @@
+"""CLEAN: both custom headers this package sends are parsed by the
+receiving side (receiver.py) — no drift in either direction."""
+
+import http.client
+
+
+def call(host, port, body, deadline_ms):
+    conn = http.client.HTTPConnection(host, port, timeout=5.0)
+    conn.putrequest("POST", "/infer")
+    conn.putheader("Content-Type", "application/octet-stream")
+    conn.putheader("X-Request-Class", "interactive")
+    conn.putheader("X-Deadline-Ms", str(deadline_ms))
+    conn.endheaders()
+    conn.send(body)
+    return conn.getresponse()

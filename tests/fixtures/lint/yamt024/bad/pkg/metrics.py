@@ -1,0 +1,12 @@
+"""BAD: ``pkg.mystery_count`` is emitted but absent from the taxonomy
+(../docs/OBSERVABILITY.md), and the ``pkg.queue_wait_seconds.<class>``
+family is neither registered in PROM_LABEL_FAMILIES nor documented —
+every sample renders as its own unlabeled series. The documented +
+registered emissions stay silent."""
+
+
+def record(reg, cls, wait_s, latency_s):
+    reg.counter("pkg.requests").inc()
+    reg.counter("pkg.mystery_count").inc()
+    reg.histogram(f"pkg.queue_wait_seconds.{cls}").observe(wait_s)
+    reg.histogram(f"pkg.latency_seconds.{cls}").observe(latency_s)

@@ -1,0 +1,5 @@
+"""The per-label family registrations for /metrics rendering."""
+
+PROM_LABEL_FAMILIES: dict[str, str] = {
+    "pkg.latency_seconds": "class",
+}

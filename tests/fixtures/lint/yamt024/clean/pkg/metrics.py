@@ -1,0 +1,10 @@
+"""CLEAN: every emitted name is in the taxonomy (``pkg.completed`` via the
+elided-sibling doc idiom) and every dotted family is registered in
+PROM_LABEL_FAMILIES."""
+
+
+def record(reg, cls, wait_s, latency_s):
+    reg.counter("pkg.requests").inc()
+    reg.counter("pkg.completed").inc()
+    reg.histogram(f"pkg.queue_wait_seconds.{cls}").observe(wait_s)
+    reg.histogram(f"pkg.latency_seconds.{cls}").observe(latency_s)

@@ -1,0 +1,6 @@
+"""The per-label family registrations for /metrics rendering."""
+
+PROM_LABEL_FAMILIES: dict[str, str] = {
+    "pkg.latency_seconds": "class",
+    "pkg.queue_wait_seconds": "class",
+}

@@ -1,0 +1,30 @@
+"""BAD: the exact PR 18 shape — ``ServeConfig`` grew a ``zoo: ZooConfig``
+section but ``ZooConfig`` was never added to ``_SECTION_TYPES``, so every
+dotted ``serve.zoo.*`` override raises TypeError at build time (the nested
+dict is handed to the dataclass constructor uncoerced)."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ZooConfig:
+    models: str = ""
+
+
+@dataclass
+class ServeConfig:
+    zoo: ZooConfig = field(default_factory=ZooConfig)
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+_SECTION_TYPES = {
+    "ServeConfig": ServeConfig,
+}
+
+
+def build(overrides):
+    cfg = ServeConfig()
+    for key, value in overrides.items():
+        setattr(cfg, key, value)
+    return cfg

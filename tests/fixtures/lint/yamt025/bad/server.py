@@ -1,0 +1,6 @@
+"""The package-side consumer of every plain config field (so the only
+finding the bad twin can produce is the unregistered section)."""
+
+
+def serve(cfg):
+    return cfg.host, cfg.port, cfg.zoo.models.split(",")

@@ -1,0 +1,29 @@
+"""CLEAN: every section dataclass is registered in ``_SECTION_TYPES`` and
+every plain field has a package-code reader (server.py)."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ZooConfig:
+    models: str = ""
+
+
+@dataclass
+class ServeConfig:
+    zoo: ZooConfig = field(default_factory=ZooConfig)
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+_SECTION_TYPES = {
+    "ZooConfig": ZooConfig,
+    "ServeConfig": ServeConfig,
+}
+
+
+def build(overrides):
+    cfg = ServeConfig()
+    for key, value in overrides.items():
+        setattr(cfg, key, value)
+    return cfg

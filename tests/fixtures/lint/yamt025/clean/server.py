@@ -1,0 +1,5 @@
+"""The package-side consumer of every plain config field."""
+
+
+def serve(cfg):
+    return cfg.host, cfg.port, cfg.zoo.models.split(",")

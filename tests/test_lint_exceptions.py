@@ -1,0 +1,236 @@
+"""Model tests for the escaping-exception layer (analysis/exceptions.py).
+
+The fixture pair in test_lint_rules.py proves YAMT022 flags and stays
+silent end to end; this file pins the MODEL facts the rule consumes —
+raise/re-raise/raise-from propagation through the call graph, except
+narrowing by the project class hierarchy AND the real builtin hierarchy,
+broad-except absorption, else-block bypass, and honest degradation to
+silence on opaque callees and computed raise expressions — so a resolution
+regression fails here with a named fact, not as a mysteriously silent rule.
+"""
+
+import pathlib
+
+from yet_another_mobilenet_series_tpu.analysis.core import Project, SourceFile, collect_paths
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
+
+
+def _project(paths):
+    py, yml = collect_paths([str(p) for p in paths])
+    files = []
+    for p in py:
+        with open(p, encoding="utf-8") as f:
+            files.append(SourceFile(p, f.read()))
+    return Project(files, yml)
+
+
+def _escapes(model, tail):
+    q = next(q for q in model.project.summaries if q.endswith(tail))
+    return {k.rsplit(".", 1)[-1] for k in model.escape_set(q)}
+
+
+# -- raise propagation -------------------------------------------------------
+
+
+def test_raise_propagates_through_calls(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class Boom(Exception):\n"
+        "    pass\n"
+        "\n"
+        "def deep():\n"
+        "    raise Boom('x')\n"
+        "\n"
+        "def mid():\n"
+        "    return deep()\n"
+        "\n"
+        "def top():\n"
+        "    return mid()\n"
+    )
+    model = _project([tmp_path]).exceptions
+    assert _escapes(model, ".top") == {"Boom"}
+
+
+def test_raise_from_and_ctor_args_resolve_to_the_class(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class WireError(Exception):\n"
+        "    pass\n"
+        "\n"
+        "def decode(raw):\n"
+        "    try:\n"
+        "        return int(raw)\n"
+        "    except ValueError as e:\n"
+        "        raise WireError(f'bad frame {raw!r}') from e\n"
+    )
+    model = _project([tmp_path]).exceptions
+    assert _escapes(model, ".decode") == {"WireError"}
+
+
+# -- except narrowing --------------------------------------------------------
+
+
+def test_narrow_except_absorbs_subclass_and_passes_sibling(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class Base(Exception):\n"
+        "    pass\n"
+        "\n"
+        "class Retryable(Base):\n"
+        "    pass\n"
+        "\n"
+        "class Fatal(Base):\n"
+        "    pass\n"
+        "\n"
+        "def work(flag):\n"
+        "    if flag:\n"
+        "        raise Retryable()\n"
+        "    raise Fatal()\n"
+        "\n"
+        "def call():\n"
+        "    try:\n"
+        "        work(True)\n"
+        "    except Retryable:\n"
+        "        return None\n"
+    )
+    model = _project([tmp_path]).exceptions
+    # Retryable absorbed by its own handler; the sibling provably passes
+    assert _escapes(model, ".call") == {"Fatal"}
+
+
+def test_catching_the_base_absorbs_project_subclasses(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class Base(Exception):\n"
+        "    pass\n"
+        "\n"
+        "class Retryable(Base):\n"
+        "    pass\n"
+        "\n"
+        "def work():\n"
+        "    raise Retryable()\n"
+        "\n"
+        "def call():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Base:\n"
+        "        return None\n"
+    )
+    model = _project([tmp_path]).exceptions
+    assert _escapes(model, ".call") == set()
+
+
+def test_builtin_hierarchy_narrows_externals(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "def work(d):\n"
+        "    raise KeyError('k')\n"
+        "\n"
+        "def call(d):\n"
+        "    try:\n"
+        "        return work(d)\n"
+        "    except LookupError:\n"
+        "        return None\n"
+        "\n"
+        "def passes(d):\n"
+        "    try:\n"
+        "        return work(d)\n"
+        "    except OSError:\n"
+        "        return None\n"
+    )
+    model = _project([tmp_path]).exceptions
+    # KeyError is a LookupError (real builtin hierarchy) but NOT an OSError
+    assert _escapes(model, ".call") == set()
+    assert _escapes(model, ".passes") == {"KeyError"}
+
+
+def test_else_block_bypasses_the_handlers(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class Boom(Exception):\n"
+        "    pass\n"
+        "\n"
+        "def call(x):\n"
+        "    try:\n"
+        "        y = x + 1\n"
+        "    except Boom:\n"
+        "        return None\n"
+        "    else:\n"
+        "        raise Boom('from else')\n"
+    )
+    model = _project([tmp_path]).exceptions
+    assert _escapes(model, ".call") == {"Boom"}
+
+
+# -- re-raise ----------------------------------------------------------------
+
+
+def test_bare_raise_reescapes_the_absorbed_set(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "class Boom(Exception):\n"
+        "    pass\n"
+        "\n"
+        "def work():\n"
+        "    raise Boom()\n"
+        "\n"
+        "def logged():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "\n"
+        "def renamed():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"
+        "        raise e\n"
+        "\n"
+        "def swallowed():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        return None\n"
+    )
+    model = _project([tmp_path]).exceptions
+    # a broad handler absorbs, but its re-raise (bare or by the bound
+    # name) puts the ABSORBED set back on the wire
+    assert _escapes(model, ".logged") == {"Boom"}
+    assert _escapes(model, ".renamed") == {"Boom"}
+    assert _escapes(model, ".swallowed") == set()
+
+
+# -- honest degradation ------------------------------------------------------
+
+
+def test_opaque_callee_and_computed_raise_degrade_to_silence(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "def computed(mk):\n"
+        "    raise mk()\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self, cb):\n"
+        "        self._cb = cb\n"
+        "\n"
+        "    def run(self):\n"
+        "        return self._cb()\n"
+    )
+    model = _project([tmp_path]).exceptions
+    # `raise mk()` raises whatever the factory made — no guess; a callback
+    # whose target the call graph cannot resolve contributes nothing
+    assert _escapes(model, ".computed") == set()
+    assert _escapes(model, "Box.run") == set()
+
+
+def test_unknown_external_relationship_is_none_and_absorbs(tmp_path):
+    (tmp_path / "m.py").write_text(
+        "import thirdparty\n"
+        "\n"
+        "def work():\n"
+        "    raise thirdparty.WeirdError('x')\n"
+        "\n"
+        "def call():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except thirdparty.OtherError:\n"
+        "        return None\n"
+    )
+    model = _project([tmp_path]).exceptions
+    # two externals whose bodies we never see: the hierarchy cannot answer
+    assert model.is_subtype("thirdparty.WeirdError", "thirdparty.OtherError") is None
+    # and the try absorbs rather than guessing an escape
+    assert _escapes(model, ".call") == set()

@@ -16,7 +16,7 @@ from yet_another_mobilenet_series_tpu import analysis
 from yet_another_mobilenet_series_tpu.analysis import cli
 
 FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "lint"
-RULE_IDS = [f"YAMT{i:03d}" for i in range(1, 22)]
+RULE_IDS = [f"YAMT{i:03d}" for i in range(1, 26)]
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)

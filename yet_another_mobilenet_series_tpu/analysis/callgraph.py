@@ -87,26 +87,16 @@ class CallGraph:
         self.project = project
         self.symbols = project.symbols
         self._envs: dict = {}
-        self._scope_maps: dict[str, dict[int, ast.AST | None]] = {}
         self._cache: dict[tuple, Optional[Target]] = {}
+        self._summary_reads = 0
 
     # -- scope bookkeeping --------------------------------------------------
 
     def enclosing_scope(self, src, node: ast.AST):
         """Nearest enclosing FunctionDef/AsyncFunctionDef of ``node`` in
-        ``src`` (None = module scope)."""
-        m = self._scope_maps.get(src.path)
-        if m is None:
-            m = {}
-
-            def fill(n, scope):
-                for child in ast.iter_child_nodes(n):
-                    m[id(child)] = scope
-                    fill(child, child if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) else scope)
-
-            fill(src.tree, None)
-            self._scope_maps[src.path] = m
-        return m.get(id(node))
+        ``src`` (None = module scope) — served from the SourceFile's
+        one-time DFS index rather than a per-file recursion here."""
+        return src.scopes.get(id(node))
 
     def _scope_chain(self, src, scope_node):
         chain = []
@@ -232,8 +222,12 @@ class CallGraph:
             key = (id(expr), id(scope_node) if scope_node is not None else None)
             if key in self._cache:
                 return self._cache[key]
+            before = self._summary_reads
             result = self.resolve_expr(src, expr, scope_node, set())
-            if getattr(self.project, "_summaries_done", False):
+            # a resolution whose descent never consulted a summary's
+            # ``returns`` depends only on static structure (symbols, env
+            # bindings) and cannot sharpen — cache it mid-fixpoint too
+            if getattr(self.project, "_summaries_done", False) or self._summary_reads == before:
                 self._cache[key] = result
             return result
         if id(expr) in _guard:
@@ -415,6 +409,7 @@ class CallGraph:
             and callee.inner.kind == "function" else None
         )
         if fi is not None:
+            self._summary_reads += 1
             summary = self.project.summaries.get(fi.qualname)
             if summary is not None and summary.returns is not None:
                 return summary.returns

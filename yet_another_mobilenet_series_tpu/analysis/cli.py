@@ -34,6 +34,13 @@ def main(argv=None) -> int:
         help="report format ('github' emits ::error workflow annotations for CI)",
     )
     p.add_argument("--select", default="", metavar="IDS", help="comma-separated rule ids to run (default: all)")
+    p.add_argument(
+        "--deselect", default="", metavar="IDS",
+        help="comma-separated rule ids to skip (applied after --select; used by "
+        "scripts/lint.sh --changed to drop the whole-package pairing rules, "
+        "which would report every contract's absent other side on a partial "
+        "file set)",
+    )
     p.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
     p.add_argument(
         "--check-suppressions", action="store_true",
@@ -48,6 +55,9 @@ def main(argv=None) -> int:
         return 0
 
     select = {s.strip().upper() for s in args.select.split(",") if s.strip()} or None
+    deselect = {s.strip().upper() for s in args.deselect.split(",") if s.strip()}
+    if deselect:
+        select = (select if select is not None else {r.id for r in load_rules()}) - deselect
     runner = check_suppressions if args.check_suppressions else run_lint
     try:
         findings = runner(args.paths or [_default_path()], select=select)

@@ -549,7 +549,7 @@ class ConcurrencyModel:
         function, or — for a lambda — every resolved call in its body."""
         if isinstance(target, ast.Lambda):
             out = []
-            for node in ast.walk(target.body):
+            for node in src.subtree(target.body):
                 if isinstance(node, ast.Call):
                     t = self.cg.resolve_call(src, node, scope)
                     if t is not None and t.kind == "function":

@@ -50,15 +50,16 @@ _SUPPRESS_RE = re.compile(
 )
 
 
-def _import_aliases(tree: ast.Module) -> dict[str, str]:
+def _import_aliases(nodes) -> dict[str, str]:
     """Local binding -> dotted origin, from every import in the module.
 
     ``import numpy as np`` -> ``{'np': 'numpy'}``; ``from jax import lax`` ->
     ``{'lax': 'jax.lax'}``; relative imports keep their leading dots so they
-    can never collide with an absolute ``jax.*``/``numpy.*`` match.
+    can never collide with an absolute ``jax.*``/``numpy.*`` match. Takes
+    the already-walked node list so the file is traversed once, not twice.
     """
     aliases: dict[str, str] = {}
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.asname:
@@ -105,12 +106,18 @@ class SourceFile:
             self.tree = ast.parse(text, filename=path)
         except SyntaxError as e:
             self.parse_error = e
-        self.aliases = _import_aliases(self.tree) if self.tree is not None else {}
         self._nodes: list[ast.AST] | None = None
+        self._dfs: list[ast.AST] | None = None
+        self._span: dict[int, tuple[int, int]] | None = None
+        self._scopes: dict[int, ast.AST | None] | None = None
+        self._parents: dict[int, ast.AST] | None = None
+        self.aliases = _import_aliases(self.nodes) if self.tree is not None else {}
         self.line_suppressions: dict[int, set[str]] = {}
         self.file_suppressions: set[str] = set()
         self.file_suppression_lines: dict[str, int] = {}
-        for lineno, comment in self._comments():
+        # tokenizing every file for suppression comments costs more than
+        # parsing it; a file without the literal marker has none to find
+        for lineno, comment in self._comments() if "yamt-lint" in text else ():
             m = _SUPPRESS_RE.search(comment)
             if m is None:
                 continue
@@ -145,6 +152,72 @@ class SourceFile:
             self._nodes = [] if self.tree is None else list(ast.walk(self.tree))
         return self._nodes
 
+    def subtree(self, node: ast.AST):
+        """Every node of ``node``'s subtree (``node`` included) — the same
+        node SET as ``ast.walk(node)``, served as a slice of a one-time
+        DFS order of the whole tree instead of a fresh pure-Python re-walk
+        (subtree walks were the analyzer's single hottest primitive).
+        Contiguity is the invariant: a node's descendants occupy one
+        contiguous segment of the DFS list. Iteration order differs from
+        ``ast.walk`` (DFS vs BFS) — no consumer may depend on sibling
+        order across depths. Nodes from another tree fall back to a real
+        walk, never a wrong slice."""
+        self._index()
+        span = self._span.get(id(node))
+        if span is None:
+            return ast.walk(node)
+        i, j = span
+        return self._dfs[i:j]
+
+    @property
+    def scopes(self) -> dict[int, ast.AST | None]:
+        """id(node) -> nearest enclosing FunctionDef/AsyncFunctionDef
+        (None = module scope; a def's OWN scope is its enclosing one),
+        filled during the same one-time DFS pass as :meth:`subtree`."""
+        self._index()
+        return self._scopes
+
+    def _index(self) -> None:
+        if self._dfs is not None:
+            return
+        order: list[ast.AST] = []
+        spans: dict[int, tuple[int, int]] = {}
+        scopes: dict[int, ast.AST | None] = {}
+        if self.tree is not None:
+            scopes[id(self.tree)] = None
+            work: list = [self.tree]
+            while work:
+                n = work.pop()
+                if type(n) is tuple:
+                    spans[n[0]] = (n[1], len(order))
+                    continue
+                start = len(order)
+                order.append(n)
+                work.append((id(n), start))
+                child_scope = (
+                    n if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    else scopes[id(n)]
+                )
+                for child in ast.iter_child_nodes(n):
+                    scopes[id(child)] = child_scope
+                    work.append(child)
+        self._dfs = order
+        self._span = spans
+        self._scopes = scopes
+
+    @property
+    def parents(self) -> dict[int, ast.AST]:
+        """id(child) -> parent node for the whole tree, computed once
+        (rules that walk upward — try/finally enclosure, statement
+        context — were each rebuilding this map per file)."""
+        if self._parents is None:
+            parents: dict[int, ast.AST] = {}
+            for node in self.nodes:
+                for child in ast.iter_child_nodes(node):
+                    parents[id(child)] = node
+            self._parents = parents
+        return self._parents
+
     def suppressed(self, finding: Finding) -> bool:
         for scope in (self.file_suppressions, self.line_suppressions.get(finding.line, ())):
             if "ALL" in scope or finding.rule.upper() in scope:
@@ -163,6 +236,8 @@ class Project:
         self._callgraph = None
         self._summaries = None
         self._concurrency = None
+        self._contracts = None
+        self._exceptions = None
 
     @property
     def symbols(self):
@@ -203,6 +278,26 @@ class Project:
 
             self._concurrency = ConcurrencyModel(self)
         return self._concurrency
+
+    @property
+    def contracts(self):
+        """Wire-contract extraction (contracts.py), built once per Project:
+        headers, _ERROR_MAP, metric names/families, config schema."""
+        if self._contracts is None:
+            from .contracts import ContractModel
+
+            self._contracts = ContractModel(self)
+        return self._contracts
+
+    @property
+    def exceptions(self):
+        """Escaping-exception-set summaries (exceptions.py), demand-driven
+        over the call graph; built once per Project."""
+        if self._exceptions is None:
+            from .exceptions import ExceptionModel
+
+            self._exceptions = ExceptionModel(self)
+        return self._exceptions
 
     @property
     def axis_constants(self) -> dict[str, str]:
@@ -286,6 +381,7 @@ def load_rules() -> list[Rule]:
         rules_async_staging,
         rules_concurrency,
         rules_config,
+        rules_contracts,
         rules_donation,
         rules_dtype,
         rules_imports,

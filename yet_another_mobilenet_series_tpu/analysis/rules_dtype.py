@@ -217,7 +217,7 @@ class _Scanner:
     def _exprs(self, expr: ast.expr | None) -> None:
         if expr is None:
             return
-        for node in ast.walk(expr):
+        for node in self.src.subtree(expr):
             if not isinstance(node, ast.Call):
                 continue
             if isinstance(node.func, (ast.Lambda,)):

@@ -61,10 +61,7 @@ class ProfilerStopGuard(Rule):
     )
 
     def check_file(self, src: SourceFile, project: Project) -> list[Finding]:
-        parents: dict[int, ast.AST] = {}
-        for node in src.nodes:
-            for child in ast.iter_child_nodes(node):
-                parents[id(child)] = node
+        parents = src.parents
         findings: list[Finding] = []
         for node in src.nodes:
             if not isinstance(node, ast.Call):

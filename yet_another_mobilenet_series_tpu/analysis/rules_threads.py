@@ -83,16 +83,16 @@ def _is_guarded(fn: ast.FunctionDef) -> bool:
 class _DefIndex:
     """Function/method definitions in one module, for target resolution."""
 
-    def __init__(self, tree: ast.Module):
+    def __init__(self, src: SourceFile):
         self.by_name: dict[str, list[ast.FunctionDef]] = {}
         self.methods: dict[tuple[str, str], ast.FunctionDef] = {}
         self.enclosing_class: dict[int, str] = {}
-        for node in ast.walk(tree):
+        for node in src.nodes:
             if isinstance(node, ast.ClassDef):
                 for sub in node.body:
                     if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                         self.methods[(node.name, sub.name)] = sub
-                for sub in ast.walk(node):
+                for sub in src.subtree(node):
                     self.enclosing_class[id(sub)] = node.name
             if isinstance(node, ast.FunctionDef):
                 self.by_name.setdefault(node.name, []).append(node)
@@ -151,7 +151,7 @@ class UnguardedThreadTarget(Rule):
                 ))
                 continue
             if index is None:
-                index = _DefIndex(src.tree)
+                index = _DefIndex(src)
             for fn in index.resolve(target, node):
                 if id(fn) in flagged or _is_guarded(fn):
                     continue

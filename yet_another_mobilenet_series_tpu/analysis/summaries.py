@@ -106,7 +106,7 @@ def compute(project, out: dict[str, FunctionSummary]) -> None:
         aliases = src.aliases
         sites[fi.qualname] = [
             (node, qualified_name(node.func, aliases), cg.enclosing_scope(src, node))
-            for node in ast.walk(fi.node)
+            for node in src.subtree(fi.node)
             if isinstance(node, ast.Call)
         ]
 

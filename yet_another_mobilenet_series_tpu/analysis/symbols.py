@@ -173,7 +173,7 @@ class SymbolTable:
     def _index_function(self, mi, node, qualname, cls, parent) -> FunctionInfo:
         fi = FunctionInfo(qualname, mi, node, cls, parent)
         self.by_node[id(node)] = fi
-        for st in ast.walk(node):
+        for st in mi.src.subtree(node):
             if st is not node and isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if id(st) not in self.by_node:
                     # nearest registered ancestor wins as parent; qualname
@@ -196,7 +196,7 @@ class SymbolTable:
                 _record_attr_assign(ci, st.targets[0].id, st.value)
         # `self.x = ...` in any method body
         for m in ci.methods.values():
-            for st in ast.walk(m.node):
+            for st in mi.src.subtree(m.node):
                 if not (isinstance(st, ast.Assign) and len(st.targets) == 1):
                     continue
                 t = st.targets[0]

@@ -842,7 +842,7 @@ class QuantConfig:
     # uint8-wire parity gate: max |logit delta| vs the f32 wire tolerated
     # when the denorm is NOT the bitwise (zero-mean) case — the backend may
     # FMA-fuse the prelude's multiply+add (~1-ulp input deltas)
-    wire_atol: float = 1e-3
+    wire_atol: float = 1e-3  # yamt-lint: disable=YAMT025 — read outside the package: scripts/serve_bench.py's wire-parity gate and tests/test_quant.py consume it; the serving path itself only validates it (__post_init__)
     # int8-weight parity gate: minimum top-1 agreement with the f32 bundle
     # on the calibration batch; export REFUSES to write below it
     int8_top1_min: float = 0.98

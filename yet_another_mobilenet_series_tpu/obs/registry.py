@@ -69,6 +69,16 @@ PROM_LABEL_FAMILIES: dict[str, str] = {
     # tracker's burn rate per window (short/long — serve/signals.py)
     "fleet.window_p99_seconds": "class",
     "fleet.slo_burn_rate": "window",
+    # per-tenant accounting on a zoo-serving replica (serve/admission.py)
+    "serve.model_requests": "model",
+    "serve.model_completed": "model",
+    "serve.model_latency_seconds": "model",
+    # per-model image throughput split (serve/engine.py; DEFAULT_MODEL
+    # rides the unlabeled total only)
+    "serve.infer_images": "model",
+    # XLA cost_analysis gauges keyed by executable (obs/device.py)
+    "obs.cost_flops": "key",
+    "obs.cost_bytes": "key",
 }
 
 

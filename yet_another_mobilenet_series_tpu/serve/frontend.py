@@ -91,30 +91,32 @@ from .router import ModelDigestConflict, NoHealthyReplicas, NoReplicaForModel
 # — the YAMT017 hazard is subtraction, not the reading).
 _PROC_START_UNIX = time.time()
 
-# exception type -> (HTTP status, wire error tag); anything else is a 500.
-# Subtype rows precede their base (isinstance scan): UnknownModel is a
-# client-side naming error (400, never overload-shaped), NoReplicaForModel a
-# placement gap distinct from a dead fleet
-_ERROR_MAP = [
-    (BreakerOpen, 503, "breaker_open"),
-    (BrownoutShed, 503, "brownout"),
-    (DeadlineUnmeetable, 429, "deadline_unmeetable"),
-    (UnknownModel, 400, "unknown_model"),
-    (QueueFull, 429, "queue_full"),  # covers ClassQueueFull / ModelQueueFull too
-    (DeadlineExceeded, 504, "deadline_exceeded"),
-    (DrainTimeout, 503, "draining"),
-    (NoReplicaForModel, 503, "no_replica_for_model"),
-    (NoHealthyReplicas, 503, "no_healthy_replicas"),
-    (ClientTimeout, 504, "timeout"),
-]
-
-# 429/503 tags that mean "alive but saturated — come back": these carry a
+# exception type -> (HTTP status, wire error tag, overload-shaped?); anything
+# else is a 500. Subtype rows precede their base (isinstance scan):
+# UnknownModel is a client-side naming error (400, never overload-shaped),
+# NoReplicaForModel a placement gap distinct from a dead fleet. The final
+# column marks "alive but saturated — come back": those verdicts carry a
 # Retry-After header (RFC 9110), which is ALSO the router's backpressure
 # discriminator (a Retry-After-bearing 503 never scores toward ejection).
 # "draining" and "no_healthy_replicas" mean "stop sending here" — no hint.
-_RETRY_AFTER_TAGS = frozenset({
-    "breaker_open", "brownout", "deadline_unmeetable", "queue_full",
-})
+_ERROR_MAP = [
+    (BreakerOpen, 503, "breaker_open", True),
+    (BrownoutShed, 503, "brownout", True),
+    (DeadlineUnmeetable, 429, "deadline_unmeetable", True),
+    (UnknownModel, 400, "unknown_model", False),
+    (QueueFull, 429, "queue_full", True),  # covers ClassQueueFull / ModelQueueFull too
+    (DeadlineExceeded, 504, "deadline_exceeded", False),
+    (DrainTimeout, 503, "draining", False),
+    (NoReplicaForModel, 503, "no_replica_for_model", False),
+    (NoHealthyReplicas, 503, "no_healthy_replicas", False),
+    (ClientTimeout, 504, "timeout", False),
+]
+
+# derived, not hand-kept: the one source of truth for overload-shaped tags
+# is the _ERROR_MAP row itself
+_RETRY_AFTER_TAGS = frozenset(
+    tag for _typ, _status, tag, retry_after in _ERROR_MAP if retry_after
+)
 
 
 def _classify(exc: Exception) -> tuple[int, str]:
@@ -122,7 +124,7 @@ def _classify(exc: Exception) -> tuple[int, str]:
     # (fleet-behind-the-frontend is indistinguishable from one replica)
     if isinstance(exc, ClientHTTPError):
         return exc.status, exc.tag
-    for typ, status, tag in _ERROR_MAP:
+    for typ, status, tag, _retry_after in _ERROR_MAP:
         if isinstance(exc, typ):
             return status, tag
     return 500, "engine_error"

@@ -338,7 +338,10 @@ class NetChaosProxy:
         never reads (send buffers fill like a routed-to-nowhere link);
         half-open consumes request bytes and answers nothing. Released when
         the settings generation moves (fault cleared) or the proxy stops."""
-        self._reg.counter(f"serve.netchaos.{'blackholed' if shape == 'blackhole' else 'half_open'}").inc()
+        if shape == "blackhole":
+            self._reg.counter("serve.netchaos.blackholed").inc()
+        else:
+            self._reg.counter("serve.netchaos.half_open").inc()
         client.settimeout(_SOCK_TIMEOUT_S)
         while not self._stop.is_set() and not self._gen_moved(gen):
             if shape == "half_open":
