@@ -247,6 +247,17 @@ def summarize(log_dir: str, requests: bool = False, max_requests: int = 20,
                     f"  off-ladder executables evicted: "
                     f"{snap['serve.evicted_executables']:.0f} (LRU bound)"
                 )
+            if snap.get("serve.ring_dispatches"):
+                # device-resident ring (docs/SERVING.md "Device-resident
+                # ring"): slots/window is the dispatch-amortization factor
+                lines.append(
+                    "  ring windows: {:.0f} dispatches, {:.2f} slots/window "
+                    "(max {:.0f}), last fill {:.0%}".format(
+                        snap["serve.ring_dispatches"],
+                        snap.get("serve.ring_slots_per_dispatch.mean", 0),
+                        snap.get("serve.ring_slots_per_dispatch.max", 0),
+                        snap.get("serve.ring_fill", 0))
+                )
             if snap.get("serve.dispatches_per_wakeup.count"):
                 lines.append(
                     "  dispatches/wakeup: mean {:.2f}, max {:.0f} over {:.0f} "

@@ -27,18 +27,24 @@ writes it to a BENCH_SERVE_*.json via --out. Four measurements per run:
    recorded in the artifact (on 1 core the dispatch boundary is nearly free,
    so the speedup may be ~flat — the dispatch-count drop is the pinned win).
 5. **structural sweep** (``--structural``) — ONE interleaved sweep across
-   the four serving structures at a saturated bucket: **sync** (blocking
+   the five serving structures at a saturated bucket: **sync** (blocking
    collect->predict cycle), **pipelined** (async in-flight window),
-   **fused** (coalesced overflow rides the lax.scan executables), and
+   **fused** (coalesced overflow rides the lax.scan executables),
    **overlapped** (fence-tracked slot staging with async H2D + back-to-back
-   runs: > 1 dispatch per completion wake-up, serve/pipeline.py). Rounds
-   interleave mode-by-mode so box drift hits all four alike; per mode the
-   row carries median QPS, fill, dispatches/request, the
+   runs: > 1 dispatch per completion wake-up, serve/pipeline.py), and
+   **ring** (device-resident request ring, serve/ring.py: a window of up
+   to R staged max-bucket slots consumed by ONE masked-scan dispatch).
+   Rounds interleave mode-by-mode so box drift hits all five alike; per
+   mode the row carries median QPS, fill, dispatches/request, the
    ``serve.dispatches_per_wakeup`` registry delta (the back-to-back
-   structural claim — None for sync, 1.0 for per-batch pipelining), the
+   structural claim — None for sync, 1.0 for per-batch pipelining; a ring
+   window is ONE piece, so the per-batch [1, 2] bound does not apply), the
    steady-state ``serve.achieved_flops_per_s`` window (dispatched cost
    FLOPs ÷ measured run seconds) next to the single-dispatch reference,
-   and registry-math latency quantiles. Emits the BENCH_SERVE_r05 shape.
+   ring window counts, and registry-math latency quantiles. The sweep also
+   pins the deterministic ``ring_probe``: a saturated R-slot window is
+   exactly ONE ``serve.dispatch_seconds`` observation, bitwise vs the
+   per-batch path. Emits the BENCH_SERVE_r12 shape (r05 + the ring arm).
 6. **chaos A/B** — an OPEN-LOOP Poisson load generator (arrivals fire on
    schedule regardless of completions — closed loops hide overload) drives
    mixed priorities (interactive/batch/best_effort via serve/admission.py)
@@ -383,21 +389,31 @@ _STRUCTURAL_CPU_CAVEAT = (
     "cannot add throughput here (QPS columns may be ~flat or slightly "
     "negative). The pinned structural wins are dispatches_per_wakeup > 1 on "
     "the saturated bucket, bitwise-identical logits, and the dispatch/ "
-    "transfer accounting; the throughput claim is an accelerator measurement "
-    "— ROADMAP item 3's hardware rung, same caveat discipline as r02/r04."
+    "transfer accounting; for the ring arm they are the deterministic "
+    "one-dispatch window probe (a saturated R-slot window == ONE "
+    "serve.dispatch_seconds observation, registry-delta counted), "
+    "serve.ring_dispatches > 0 under the driven burst, and bitwise parity "
+    "vs the per-batch path. The throughput claim is an accelerator "
+    "measurement — ROADMAP item 2's hardware rung, same caveat discipline "
+    "as r02/r04."
 )
 
 
 def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
-                      staging_slots, run_max, fuse_ladder, rng):
-    """One interleaved sweep across the four serving structures on a
-    saturated bucket (docs/SERVING.md "Overlapped staging"):
+                      staging_slots, run_max, fuse_ladder, rng,
+                      ring_slots=4, ring_min_fill=0.5):
+    """One interleaved sweep across the five serving structures on a
+    saturated bucket (docs/SERVING.md "Overlapped staging" and
+    "Device-resident ring"):
 
     - ``sync``       MicroBatcher: blocking collect -> predict -> resolve
     - ``pipelined``  PipelinedBatcher(run_max=1), chained engine
     - ``fused``      PipelinedBatcher(run_max=1), fused-scan engine
     - ``overlapped`` PipelinedBatcher(run_max), overlapped-staging fused
                      engine — the device-resident steady state
+    - ``ring``       PipelinedBatcher over a ring-mode overlapped engine:
+                     saturated windows of up to ``ring_slots`` staged
+                     max-bucket slots consumed by ONE masked-scan dispatch
 
     All share ``max_batch = 2 * max_bucket`` so every saturated coalesced
     group exceeds the biggest bucket (the fused/overlapped modes serve it
@@ -419,7 +435,9 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
     eng_fused = make_engine("float32", fuse=fuse_ladder)
     eng_overlap = make_engine("float32", fuse=fuse_ladder, overlap=True,
                               staging_slots=staging_slots)
-    for e in (eng_chained, eng_fused, eng_overlap):
+    eng_ring = make_engine("float32", overlap=True, staging_slots=staging_slots,
+                           ring_slots=ring_slots)
+    for e in (eng_chained, eng_fused, eng_overlap, eng_ring):
         e.warmup()
     cap = eng_chained.buckets[-1]
     max_batch = 2 * cap
@@ -435,7 +453,32 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
     bitwise_ok = bool(
         np.array_equal(eng_fused.predict(xp), ref)
         and np.array_equal(eng_overlap.predict(xp), ref)
+        and np.array_equal(eng_ring.predict(xp), ref)  # per-batch fallback path
     )
+    # the ring's headline, pinned deterministically before the driven rounds:
+    # a saturated window of R full max-bucket slots is exactly ONE
+    # serve.dispatch_seconds observation (registry-delta counted), fill 1.0,
+    # and its drained logits are bitwise-identical to the per-batch path
+    xr = rng.normal(0, 1, (ring_slots * cap, size, size, 3)).astype("float32")
+    ring_ref = np.concatenate(
+        [eng_chained.predict(np.ascontiguousarray(xr[i * cap:(i + 1) * cap]))
+         for i in range(ring_slots)])
+    s0 = reg.snapshot()
+    entries = [eng_ring.ring_stage(np.ascontiguousarray(xr[i * cap:(i + 1) * cap]))
+               for i in range(ring_slots)]
+    ring_out = eng_ring.ring_dispatch(entries).result()
+    s1 = reg.snapshot()
+    ring_probe = {
+        "slots": ring_slots,
+        "rows": int(ring_slots * cap),
+        "dispatch_seconds_count_delta": int(
+            s1.get("serve.dispatch_seconds.count", 0)
+            - s0.get("serve.dispatch_seconds.count", 0)),
+        "ring_dispatches_delta": int(
+            s1.get("serve.ring_dispatches", 0) - s0.get("serve.ring_dispatches", 0)),
+        "fill": float(s1.get("serve.ring_fill", 0.0)),
+        "bitwise_ok": bool(np.array_equal(ring_out, ring_ref)),
+    }
     # single-dispatch reference for the efficiency column: cost FLOPs of the
     # full max bucket over its measured direct latency (one warm predict)
     xb = rng.normal(0, 1, (cap, size, size, 3)).astype("float32")
@@ -457,6 +500,10 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
         "overlapped": PipelinedBatcher(
             eng_overlap, max_inflight=max_inflight, run_max=run_max, **common
         ).start(),
+        "ring": PipelinedBatcher(
+            eng_ring, max_inflight=max_inflight, run_max=run_max,
+            ring_min_fill=ring_min_fill, **common
+        ).start(),
     }
     runs = {m: [] for m in batchers}  # per round: (qps, lat, deltas dict)
     try:
@@ -473,6 +520,8 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
                     "serve.batch_size.sum", "serve.dispatches_per_wakeup.count",
                     "serve.dispatches_per_wakeup.sum", "serve.dispatched_flops",
                     "serve.dispatched_bytes", "serve.run_seconds.sum",
+                    "serve.ring_dispatches", "serve.ring_slots_per_dispatch.count",
+                    "serve.ring_slots_per_dispatch.sum",
                 )}
                 d["registry_q"] = _hist_delta_quantiles("serve.run_seconds", run_counts0)
                 runs[mode].append((qps, lat, d))
@@ -510,6 +559,14 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
             "achieved_flops_per_s": round(
                 tot["serve.dispatched_flops"] / tot["serve.run_seconds.sum"], 1
             ) if tot["serve.run_seconds.sum"] > 0 else 0.0,
+            # ring instruments: windows consumed + average staged slots per
+            # window (identically 0/None for the four per-batch arms)
+            "ring_windows": int(tot["serve.ring_dispatches"]),
+            "ring_slots_per_window": (
+                round(tot["serve.ring_slots_per_dispatch.sum"]
+                      / tot["serve.ring_slots_per_dispatch.count"], 3)
+                if tot["serve.ring_slots_per_dispatch.count"] else None
+            ),
         }
     return {
         "image_size": size,
@@ -524,9 +581,16 @@ def _structural_sweep(make_engine, size, *, rounds, conc_iters, max_inflight,
         "fuse_ladder": list(fuse_ladder),
         "bitwise_ok": bitwise_ok,
         "single_dispatch_achieved_flops_per_s": round(single_dispatch_ref, 1),
+        "ring_slots": ring_slots,
+        "ring_min_fill": ring_min_fill,
+        "ring_probe": ring_probe,
         "modes": modes,
         "overlapped_speedup_vs_sync": (
             round(modes["overlapped"]["qps"] / modes["sync"]["qps"], 4)
+            if modes["sync"]["qps"] else None
+        ),
+        "ring_speedup_vs_sync": (
+            round(modes["ring"]["qps"] / modes["sync"]["qps"], 4)
             if modes["sync"]["qps"] else None
         ),
         "cpu_rehearsal_note": _STRUCTURAL_CPU_CAVEAT,
@@ -2407,11 +2471,11 @@ def measure(arch, image_sizes, buckets, iters, conc_iters, ab_iters, max_infligh
     )
     bundle = InferenceBundle(net=net, params=fold_network(net, params, state), meta={})
 
-    def make_engine(dtype, fuse=(), overlap=False, staging_slots=2):
+    def make_engine(dtype, fuse=(), overlap=False, staging_slots=2, ring_slots=0):
         return InferenceEngine(bundle, buckets=buckets, compute_dtype=dtype,
                                image_size=base_size, image_sizes=image_sizes,
                                fuse_ladder=fuse, overlap_staging=overlap,
-                               staging_slots=staging_slots)
+                               staging_slots=staging_slots, ring_slots=ring_slots)
 
     # the baseline engine stays CHAINED (fuse_ladder=()) so direct /
     # concurrent / chaos rows keep their r01-r03 meaning; the fused engine

@@ -1,0 +1,36 @@
+"""YAMT014 clean fixture: the ring slot-feed idiom (serve/engine.py
+``ring_stage`` / ``ring_dispatch``). Host threads feed a window of slot
+buffers with async device_put — one transfer per slot, no dispatch — and
+the consuming window dispatch's OUTPUT logits become the fence of every
+slot it consumed (the donated inputs are deleted by donation and cannot be
+waited on). A buffer is rewritten only after that fence is ready."""
+
+import jax
+import numpy as np
+
+
+def feed_and_dispatch_windows(windows, ring_exe, params, r=4):
+    # 2R host buffers: R possibly consumed by the in-flight window plus R
+    # being fed for the next one — the fence wait stays ~0 at steady state
+    bufs = [np.zeros((8, 24, 24, 3), np.float32) for _ in range(2 * r)]
+    fences = [None] * (2 * r)
+    nxt = 0
+    outs = []
+    for window in windows:
+        fed = []
+        for rows in window:
+            i = nxt
+            nxt = (nxt + 1) % len(bufs)
+            if fences[i] is not None:
+                # fence idiom: the previous consumer's outputs existing
+                # proves its input transfer finished with this host memory
+                jax.block_until_ready(fences[i])
+                fences[i] = None
+            bufs[i][: len(rows)] = rows
+            bufs[i][len(rows) :] = 0.0
+            fed.append((i, jax.device_put(bufs[i])))  # async feed, no dispatch
+        ys = ring_exe(params, *[x for _, x in fed])  # ONE dispatch per window
+        for i, _ in fed:
+            fences[i] = ys  # one fence arms every consumed slot
+        outs.append(ys)
+    return outs

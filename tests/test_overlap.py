@@ -340,6 +340,39 @@ def test_dispatches_per_wakeup_counts_engine_pieces(bundle):
     assert h.total - sum0 == pieces
 
 
+def test_dispatches_per_wakeup_counts_ring_window_as_one_piece(bundle):
+    """Ring-mode re-pin of the histogram-sum == dispatch-count invariant
+    (serve/ring.py): a ring window — however many slots it consumed — is
+    ONE engine piece (``handle.dispatches`` == 1, one
+    ``serve.dispatch_seconds`` observation), so the equality holds with
+    ring and per-batch dispatches mixed in one registry window, and a
+    ring wake-up observes exactly 1 — the per-batch-mode [1, 2] contract
+    bound (tests/test_bench_contract.py) deliberately does NOT apply to
+    the ring arm, whose whole point is many batches per dispatch."""
+    ringe = InferenceEngine(bundle, buckets=(2, 4), image_size=24,
+                            overlap_staging=True, staging_slots=2, ring_slots=4)
+    ringe.warmup()
+    reg = get_registry()
+    h = reg.histogram("serve.dispatches_per_wakeup")
+    sum0 = h.total
+    d0 = reg.snapshot().get("serve.dispatch_seconds.count", 0)
+    r0 = reg.snapshot().get("serve.ring_dispatches", 0)
+    rng = np.random.RandomState(12)
+    img = rng.normal(0, 1, (24, 24, 3)).astype(np.float32)
+    b = PipelinedBatcher(ringe, max_inflight=2, max_batch=8, max_wait_ms=20.0).start()
+    try:
+        # burst: enough queued rows for ring windows to form mid-stream
+        futs = [b.submit(img) for _ in range(48)]
+        for f in futs:
+            f.result(timeout=120)
+    finally:
+        b.stop()
+    snap = reg.snapshot()
+    pieces = snap["serve.dispatch_seconds.count"] - d0
+    assert snap.get("serve.ring_dispatches", 0) - r0 >= 1  # the ring really engaged
+    assert h.total - sum0 == pieces  # a window = ONE piece, invariant intact
+
+
 class _RecordingEngine:
     """Minimal engine protocol double recording dispatched batch sizes."""
 

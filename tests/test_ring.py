@@ -1,0 +1,333 @@
+"""Device-resident request ring tests (docs/SERVING.md "Device-resident
+ring", serve/ring.py + engine ring mode).
+
+The ring's load-bearing claims, each pinned:
+
+- **bitwise parity by construction**: a ring window's logits are bitwise
+  identical to the per-batch path for every staged row — across window
+  fills, partial last slots, the uint8 wire, int8-weight bundles, and
+  every tenant of a 2-model zoo. The scan body IS the per-chunk forward;
+  the mask is a scalar-bool output select, never an input blend.
+- **one dispatch per window**: a window of R staged slots costs exactly
+  ONE ``serve.dispatch_seconds`` observation (the registry-delta probe),
+  and the ring accounting (``serve.ring_dispatches``,
+  ``serve.ring_slots_per_dispatch``, ``serve.ring_fill``) matches.
+- **typed feed/consume contract**: the window shape is validated with
+  typed errors — only the LAST slot may be partial, 1..R slots, ring off
+  is a RuntimeError — and the config block refuses nonsense depths/fills.
+- **pipeline engagement and fallback**: a saturated burst rides the ring
+  (``serve.ring_dispatches`` advances, answers correct); trickle traffic
+  and off-ladder sizes ride the untouched per-batch path.
+
+Heavy matrix corners (u8 wire x int8 weights x zoo x both ladder sizes)
+are ``@pytest.mark.slow`` to hold the tier-1 wall-time budget.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from yet_another_mobilenet_series_tpu.config import ModelConfig, RingConfig
+from yet_another_mobilenet_series_tpu.models import get_model
+from yet_another_mobilenet_series_tpu.obs.registry import get_registry
+from yet_another_mobilenet_series_tpu.serve import quant
+from yet_another_mobilenet_series_tpu.serve.engine import InferenceEngine
+from yet_another_mobilenet_series_tpu.serve.export import InferenceBundle, fold_network
+from yet_another_mobilenet_series_tpu.serve.pipeline import PipelinedBatcher
+from yet_another_mobilenet_series_tpu.serve.ring import RingEntry, min_slots, window_chunks
+
+
+def _small_net(num_classes=10, image_size=24):
+    specs = [
+        {"t": 2, "c": 8, "n": 1, "s": 2},
+        {"t": 3, "c": 16, "n": 2, "s": 2},
+    ]
+    return get_model(
+        ModelConfig(arch="mobilenet_v2", num_classes=num_classes, block_specs=specs, dropout=0.0),
+        image_size=image_size,
+    )
+
+
+def _folded_bundle(seed=0, num_classes=10, int8=False):
+    net = _small_net(num_classes=num_classes)
+    params, state = net.init(jax.random.PRNGKey(seed))
+    k = jax.random.PRNGKey(seed + 1)
+    leaves, treedef = jax.tree.flatten(state)
+    keys = jax.random.split(k, len(leaves))
+    state = jax.tree.unflatten(
+        treedef,
+        [l + 0.1 * jnp.abs(jax.random.normal(kk, l.shape)) + 0.01 for l, kk in zip(leaves, keys)],
+    )
+    folded = fold_network(net, params, state)
+    if int8:
+        folded, _ = quant.quantize_folded(folded)
+    return InferenceBundle(net=net, params=folded, meta={})
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return _folded_bundle()
+
+
+def _images(counts, size, *, wire, seed=0):
+    """Per-slot input arrays in the wire's client dtype: raw u8 pixels on
+    the uint8 wire, already-normalized floats on the f32 wire."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i, n in enumerate(counts):
+        if wire == "uint8":
+            out.append(rng.randint(0, 256, (n, size, size, 3)).astype(np.uint8))
+        else:
+            out.append(rng.normal(0, 1, (n, size, size, 3)).astype(np.float32))
+    return out
+
+
+def _ring_vs_per_batch(eng, counts, size, *, wire, model=None, ref_eng=None, seed=0):
+    """Stage one window of ``counts`` slots, dispatch it, and assert the
+    drained logits are bitwise identical to the per-batch path, slot by
+    slot (per-slot references use each slot's own bucket, the strictest
+    comparison: different executable, same math)."""
+    parts = _images(counts, size, wire=wire, seed=seed)
+    entries = [eng.ring_stage(p.copy()) for p in parts]
+    out = eng.ring_dispatch(entries, model=model).result()
+    assert out.shape[0] == sum(counts)
+    ref = ref_eng if ref_eng is not None else eng
+    # a dedicated single-bundle reference engine serves its bundle as the
+    # default tenant: query it unqualified
+    ref_model = model if ref_eng is None else None
+    at = 0
+    for p in parts:
+        want = (ref.predict(p.copy(), model=ref_model)
+                if ref_model is not None else ref.predict(p.copy()))
+        np.testing.assert_array_equal(out[at:at + len(p)], want)
+        at += len(p)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# pure helpers + config surface
+# ---------------------------------------------------------------------------
+
+
+def test_ring_min_slots_and_window_chunks():
+    assert min_slots(4, 0.5) == 2
+    assert min_slots(4, 1.0) == 4
+    assert min_slots(4, 0.01) == 1
+    assert min_slots(3, 1 / 3) == 1  # the epsilon keeps exact thirds exact
+    chunks, leftover = window_chunks(list(range(10)), 4, 4)
+    assert [len(c) for c in chunks] == [4, 4, 2] and leftover == []
+    chunks, leftover = window_chunks(list(range(20)), 4, 4)
+    assert [len(c) for c in chunks] == [4, 4, 4, 4] and leftover == [16, 17, 18, 19]
+    assert window_chunks([], 4, 4) == ([], [])
+    with pytest.raises(ValueError):
+        window_chunks([1], 0, 4)
+    with pytest.raises(ValueError):
+        window_chunks([1], 4, 0)
+
+
+def test_ring_config_validation():
+    rc = RingConfig(enable=True, slots=6, min_fill=0.25)
+    assert rc.slots == 6
+    with pytest.raises(ValueError):
+        RingConfig(slots=1)
+    with pytest.raises(ValueError):
+        RingConfig(min_fill=0.0)
+    with pytest.raises(ValueError):
+        RingConfig(min_fill=1.5)
+
+
+def test_ring_engine_ctor_validation(bundle):
+    with pytest.raises(ValueError):
+        InferenceEngine(bundle, buckets=(2,), fuse_ladder=(), ring_slots=1)
+    eng = InferenceEngine(bundle, buckets=(2,), fuse_ladder=())
+    assert eng.ring_slots == 0
+    with pytest.raises(RuntimeError):
+        eng.ring_stage(np.zeros((1, 24, 24, 3), np.float32))
+    with pytest.raises(RuntimeError):
+        eng.ring_dispatch([RingEntry(None, 1)])
+
+
+# ---------------------------------------------------------------------------
+# parity matrix: window fills x wire x sizes (engine level)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("wire", ["float32", "uint8"])
+def test_ring_parity_across_window_fills(bundle, wire):
+    """The core matrix cell: f32 and u8 wires, every window fill shape a
+    4-deep ring admits over bucket 4 — saturated, partial last slot,
+    single full slot, single partial slot — all bitwise."""
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(),
+                          wire=wire, ring_slots=4)
+    eng.warmup()
+    for seed, counts in enumerate([(4, 4, 4, 4), (4, 4, 2), (4,), (3,)]):
+        _ring_vs_per_batch(eng, counts, 24, wire=wire, seed=seed)
+
+
+def test_ring_parity_on_second_ladder_size(bundle):
+    """Both rungs of a 2-size ladder get their own warmed ring executable
+    and both serve bitwise; an off-ladder size reports not ring-ready."""
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_sizes=(24, 32),
+                          fuse_ladder=(), ring_slots=4)
+    eng.warmup()
+    for size in (24, 32):
+        assert eng.ring_ready(None, size)
+        _ring_vs_per_batch(eng, (4, 3), size, wire="float32", seed=size)
+    assert not eng.ring_ready(None, 48)
+
+
+def test_ring_parity_int8_weights():
+    """int8-weight bundles need no ring plumbing: apply_folded dequantizes
+    in-program, in the ring scan body exactly as in the per-chunk
+    executables — parity stays bitwise."""
+    b8 = _folded_bundle(seed=3, int8=True)
+    eng = InferenceEngine(b8, buckets=(2, 4), image_size=24, fuse_ladder=(),
+                          ring_slots=4)
+    eng.warmup()
+    _ring_vs_per_batch(eng, (4, 4, 1), 24, wire="float32", seed=11)
+
+
+def test_ring_parity_two_model_zoo():
+    """Each tenant of a ring-enabled zoo engine answers bitwise-identically
+    to a DEDICATED ring-less engine serving that bundle alone — the shared
+    ring staging pools and the per-tenant ring executables add nothing to
+    any tenant's math."""
+    bs = _folded_bundle(seed=0, num_classes=10)
+    bb = _folded_bundle(seed=7, num_classes=7)
+    eng = InferenceEngine(models={"small": bs, "big": bb}, buckets=(2, 4),
+                          fuse_ladder=(), ring_slots=4)
+    eng.warmup()
+    refs = {"small": InferenceEngine(bs, buckets=(2, 4), fuse_ladder=()),
+            "big": InferenceEngine(bb, buckets=(2, 4), fuse_ladder=())}
+    for model, seed in (("small", 1), ("big", 2)):
+        out = _ring_vs_per_batch(eng, (4, 2), 24, wire="float32", model=model,
+                                 ref_eng=refs[model], seed=seed)
+        assert out.shape[1] == (10 if model == "small" else 7)
+
+
+@pytest.mark.slow
+def test_ring_parity_heavy_matrix_corner():
+    """The expensive matrix corner in one engine: uint8 wire x int8-weight
+    bundles x 2-model zoo x a 2-size ladder x overlapped staging, every
+    cell bitwise against dedicated ring-less engines."""
+    bs = _folded_bundle(seed=0, num_classes=10, int8=True)
+    bb = _folded_bundle(seed=7, num_classes=7, int8=True)
+    common = dict(buckets=(2, 4), fuse_ladder=(), wire="uint8",
+                  model_image_sizes={"small": (24, 32), "big": (24, 32)})
+    eng = InferenceEngine(models={"small": bs, "big": bb}, ring_slots=4,
+                          overlap_staging=True, staging_slots=2, **common)
+    eng.warmup()
+    refs = {"small": InferenceEngine(models={"small": bs}, **common),
+            "big": InferenceEngine(models={"big": bb}, **common)}
+    for size in (24, 32):
+        for model, seed in (("small", size), ("big", size + 1)):
+            for counts in ((4, 4, 4, 4), (4, 1)):
+                _ring_vs_per_batch(eng, counts, size, wire="uint8", model=model,
+                                   ref_eng=refs[model], seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# one-dispatch probe + accounting (registry deltas)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_window_is_one_dispatch(bundle):
+    """The tentpole's headline, registry-delta counted: a saturated window
+    of R full slots is exactly ONE serve.dispatch_seconds observation, one
+    serve.ring_dispatches tick, fill == 1.0, and R slots in the
+    slots-per-dispatch histogram."""
+    get_registry().reset()
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(),
+                          ring_slots=4)
+    eng.warmup()
+    snap0 = get_registry().snapshot()
+    parts = _images((4, 4, 4, 4), 24, wire="float32", seed=5)
+    entries = [eng.ring_stage(p) for p in parts]
+    out = eng.ring_dispatch(entries).result()
+    assert out.shape == (16, 10)
+    snap = get_registry().snapshot()
+
+    def delta(key):
+        return snap.get(key, 0) - snap0.get(key, 0)
+
+    assert delta("serve.dispatch_seconds.count") == 1
+    assert delta("serve.ring_dispatches") == 1
+    assert delta("serve.ring_slots_per_dispatch.count") == 1
+    assert delta("serve.ring_slots_per_dispatch.sum") == 4
+    assert snap["serve.ring_fill"] == 1.0
+    assert delta("serve.infer_images") == 16
+    assert delta("serve.bucket_hits.4") == 4
+    assert delta("serve.dispatched_flops") > 0
+    # a half-filled window still runs the same executable; fill says so
+    _ring_vs_per_batch(eng, (4, 4), 24, wire="float32", seed=6)
+    assert get_registry().snapshot()["serve.ring_fill"] == 0.5
+
+
+def test_ring_dispatch_typed_window_errors(bundle):
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(),
+                          ring_slots=4)
+    eng.warmup()
+    with pytest.raises(ValueError, match="1..4 rows|ring slot holds"):
+        eng.ring_stage(np.zeros((5, 24, 24, 3), np.float32))
+    with pytest.raises(ValueError, match="ring_stage expects"):
+        eng.ring_stage(np.zeros((2, 24, 32, 3), np.float32))
+    partial = eng.ring_stage(np.zeros((2, 24, 24, 3), np.float32))
+    full = eng.ring_stage(np.zeros((4, 24, 24, 3), np.float32))
+    with pytest.raises(ValueError, match="LAST ring slot"):
+        eng.ring_dispatch([partial, full])
+    with pytest.raises(ValueError, match="ring window holds"):
+        eng.ring_dispatch([])
+    # the staged-but-refused slots are still dispatchable in the right order
+    out = eng.ring_dispatch([full, partial]).result()
+    assert out.shape == (6, 10)
+
+
+# ---------------------------------------------------------------------------
+# pipeline engagement + fallback
+# ---------------------------------------------------------------------------
+
+
+def test_ring_pipeline_burst_rides_ring_trickle_does_not(bundle):
+    """A concurrent burst deep enough to fill min_fill * R slots rides the
+    ring (serve.ring_dispatches advances; every answer bitwise vs direct
+    predict); afterwards, sequential trickle traffic leaves the ring
+    counter untouched and still answers correctly."""
+    get_registry().reset()
+    eng = InferenceEngine(bundle, buckets=(2, 4), image_size=24, fuse_ladder=(),
+                          ring_slots=4)
+    eng.warmup()
+    b = PipelinedBatcher(eng, max_inflight=2, max_batch=8, max_wait_ms=20.0,
+                         queue_depth=64, ring_min_fill=0.5).start()
+    try:
+        rng = np.random.RandomState(0)
+        imgs = [rng.normal(0, 1, (24, 24, 3)).astype(np.float32) for _ in range(32)]
+        results = {}
+        lock = threading.Lock()
+
+        def client(i):
+            val = b.submit(imgs[i].copy()).result(timeout=30)
+            with lock:
+                results[i] = val
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        burst_rings = get_registry().snapshot().get("serve.ring_dispatches", 0)
+        assert burst_rings >= 1, "a 32-deep burst never engaged the ring"
+        for i in range(32):
+            np.testing.assert_array_equal(
+                results[i], eng.predict(imgs[i][None].copy())[0])
+        # trickle: one request at a time can never stage min_fill * R slots
+        for i in range(3):
+            np.testing.assert_array_equal(
+                b.submit(imgs[i].copy()).result(timeout=30),
+                eng.predict(imgs[i][None].copy())[0])
+        assert get_registry().snapshot().get("serve.ring_dispatches", 0) == burst_rings
+    finally:
+        b.stop()

@@ -167,6 +167,10 @@ def _make_batcher(cfg: Config, engine) -> MicroBatcher:
             # back-to-back dispatch rides the overlap block: a saturated
             # bucket dispatches runs with one completion wake-up per run
             run_max=cfg.serve.overlap.run_max if cfg.serve.overlap.enable else 1,
+            # ring feed/drain engages iff the ENGINE has ring_slots > 0
+            # (serve.ring.enable wired into eng_kw); min_fill only sets the
+            # engagement threshold here
+            ring_min_fill=cfg.serve.ring.min_fill,
             **common,
         )
     return MicroBatcher(engine.predict, **common)
@@ -438,6 +442,12 @@ def run(cfg: Config) -> dict:
             wire=cfg.serve.quant.wire,
             wire_mean=cfg.data.mean,
             wire_std=cfg.data.std,
+            # device-resident request ring (serve/ring.py): one masked-scan
+            # dispatch per steady-state window. Gated off under the mesh
+            # here (the engine would refuse the combination) — the same
+            # per-chunk fallback rule fusion follows under data_parallel
+            ring_slots=cfg.serve.ring.slots
+            if (cfg.serve.ring.enable and mesh is None) else 0,
         )
         if zoo is not None:
             engine = InferenceEngine(**zoo.engine_kwargs(), **eng_kw)

@@ -899,6 +899,36 @@ class OverlapConfig:
 
 
 @dataclass(frozen=True)
+class RingConfig:
+    """Device-resident request ring (serve/ring.py, serve/engine.py,
+    docs/SERVING.md "Device-resident ring"): R pre-staged batch slots per
+    hot (model, bucket, image_size) key consumed by ONE AOT-compiled
+    lax.scan dispatch per steady-state window. Host threads only feed
+    slots (async device_put through the fence-tracked slot-pool idiom) and
+    drain per-slot logits; an active-slot mask lets a partially-filled
+    window run the same executable with padded slots' outputs discarded —
+    bitwise parity with the per-batch path by construction, the same
+    discipline as the fused-K scan. Engages only when the pipeline sees a
+    saturated bucket worth >= min_fill of the ring; everything else rides
+    the existing per-batch dispatch path."""
+
+    enable: bool = False
+    # ring depth R: pre-staged batch slots per (model, bucket, size) key;
+    # one ring dispatch consumes up to R slots
+    slots: int = 4
+    # minimum window occupancy (staged slots / R) before the pipeline
+    # commits a ring dispatch; below it the per-batch path runs instead
+    min_fill: float = 0.5
+
+    def __post_init__(self):
+        if self.slots < 2:
+            raise ValueError(f"serve.ring.slots must be >= 2, got {self.slots}")
+        if not 0.0 < self.min_fill <= 1.0:
+            raise ValueError(
+                f"serve.ring.min_fill must be in (0, 1], got {self.min_fill}")
+
+
+@dataclass(frozen=True)
 class CascadeConfig:
     """Confidence cascade (serve/cascade.py, docs/SERVING.md "Multi-model
     zoo & cascade"): the cheap small-tier model answers every request; a
@@ -1019,6 +1049,9 @@ class ServeConfig:
     # overlapped staging + back-to-back dispatch: the device-resident
     # steady state (async H2D slot pool; saturated buckets dispatch runs)
     overlap: OverlapConfig = field(default_factory=OverlapConfig)
+    # device-resident request ring: one lax.scan dispatch consumes a whole
+    # steady-state window of pre-staged slots (opt-in; per-batch fallback)
+    ring: RingConfig = field(default_factory=RingConfig)
     # HTTP front door / admission control / fault injection sub-blocks
     listen: ListenConfig = field(default_factory=ListenConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
@@ -1113,6 +1146,7 @@ _SECTION_TYPES = {
     "QuantConfig": QuantConfig,
     "FuseChunksConfig": FuseChunksConfig,
     "OverlapConfig": OverlapConfig,
+    "RingConfig": RingConfig,
     "CascadeConfig": CascadeConfig,
     "ZooConfig": ZooConfig,
     "ServeConfig": ServeConfig,

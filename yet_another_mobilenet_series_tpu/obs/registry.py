@@ -76,6 +76,9 @@ PROM_LABEL_FAMILIES: dict[str, str] = {
     # per-model image throughput split (serve/engine.py; DEFAULT_MODEL
     # rides the unlabeled total only)
     "serve.infer_images": "model",
+    # per-model ring-window split (serve/engine.py ring_dispatch; same
+    # DEFAULT_MODEL-rides-the-total convention as infer_images)
+    "serve.ring_dispatches": "model",
     # XLA cost_analysis gauges keyed by executable (obs/device.py)
     "obs.cost_flops": "key",
     "obs.cost_bytes": "key",

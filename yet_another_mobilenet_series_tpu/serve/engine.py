@@ -90,6 +90,23 @@ holds int8 while compute stays f32/bf16. There is ONE wire dtype per
 engine, resolved from config at construction (never a per-call fork):
 flipping ``serve.quant.wire`` is a config change, not a code path change.
 
+**Device-resident request ring** (``ring_slots`` > 0, serve.ring config,
+serve/ring.py): the steady-state generalization of the fused scan. R
+pre-staged batch slots per (model, max-bucket, size) key are consumed by
+ONE ``lax.scan`` dispatch carrying an active-slot mask — host threads only
+feed slots (:meth:`InferenceEngine.ring_stage`: async ``device_put``
+through a ring-private fence-tracked slot pool) and drain per-slot logits
+(:meth:`InferenceEngine.ring_dispatch` returns a standard
+:class:`PendingPrediction`); a partially-filled window runs the same
+executable with padded slots entering as device-side zeros and their
+outputs masked away, so ring logits are bitwise-identical to the per-batch
+path by construction. Ring executables are keyed ``(model, bucket, size,
+R)`` in their own cache alongside the fused ``(model, bucket, size, K)``
+ladder; staging stays geometry-shared across zoo tenants. The ring
+requires ``mesh=None`` (like fusion, device_put sharding semantics
+differ) and each ring dispatch observes ``serve.dispatch_seconds`` exactly
+once — a whole window is one engine piece, which is the point.
+
 **Compilation never blocks warm traffic**: a cold (off-ladder) key compiles
 under a dedicated compile lock with a double-checked insert, OUTSIDE the
 dispatch lock — while one thread pays a cold compile, concurrent warm-size
@@ -157,6 +174,7 @@ from ..parallel import mesh as mesh_lib
 from . import quant
 from .admission import UnknownModel
 from .export import InferenceBundle, apply_folded
+from .ring import RingEntry
 
 # the implicit model name of a single-bundle engine: its cost keys carry no
 # model suffix, so every pre-zoo dashboard/bench key (serve_b8_s224_k1)
@@ -182,6 +200,14 @@ def _cost_key(bucket: int, size: int, k: int, tag: str = "") -> str:
     weights) so an A/B running several engines in one process never
     cross-writes another mode's cost gauges."""
     return f"serve_b{bucket}_s{size}_k{k}{tag}"
+
+
+def _ring_cost_key(bucket: int, size: int, r: int, tag: str = "") -> str:
+    """Cost-gauge key of a ring executable — ``ring{R}`` instead of
+    ``k{K}`` so a ring of depth 4 never collides with the fused K=4 scan
+    of the same geometry (they are different programs: the ring carries
+    the mask and R donated slot arguments)."""
+    return f"serve_b{bucket}_s{size}_ring{r}{tag}"
 
 
 class _StagingSlot:
@@ -345,6 +371,7 @@ class InferenceEngine:
         wire: str = "float32",
         wire_mean: Sequence[float] | None = None,
         wire_std: Sequence[float] | None = None,
+        ring_slots: int = 0,
     ):
         if not buckets:
             raise ValueError("engine needs at least one batch bucket")
@@ -396,6 +423,16 @@ class InferenceEngine:
         self._wire_np = quant.wire_np_dtype(wire)  # validates the name too
         self._wire_jnp = jnp.uint8 if wire == "uint8" else jnp.float32
         self._denorm_scale, self._denorm_shift = quant.denorm_constants(wire_mean, wire_std)
+        # device-resident request ring (serve.ring config, serve/ring.py):
+        # 0 = off. The ring is a mesh-less structure for the same reason
+        # fusion is (device_put sharding semantics differ under a mesh),
+        # and a depth-1 "ring" is just the per-batch path with extra steps.
+        if ring_slots and ring_slots < 2:
+            raise ValueError(f"ring_slots must be 0 (off) or >= 2, got {ring_slots}")
+        if ring_slots and mesh is not None:
+            raise ValueError("the request ring requires mesh=None "
+                             "(data-parallel serving rides the per-chunk path)")
+        self._ring_slots = int(ring_slots)
         self._mesh = mesh
         self._donate = donate_input
         if mesh is not None:
@@ -449,6 +486,15 @@ class InferenceEngine:
         # cross-model reuse safe exactly like same-model reuse).
         self._compiled: dict[tuple[str, int, int, int], jax.stages.Compiled] = {}
         self._staging: dict[tuple[int, int, int], _SlotPool] = {}
+        # ring executables keyed (model, bucket, image_size, R) in their own
+        # cache alongside the fused ladder (a ring program has a different
+        # signature: mask + R donated slots). Ring staging pools are keyed
+        # (bucket, image_size) and — like the per-piece pools — SHARED
+        # across zoo tenants: a slot's host buffer depends only on geometry
+        # and wire. The pipeline only engages the ring on ladder sizes
+        # (ring_ready), so these caches are bounded by the warmed ladder.
+        self._ring_compiled: dict[tuple[str, int, int, int], jax.stages.Compiled] = {}
+        self._ring_staging: dict[tuple[int, int], _SlotPool] = {}
         # off-ladder keys live in a bounded PER-MODEL LRU (on-ladder keys are
         # pinned): a size-scanning client must not grow the caches without
         # bound, and a churn burst on one tenant must never evict another
@@ -587,6 +633,74 @@ class InferenceEngine:
         self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
         return compiled
 
+    def _build_ring(self, model: str, bucket: int, size: int, r: int):
+        """Compile the ring executable for ``(model, bucket, size, R)``: a
+        ``lax.scan`` over R stacked slot arrays plus an active-slot mask.
+        The scan body is the SAME per-chunk forward the (bucket, size, 1)
+        executable compiles — denorm prelude included on the u8 wire — so
+        an active slot's logits are bitwise-identical to the per-batch
+        path; a masked (padded) slot's output is selected to zeros by a
+        scalar-bool ``where``, which cannot perturb the active slots. All
+        R slot arguments are donated (each is engine-staged and dead after
+        the call); the mask and params are not."""
+        st = self._model_states[model]
+
+        def run_one(params, x):
+            if self._wire == "uint8":
+                # same in-program denorm prelude as _build's K executables
+                # (serve/quant.py): the ring scans RAW u8 slots and
+                # denormalizes inside the scan body
+                x = quant.denormalize_device(x, self._denorm_scale, self._denorm_shift)
+            return apply_folded(st.net, params, x, compute_dtype=self._compute_dtype)
+
+        def run(params, mask, *slots):
+            xs = jnp.stack(slots)
+
+            def body(carry, xm):
+                x, m = xm
+                y = run_one(params, x)
+                # scalar-bool select: active slots pass through bit-exact,
+                # padded slots' outputs are discarded by the drain anyway
+                return carry, jnp.where(m, y, jnp.zeros_like(y))
+
+            _, ys = jax.lax.scan(body, None, (xs, mask))
+            return ys
+
+        slot_shape = jax.ShapeDtypeStruct((bucket, size, size, 3), self._wire_jnp)
+        mask_shape = jax.ShapeDtypeStruct((r,), jnp.bool_)
+        donate = tuple(range(2, 2 + r)) if self._donate else ()
+        fn = jax.jit(run, donate_argnums=donate)
+        t0 = time.perf_counter()
+        with obs_trace.get_tracer().span("serve/compile", "serve", bucket=bucket,
+                                         image_size=size, ring=r, model=model):
+            compiled = obs_device.timed_compile(
+                fn.lower(st.params, mask_shape, *([slot_shape] * r)),
+                _ring_cost_key(bucket, size, r, st.cost_tag),
+                registry=self._reg,
+            )
+        self._reg.histogram("serve.compile_seconds").observe(time.perf_counter() - t0)
+        return compiled
+
+    def _ensure_ring_compiled(self, model: str, key: tuple[int, int, int]):
+        """Ring executable for ``(model, bucket, size, R)``, compiling on
+        miss with the same never-block-warm-traffic discipline as
+        :meth:`_ensure_compiled`. No LRU: ring keys are bounded by the
+        warmed ladder (the pipeline refuses off-ladder ring engagement)."""
+        full = (model,) + key
+        with self._cache_lock:
+            exe = self._ring_compiled.get(full)
+        if exe is not None:
+            return exe
+        with self._compile_lock:
+            with self._cache_lock:
+                exe = self._ring_compiled.get(full)
+            if exe is not None:
+                return exe
+            exe = self._build_ring(model, *key)
+            with self._cache_lock:
+                self._ring_compiled[full] = exe
+            return exe
+
     def _ensure_compiled(self, model: str, key: tuple[int, int, int]):
         """Executable for ``(model, *key)``, compiling on miss WITHOUT
         holding the dispatch lock (double-checked insert): warm traffic
@@ -636,6 +750,8 @@ class InferenceEngine:
                 if self._mesh is None:
                     for k in self.fuse_ladder:
                         self._ensure_compiled(model, (cap, s, k))
+                    if self._ring_slots:
+                        self._ensure_ring_compiled(model, (cap, s, self._ring_slots))
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -804,6 +920,169 @@ class InferenceEngine:
             if cost:
                 self._reg.counter(counter).inc(cost)
         return logits, rows
+
+    # -- device-resident request ring (serve/ring.py) -----------------------
+
+    @property
+    def ring_slots(self) -> int:
+        """Ring depth R (0 = ring mode off) — the pipeline's engagement
+        signal and the window's slot budget."""
+        return self._ring_slots
+
+    def ring_ready(self, model: str | None, size: int) -> bool:
+        """Whether a ring window may form for ``(model, size)`` traffic:
+        ring mode on, and ``size`` on the tenant's warmed ladder (an
+        off-ladder size rides the per-batch path — it keeps the ring
+        executable cache bounded by the ladder, and a size cold enough to
+        be off-ladder is not the saturated steady state anyway)."""
+        if not self._ring_slots:
+            return False
+        st = self._model_states.get(model or self._default)
+        return st is not None and int(size) in st.image_sizes
+
+    def ring_stage(self, images: np.ndarray) -> RingEntry:
+        """Feed ONE ring slot: stage up to max-bucket rows into a host slot
+        buffer and start its H2D transfer, WITHOUT dispatching — the host
+        side of the window keeps feeding (and the device keeps computing
+        the previous window) while this transfer is in flight. Returns the
+        :class:`~.ring.RingEntry` that :meth:`ring_dispatch` consumes.
+
+        Single-feeder contract: the ring staging pools are (deliberately)
+        as lock-free as the dispatch-path pools, so slots are fed from ONE
+        thread — the pipeline's collect thread. An exact-bucket feed
+        transfers the caller's array zero-copy (freshly-stacked, per the
+        predict_async contract); a partial feed copies into a pool slot
+        whose fence (the consuming ring dispatch's logits) guards reuse."""
+        if not self._ring_slots:
+            raise RuntimeError("ring mode is off (ring_slots=0)")
+        images = quant.coerce_wire(images, self._wire_np)
+        if images.ndim != 4 or images.shape[1] != images.shape[2]:
+            raise ValueError(f"ring_stage expects (N, S, S, 3), got shape {images.shape}")
+        bucket = self.buckets[-1]
+        n = images.shape[0]
+        if not 0 < n <= bucket:
+            raise ValueError(f"a ring slot holds 1..{bucket} rows, got {n}")
+        size = int(images.shape[1])
+        tracer = obs_trace.get_tracer()
+        with tracer.span("serve/stage", "serve", bucket=bucket, rows=n, ring=True):
+            if n == bucket:
+                staged, slot = np.ascontiguousarray(images), None
+            else:
+                key = (bucket, size)
+                with self._cache_lock:
+                    pool = self._ring_staging.get(key)
+                    if pool is None:
+                        # 2R host buffers: R possibly consumed by the
+                        # in-flight window + R being fed for the next one —
+                        # the fence wait stays ~0 at steady state
+                        pool = self._ring_staging[key] = _SlotPool(
+                            (bucket, size, size, 3), 2 * self._ring_slots, self._wire_np)
+                slot = pool.acquire(self._reg)
+                slot.buf[:n] = images
+                slot.buf[n:] = 0
+                self._reg.counter("serve.padded_rows").inc(bucket - n)
+                staged = slot.buf
+            t_h2d = time.perf_counter()
+            with tracer.span("serve/h2d", "serve", bucket=bucket, ring=True,
+                             overlap=self._overlap):
+                if self._overlap:
+                    # async H2D: the slot's buffer is rewritable only after
+                    # the consuming ring dispatch's fence (YAMT014)
+                    x = jax.device_put(staged)
+                else:
+                    x = jnp.asarray(staged)
+            self._reg.histogram("serve.h2d_seconds").observe(time.perf_counter() - t_h2d)
+        self._reg.counter("serve.h2d_bytes").inc(staged.nbytes)
+        return RingEntry(x, n, slot)
+
+    def ring_dispatch(self, entries: Sequence[RingEntry], ctxs=(),
+                      model: str | None = None) -> PendingPrediction:
+        """Consume a window of staged slots in ONE dispatch: the masked
+        ring scan runs every staged slot (and R - staged device-side zero
+        pads) through ``model``'s forward, and the returned handle drains
+        all per-slot logits with a single device_get. Every slot but the
+        last must be FULL — the drain flattens ``(R, bucket, classes)``
+        and slices the first ``rows``, which is only the staged rows when
+        they are contiguous. Observes ``serve.dispatch_seconds`` exactly
+        once: a whole window is one engine piece (``handle.dispatches`` ==
+        1), which is what ``serve.dispatches_per_wakeup`` counts."""
+        st = self._model_state(model)
+        r = self._ring_slots
+        if not r:
+            raise RuntimeError("ring mode is off (ring_slots=0)")
+        entries = list(entries)
+        if not 0 < len(entries) <= r:
+            raise ValueError(f"a ring window holds 1..{r} slots, got {len(entries)}")
+        bucket = self.buckets[-1]
+        if any(e.rows != bucket for e in entries[:-1]):
+            raise ValueError("only the LAST ring slot may be partial "
+                             "(the drain relies on contiguous valid rows)")
+        size = int(entries[0].x.shape[1])
+        rows = (len(entries) - 1) * bucket + entries[-1].rows
+        ctxs = tuple(ctxs)
+        exe = self._ensure_ring_compiled(st.name, (bucket, size, r))  # warmup hit
+        self._reg.counter("serve.infer_images").inc(rows)
+        if st.name != DEFAULT_MODEL:
+            self._reg.counter(f"serve.infer_images.{st.name}").inc(rows)
+        t_start = time.perf_counter()
+        tracer = obs_trace.get_tracer()
+        with self._dispatch_lock:
+            t0 = time.perf_counter()
+            try:
+                span_args = dict(bucket=bucket, image_size=size, rows=rows,
+                                 slots=len(entries), r=r, model=st.name)
+                if ctxs:
+                    span_args["rids"] = [c.rid for c in ctxs[:16]]
+                with tracer.span("serve/ring", "serve", **span_args):
+                    mask = np.zeros((r,), np.bool_)
+                    mask[: len(entries)] = True
+                    xs = [e.x for e in entries] + [
+                        # device-side zero fill for the masked slots: no H2D,
+                        # and each is a DISTINCT buffer (they are all donated)
+                        jnp.zeros((bucket, size, size, 3), self._wire_jnp)
+                        for _ in range(r - len(entries))
+                    ]
+                    ys = exe(st.params, jnp.asarray(mask), *xs)
+                    for c in ctxs:
+                        c.advance("dispatched")
+                        tracer.flow_step("serve/req", c.rid)
+                if self._overlap:
+                    for e in entries:
+                        if e.slot is not None:
+                            # the window's outputs existing proves every
+                            # slot's transfer finished: one fence for all
+                            e.slot.fence = ys
+            except BaseException:
+                if self._overlap:
+                    # same orphan discipline as _dispatch_piece: a failure
+                    # before fence arming must not recycle buffers whose
+                    # transfers may still be in flight
+                    for e in entries:
+                        if e.slot is not None:
+                            e.slot.buf = np.zeros_like(e.slot.buf)
+                            e.slot.fence = None
+                raise
+        self._reg.histogram("serve.dispatch_seconds").observe(time.perf_counter() - t0)
+        self._reg.counter("serve.ring_dispatches").inc()
+        if st.name != DEFAULT_MODEL:
+            self._reg.counter(f"serve.ring_dispatches.{st.name}").inc()
+        self._reg.histogram("serve.ring_slots_per_dispatch").observe(len(entries))
+        self._reg.gauge("serve.ring_fill").set(len(entries) / r)
+        self._reg.counter(f"serve.bucket_hits.{bucket}").inc(len(entries))
+        # the device really computes ALL R scan iterations (the mask selects
+        # outputs, it does not skip compute), so account R x the per-chunk
+        # cost — the fill waste is visible as serve.ring_fill < 1, not
+        # hidden in the FLOPs
+        for counter, lookup in (
+            ("serve.dispatched_flops", obs_device.flops_for),
+            ("serve.dispatched_bytes", obs_device.bytes_for),
+        ):
+            per_chunk = lookup(_cost_key(bucket, size, 1, st.cost_tag))
+            cost = per_chunk * r if per_chunk else lookup(
+                _ring_cost_key(bucket, size, r, st.cost_tag))
+            if cost:
+                self._reg.counter(counter).inc(cost)
+        return PendingPrediction(self, [(ys, rows)], t_start, time.perf_counter(), ctxs=ctxs)
 
     def predict_async(self, images: np.ndarray, ctxs=None,
                       model: str | None = None) -> PendingPrediction:

@@ -64,6 +64,21 @@ first — a run the completion thread has not been handed yet can never be
 the thing its window slots are waiting on (the deadlock this ordering rule
 exists to make impossible).
 
+**Ring feed/drain** (``serve.ring.enable``, serve/ring.py) replaces
+back-to-back dispatch on a saturated bucket with something strictly
+stronger: instead of N dispatches per completion wake-up, the collect
+thread FEEDS up to R max-bucket slots (engine ``ring_stage`` — async H2D
+per slot, no dispatch) and commits the whole window as ONE masked-scan
+dispatch (``ring_dispatch``). Engagement is conservative: the queue (plus
+the batch in hand) must hold at least ``min_slots(R, min_fill)`` slots'
+worth of rows, and only the largest same-(model, shape) group rides the
+ring — everything else (mixed sizes, shallow queues, off-ladder sizes,
+ring-less engines) falls back to the existing per-batch path unchanged,
+so sync / pipelined / fused / overlapped semantics stay intact and
+A/B-able. A ring window occupies ONE in-flight window slot and counts as
+ONE engine piece in ``serve.dispatches_per_wakeup`` (the whole point:
+dispatches-per-window drops to 1/R at full fill).
+
 Failure semantics are preserved, not weakened:
 
 - ``QueueFull`` backpressure and dispatch-time deadline shedding behave as
@@ -104,6 +119,7 @@ import time
 import numpy as np
 
 from ..obs import trace as obs_trace
+from . import ring as ring_lib
 from .batcher import _STOP, DeadlineExceeded, MicroBatcher, _Request, _group_by_shape
 
 # in-flight window sentinel: collect thread -> completion thread shutdown
@@ -131,11 +147,14 @@ class PipelinedBatcher(MicroBatcher):
         default_deadline_ms: float = 0.0,
         drain_timeout_s: float = 0.0,
         wire_dtype=None,
+        ring_min_fill: float = 0.5,
     ):
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
         if run_max < 1:
             raise ValueError(f"run_max must be >= 1, got {run_max}")
+        if not 0.0 < ring_min_fill <= 1.0:
+            raise ValueError(f"ring_min_fill must be in (0, 1], got {ring_min_fill}")
         # the wire dtype rides the engine (serve.quant.wire): submit-side
         # coercion must match the engine's staging buffers, so inherit it
         # unless the caller overrides (bare test doubles default to f32)
@@ -169,6 +188,13 @@ class PipelinedBatcher(MicroBatcher):
         except (TypeError, ValueError):
             self._engine_takes_ctxs = False
             self._engine_takes_model = False
+        # ring feed/drain mode (serve/ring.py): engaged iff the engine was
+        # built with ring_slots > 0 (serve.ring.enable); _ring_min_slots is
+        # the engagement threshold in STAGED SLOTS (min_fill x R, ceil)
+        self._ring_slots = int(getattr(engine, "ring_slots", 0) or 0)
+        self._ring_min_slots = (
+            ring_lib.min_slots(self._ring_slots, ring_min_fill) if self._ring_slots else 0)
+        self._ring_cap = int(engine.buckets[-1]) if self._ring_slots else 0
         # dispatched-but-unsynced budget, acquired BEFORE each dispatch so
         # at most max_inflight executions are ever enqueued device-side
         self._window = threading.BoundedSemaphore(max_inflight)
@@ -263,6 +289,11 @@ class PipelinedBatcher(MicroBatcher):
                 batch.append(nxt)
 
     def _dispatch_batch(self, batch: list[_Request]) -> None:
+        # ring feed/drain first (serve.ring.enable): a saturated window
+        # rides ONE masked-scan dispatch; on False the batch is untouched
+        # (possibly topped up) and falls through to the per-batch path
+        if self._ring_min_slots and self._ring_try(batch):
+            return
         # reserve the slot (window = dispatched-but-unsynced cap) BEFORE
         # dispatch — backpressure toward submit(); released by completion
         self._acquire_window_topping_up(batch)
@@ -297,6 +328,95 @@ class PipelinedBatcher(MicroBatcher):
                 self._linger_fill(nxt)
             self._dispatch_groups(nxt, run)
         self._flush_run(run)
+
+    # -- ring feed/drain (serve/ring.py) ------------------------------------
+
+    def _ring_try(self, batch: list[_Request]) -> bool:
+        """Serve ``batch`` as a device-resident ring window when it is
+        worth one: the batch plus the queue must hold at least
+        ``min_slots`` slots' worth of rows (the min_fill engagement
+        condition), and the window is the largest same-(model, shape)
+        group whose size is ring-ready (on the tenant's warmed ladder).
+        Returns True when the batch was fully handled — the ring group as
+        ONE feed+dispatch, every other group through the normal per-batch
+        machinery. Returns False with the batch intact (possibly topped
+        up from the queue, which the per-batch path would have drained
+        anyway) when no window can form — shallow queue, mixed traffic,
+        off-ladder sizes — so the existing path serves it unchanged."""
+        cap, r = self._ring_cap, self._ring_slots
+        if len(batch) + self._q.qsize() < self._ring_min_slots * cap:
+            return False
+        # saturation top-up with NO linger, to at most one full window:
+        # the queue reported the rows already there
+        while len(batch) < r * cap and not self._exit_after_batch:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is _STOP:
+                self._exit_after_batch = True
+            else:
+                batch.append(nxt)
+        live = self._shed_expired(batch)
+        batch[:] = live
+        if not live:
+            return True  # everything shed: nothing to dispatch, no window taken
+        groups = _group_by_shape(live)
+        best = -1
+        for i, g in enumerate(groups):
+            if (
+                len(g) > (self._ring_min_slots - 1) * cap
+                and self._engine.ring_ready(g[0].model, g[0].image.shape[0])
+                and (best < 0 or len(g) > len(groups[best]))
+            ):
+                best = i
+        if best < 0:
+            return False  # no ring-worthy group; per-batch path serves the batch
+        ring_group = groups.pop(best)
+        batch.clear()
+        # ONE window slot for the whole ring window (it is one handle, one
+        # dispatch); no run is pending yet, so a blocking acquire is safe
+        self._window.acquire()
+        self._ring_dispatch_group(ring_group)
+        rest = [req for g in groups for req in g]
+        if rest:
+            # leftover groups ride the normal path — acquired AFTER the
+            # ring run was flushed, honoring the flush-before-blocking-
+            # acquire ordering rule
+            self._acquire_window_topping_up(rest)
+            run: list[tuple] = []
+            self._dispatch_groups(rest, run)
+            self._flush_run(run)
+        return True
+
+    def _ring_dispatch_group(self, group: list[_Request]) -> None:
+        """Feed one (model, shape)-pure group into ring slots and commit
+        the window: per-slot ``ring_stage`` (async H2D, no dispatch) then
+        ONE ``ring_dispatch``. The caller holds the window slot; an engine
+        failure releases it and fails exactly this group's futures — both
+        threads keep serving, same policy as ``_dispatch_groups``."""
+        self._reg.histogram("serve.batch_size").observe(len(group))
+        for req in group:
+            req._advance("dispatched")
+        try:
+            chunks, leftover = ring_lib.window_chunks(group, self._ring_cap, self._ring_slots)
+            assert not leftover  # _ring_try caps the drain at r * cap rows
+            entries = [
+                self._engine.ring_stage(np.stack([r.image for r in chunk]))
+                for chunk in chunks
+            ]
+            handle = self._engine.ring_dispatch(
+                entries,
+                ctxs=[r.ctx for r in group if r.ctx is not None],
+                model=group[0].model,
+            )
+        except Exception as e:  # noqa: BLE001 — a dying engine must not hang clients
+            self._window.release()
+            for req in group:
+                self._finish_err(req, e)
+            return
+        self._inflight_adj(+1)
+        self._inflight.put([(handle, group)])
 
     def _drain_full_batch_nowait(self) -> list[_Request]:
         """Up to max_batch queued requests with NO lingering — only called
